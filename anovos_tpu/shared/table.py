@@ -1,0 +1,300 @@
+"""Sharded columnar Table — the Spark-DataFrame replacement.
+
+Design (SURVEY.md §7 "Design center"):
+
+- numeric columns: ``float32``/``int32`` device arrays with an explicit bool
+  validity mask (NaN in the source becomes mask=False);
+- categorical/string columns: host-side dictionary (``vocab``: np.ndarray of
+  strings) + device ``int32`` code arrays — *strings never live on the TPU*;
+  null is code ``-1`` with mask=False;
+- timestamp columns: ``int32`` epoch-seconds + mask (host-side parse);
+- every column has the same padded row count, a multiple of the mesh's data
+  axis, so per-shard shapes are static; ``nrows`` is the true row count and
+  padding rows carry mask=False;
+- layout ``(rows_sharded_over_mesh,)`` per column via NamedSharding; stats
+  kernels stack column groups into (rows, ncols) blocks so one batched XLA
+  reduction covers all columns at once (replacing the reference's per-column
+  Spark job loops, e.g. stats_generator.py:386-401).
+
+The reference's dtype triage (shared/utils.py:48-73: string→cat,
+double/int/bigint/float/long/decimal→num) maps onto ``Column.kind``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from anovos_tpu.shared.runtime import get_runtime
+
+# Spark-style dtype names kept for report parity (global_summary prints them).
+NUM_DTYPES = {"int", "bigint", "float", "double", "long", "decimal", "smallint", "tinyint"}
+CAT_DTYPES = {"string", "boolean"}
+
+
+@dataclasses.dataclass
+class Column:
+    """One column: device data + validity mask (+ host vocab for cat)."""
+
+    kind: str  # "num" | "cat" | "ts"
+    data: jax.Array  # f32/i32 (num), i32 codes (cat), i32 epoch-sec (ts)
+    mask: jax.Array  # bool, True = valid
+    vocab: Optional[np.ndarray] = None  # host strings, cat only
+    dtype_name: str = "double"  # spark-style name for reports
+
+    @property
+    def padded_len(self) -> int:
+        return self.data.shape[0]
+
+    def astype_float(self, dtype=jnp.float32) -> jax.Array:
+        return self.data.astype(dtype)
+
+
+def _pad_to(arr: np.ndarray, n: int, fill) -> np.ndarray:
+    if arr.shape[0] == n:
+        return arr
+    pad = np.full((n - arr.shape[0],) + arr.shape[1:], fill, dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def _spark_dtype_name(np_dtype) -> str:
+    kind = np.dtype(np_dtype).kind
+    if kind in "iu":
+        return "bigint" if np.dtype(np_dtype).itemsize > 4 else "int"
+    if kind == "f":
+        return "double" if np.dtype(np_dtype).itemsize > 4 else "float"
+    if kind == "b":
+        return "boolean"
+    if kind == "M":
+        return "timestamp"
+    return "string"
+
+
+class Table:
+    """Immutable-ish columnar table; transformation methods return new Tables."""
+
+    def __init__(self, columns: "OrderedDict[str, Column]", nrows: int):
+        self.columns: "OrderedDict[str, Column]" = columns
+        self.nrows = int(nrows)
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_numpy(
+        data: Dict[str, np.ndarray],
+        nrows: Optional[int] = None,
+    ) -> "Table":
+        """Build from host column arrays (object arrays → cat; datetime64 →
+        ts; numeric → num).  NaN/None become nulls."""
+        rt = get_runtime()
+        cols: "OrderedDict[str, Column]" = OrderedDict()
+        if not data:
+            return Table(cols, 0)
+        n = nrows if nrows is not None else len(next(iter(data.values())))
+        npad = rt.pad_rows(max(n, 1))
+        for name, arr in data.items():
+            cols[name] = _host_to_column(np.asarray(arr), n, npad, rt)
+        return Table(cols, n)
+
+    @staticmethod
+    def from_pandas(df) -> "Table":
+        data = {}
+        for name in df.columns:
+            s = df[name]
+            if s.dtype == object or str(s.dtype) in ("string", "category"):
+                data[name] = s.to_numpy(dtype=object)
+            else:
+                data[name] = s.to_numpy()
+        return Table.from_numpy(data, nrows=len(df))
+
+    # ------------------------------------------------------------------
+    # basic introspection (the reference's utils.attributeType_segregation)
+    # ------------------------------------------------------------------
+    @property
+    def ncols(self) -> int:
+        return len(self.columns)
+
+    @property
+    def col_names(self) -> List[str]:
+        return list(self.columns.keys())
+
+    @property
+    def padded_rows(self) -> int:
+        if not self.columns:
+            return 0
+        return next(iter(self.columns.values())).padded_len
+
+    def dtypes(self) -> List[Tuple[str, str]]:
+        return [(k, c.dtype_name) for k, c in self.columns.items()]
+
+    def attribute_type_segregation(self) -> Tuple[List[str], List[str], List[str]]:
+        """num_cols, cat_cols, other_cols (reference shared/utils.py:48-73)."""
+        num, cat, other = [], [], []
+        for k, c in self.columns.items():
+            if c.kind == "num":
+                num.append(k)
+            elif c.kind == "cat":
+                cat.append(k)
+            else:
+                other.append(k)
+        return num, cat, other
+
+    # ------------------------------------------------------------------
+    # column ops (reference data_ingest.py:201-367)
+    # ------------------------------------------------------------------
+    def select(self, names: Sequence[str]) -> "Table":
+        missing = [n for n in names if n not in self.columns]
+        if missing:
+            raise KeyError(f"columns not in table: {missing}")
+        return Table(OrderedDict((n, self.columns[n]) for n in names), self.nrows)
+
+    def drop(self, names: Sequence[str]) -> "Table":
+        names = set(names)
+        return Table(
+            OrderedDict((n, c) for n, c in self.columns.items() if n not in names),
+            self.nrows,
+        )
+
+    def rename(self, mapping: Dict[str, str]) -> "Table":
+        return Table(
+            OrderedDict((mapping.get(n, n), c) for n, c in self.columns.items()),
+            self.nrows,
+        )
+
+    def with_column(self, name: str, col: Column) -> "Table":
+        cols = OrderedDict(self.columns)
+        cols[name] = col
+        return Table(cols, self.nrows)
+
+    def __getitem__(self, name: str) -> Column:
+        return self.columns[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.columns
+
+    # ------------------------------------------------------------------
+    # device block extraction for batched kernels
+    # ------------------------------------------------------------------
+    def numeric_block(
+        self, names: Sequence[str], dtype=jnp.float32
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Stack numeric columns into (padded_rows, k) X and bool mask M,
+        row-sharded.  This is the input shape for every batched stats kernel."""
+        xs = [self.columns[n].data.astype(dtype) for n in names]
+        ms = [self.columns[n].mask for n in names]
+        X = jnp.stack(xs, axis=1)
+        M = jnp.stack(ms, axis=1)
+        return X, M
+
+    def row_mask(self) -> jax.Array:
+        """Validity of the *row* (excludes padding rows)."""
+        return jnp.arange(self.padded_rows) < self.nrows
+
+    # ------------------------------------------------------------------
+    # host materialization
+    # ------------------------------------------------------------------
+    def to_pandas(self):
+        import pandas as pd
+
+        out = {}
+        n = self.nrows
+        for name, c in self.columns.items():
+            data = np.asarray(jax.device_get(c.data))[:n]
+            mask = np.asarray(jax.device_get(c.mask))[:n]
+            if c.kind == "cat":
+                vals = np.empty(n, dtype=object)
+                valid = mask & (data >= 0)
+                vals[valid] = c.vocab[data[valid]]
+                vals[~valid] = None
+                out[name] = vals
+            elif c.kind == "ts":
+                vals = data.astype("int64") * np.int64(1_000_000_000)
+                ts = vals.view("datetime64[ns]").copy()
+                s = pd.Series(ts)
+                s[~mask] = pd.NaT
+                out[name] = s
+            else:
+                if np.issubdtype(data.dtype, np.integer) and mask.all():
+                    out[name] = data
+                else:
+                    vals = data.astype("float64")
+                    vals[~mask] = np.nan
+                    out[name] = vals
+        return pd.DataFrame(out, columns=list(self.columns.keys()))
+
+    def head(self, k: int = 5):
+        return self.to_pandas().head(k)
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{n}:{c.kind}" for n, c in self.columns.items())
+        return f"Table[{self.nrows} rows]({cols})"
+
+
+def _host_to_column(arr: np.ndarray, n: int, npad: int, rt) -> Column:
+    """Convert one host array to a device Column (pad + shard)."""
+    if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+        # categorical: dictionary-encode on host, codes on device
+        vals = arr[:n]
+        isnull = np.array([v is None or (isinstance(v, float) and np.isnan(v)) for v in vals])
+        strs = np.array(["" if b else str(v) for v, b in zip(vals, isnull)], dtype=object)
+        vocab, codes = np.unique(strs[~isnull], return_inverse=True)
+        code_arr = np.full(n, -1, dtype=np.int32)
+        code_arr[~isnull] = codes.astype(np.int32)
+        data = rt.shard_rows(_pad_to(code_arr, npad, -1))
+        mask = rt.shard_rows(_pad_to(~isnull, npad, False))
+        return Column("cat", data, mask, vocab=vocab.astype(object), dtype_name="string")
+    if arr.dtype.kind == "M":
+        # timestamps → epoch seconds int32
+        vals = arr[:n].astype("datetime64[s]")
+        isnull = np.isnat(vals)
+        secs = vals.astype("int64")
+        secs = np.where(isnull, 0, secs).astype(np.int32)
+        data = rt.shard_rows(_pad_to(secs, npad, 0))
+        mask = rt.shard_rows(_pad_to(~isnull, npad, False))
+        return Column("ts", data, mask, dtype_name="timestamp")
+    if arr.dtype.kind == "b":
+        vals = arr[:n].astype(np.int32)
+        data = rt.shard_rows(_pad_to(vals, npad, 0))
+        mask = rt.shard_rows(_pad_to(np.ones(n, bool), npad, False))
+        return Column("num", data, mask, dtype_name="boolean")
+    # numeric
+    dtn = _spark_dtype_name(arr.dtype)
+    vals = arr[:n]
+    if vals.dtype.kind == "f":
+        isnull = np.isnan(vals)
+        host = np.where(isnull, 0.0, vals).astype(np.float32)
+        fill = np.float32(0)
+    else:
+        isnull = np.zeros(n, dtype=bool)
+        if vals.dtype.itemsize > 4:
+            lo, hi = vals.min(initial=0), vals.max(initial=0)
+            if lo >= np.iinfo(np.int32).min and hi <= np.iinfo(np.int32).max:
+                host = vals.astype(np.int32)
+            else:
+                host = vals.astype(np.float32)
+        else:
+            host = vals.astype(np.int32) if vals.dtype.kind in "iu" else vals.astype(np.float32)
+        fill = host.dtype.type(0)
+    data = rt.shard_rows(_pad_to(host, npad, fill))
+    mask = rt.shard_rows(_pad_to(~isnull, npad, False))
+    return Column("num", data, mask, dtype_name=dtn)
+
+
+def make_column_from_device(
+    kind: str,
+    data: jax.Array,
+    mask: jax.Array,
+    vocab: Optional[np.ndarray] = None,
+    dtype_name: Optional[str] = None,
+) -> Column:
+    if dtype_name is None:
+        dtype_name = {"num": "double", "cat": "string", "ts": "timestamp"}[kind]
+        if kind == "num" and data.dtype in (jnp.int32, jnp.int16, jnp.int8):
+            dtype_name = "int"
+    return Column(kind, data, mask, vocab=vocab, dtype_name=dtype_name)

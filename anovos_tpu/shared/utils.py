@@ -1,0 +1,79 @@
+"""Shared helpers mirroring the reference's shared/utils.py surface.
+
+``attributeType_segregation`` / ``get_dtype`` (utils.py:48-76) live on
+:class:`~anovos_tpu.shared.table.Table`; this module adds the list-handling
+and path helpers plus ``pairwise_reduce`` (utils.py:113-132).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Iterable, List, Sequence, Union
+
+
+def parse_cols(
+    list_of_cols: Union[str, Sequence[str]],
+    all_cols: Sequence[str],
+    drop_cols: Union[str, Sequence[str], None] = None,
+) -> List[str]:
+    """Resolve the universal ``list_of_cols`` convention: a list, a
+    pipe-delimited string (``"c1|c2"``), or ``"all"``; then remove
+    ``drop_cols`` (same formats).  Reference: stats_generator.py:69-79."""
+    if list_of_cols is None:
+        list_of_cols = "all"
+    if isinstance(list_of_cols, str):
+        if list_of_cols.strip().lower() == "all":
+            cols = list(all_cols)
+        else:
+            cols = [c.strip() for c in list_of_cols.split("|") if c.strip()]
+    else:
+        cols = list(list_of_cols)
+    if drop_cols is None:
+        drop_cols = []
+    if isinstance(drop_cols, str):
+        drop_cols = [c.strip() for c in drop_cols.split("|") if c.strip()]
+    dropset = set(drop_cols)
+    out, seen = [], set()
+    for c in cols:
+        if c not in dropset and c not in seen:
+            seen.add(c)
+            out.append(c)
+    return out
+
+
+def pairwise_reduce(op: Callable, items: Iterable):
+    """Tree-reduce (reference utils.py:113-132) — balanced combine order, which
+    also matches the numerically-stable pairwise merge of running moments."""
+    items = list(items)
+    if not items:
+        raise ValueError("pairwise_reduce of empty sequence")
+    while len(items) > 1:
+        nxt = []
+        for i in range(0, len(items) - 1, 2):
+            nxt.append(op(items[i], items[i + 1]))
+        if len(items) % 2:
+            nxt.append(items[-1])
+        items = nxt
+    return items[0]
+
+
+def ends_with(string: str, end_str: str = "/") -> str:
+    """Ensure trailing separator (reference utils.py:93)."""
+    return string if string.endswith(end_str) else string + end_str
+
+
+def output_to_local(path: str) -> str:
+    """dbfs:/ → /dbfs/ rewrite (reference utils.py:135)."""
+    if path.startswith("dbfs:"):
+        return "/dbfs" + path[len("dbfs:"):]
+    return path
+
+
+def path_ak8s_modify(path: str) -> str:
+    """Azure wasbs:// → https:// rewrite (reference utils.py:157)."""
+    if path.startswith("wasbs://"):
+        rest = path[len("wasbs://"):]
+        container, _, tail = rest.partition("@")
+        account, _, blob_path = tail.partition("/")
+        return f"https://{account}/{container}/{blob_path}"
+    return path

@@ -1,0 +1,47 @@
+"""Test harness: 8 virtual CPU devices (the multi-chip "fake backend" the
+Spark reference never had — SURVEY.md §4).  Env vars must be set before jax
+imports anywhere, so this conftest does it at import time."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force off the real TPU tunnel for tests
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The container's sitecustomize imports jax at interpreter startup (axon PJRT
+# registration), which latches JAX_PLATFORMS — override via jax.config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def runtime():
+    """Module-scoped runtime over the 8-device virtual mesh (the analogue of
+    the reference's local[*] spark_session fixture, src/test/conftest.py:6-18)."""
+    from anovos_tpu.shared.runtime import init_runtime
+
+    rt = init_runtime()
+    assert rt.n_devices == 8, f"expected 8 virtual devices, got {rt.n_devices}"
+    return rt
+
+
+@pytest.fixture(scope="session")
+def income_df():
+    """The reference's income dataset as pandas (32,561 rows)."""
+    import pandas as pd
+
+    path = "/root/reference/examples/data/income_dataset/parquet"
+    import glob
+
+    files = glob.glob(path + "/*.parquet")
+    return pd.concat([pd.read_parquet(f) for f in files], ignore_index=True)
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
