@@ -197,6 +197,60 @@ class Table:
         return jnp.arange(self.padded_rows) < self.nrows
 
     # ------------------------------------------------------------------
+    # row movement (gather/filter) — the shuffle replacement
+    # ------------------------------------------------------------------
+    def gather_rows(self, idx: np.ndarray, valid: Optional[np.ndarray] = None) -> "Table":
+        """New Table whose row r is this table's row ``idx[r]``.
+
+        ``idx`` is a host int array (−1 or ``valid[r]==False`` → null row —
+        used for outer joins).  All columns move in ONE jitted program and the
+        result is blocked on before returning: a cross-shard gather lowers to
+        an all-gather, and two *independent* collective programs in flight at
+        once can interleave their rendezvous on hosts with fewer worker
+        threads than devices (observed deadlock on the 8-virtual-device CPU
+        mesh) — single program + block makes the dispatch race-free.
+        """
+        rt = get_runtime()
+        idx = np.asarray(idx)
+        n = len(idx)
+        npad = rt.pad_rows(max(n, 1))
+        if valid is None:
+            valid = idx >= 0
+        live = idx[np.asarray(valid, bool)]
+        if live.size and (live.min() < 0 or live.max() >= self.nrows):
+            raise IndexError(
+                f"gather_rows: index out of range [0, {self.nrows}) "
+                f"(min={live.min()}, max={live.max()})"
+            )
+        idx_p = _pad_to(np.where(valid, idx, 0).astype(np.int32), npad, 0)
+        val_p = _pad_to(np.asarray(valid, bool), npad, False)
+        idx_d = rt.shard_rows(idx_p)
+        val_d = rt.shard_rows(val_p)
+        names = self.col_names
+        datas = tuple(self.columns[c].data for c in names)
+        masks = tuple(self.columns[c].mask for c in names)
+        gd, gm = _gather_program(datas, masks, idx_d, val_d)
+        jax.block_until_ready((gd, gm))
+        cols: "OrderedDict[str, Column]" = OrderedDict()
+        for i, name in enumerate(names):
+            c = self.columns[name]
+            cols[name] = Column(c.kind, gd[i], gm[i], vocab=c.vocab, dtype_name=c.dtype_name)
+        return Table(cols, n)
+
+    def filter_rows(self, keep: np.ndarray) -> "Table":
+        """Compact to rows where host bool ``keep`` is True (stage-boundary
+        host compaction — the 'mask-don't-shrink' escape hatch).  ``keep``
+        must cover all rows (length nrows or padded_rows)."""
+        keep = np.asarray(keep)
+        if len(keep) not in (self.nrows, self.padded_rows):
+            raise ValueError(
+                f"filter_rows: keep has length {len(keep)}, expected "
+                f"{self.nrows} (nrows) or {self.padded_rows} (padded_rows)"
+            )
+        idx = np.nonzero(keep[: self.nrows])[0]
+        return self.gather_rows(idx)
+
+    # ------------------------------------------------------------------
     # host materialization
     # ------------------------------------------------------------------
     def to_pandas(self):
@@ -234,6 +288,13 @@ class Table:
     def __repr__(self) -> str:
         cols = ", ".join(f"{n}:{c.kind}" for n, c in self.columns.items())
         return f"Table[{self.nrows} rows]({cols})"
+
+
+@jax.jit
+def _gather_program(datas, masks, idx, valid):
+    gd = tuple(jnp.take(a, idx, axis=0) for a in datas)
+    gm = tuple(jnp.take(m, idx, axis=0) & valid for m in masks)
+    return gd, gm
 
 
 def _host_to_column(arr: np.ndarray, n: int, npad: int, rt) -> Column:

@@ -1,0 +1,161 @@
+"""Ingest tests (style mirrors the reference's
+src/test/anovos/data_ingest/test_data_ingest_integration.py — read all
+formats, write round-trips, combination ops on small frames)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from anovos_tpu.data_ingest import (
+    concatenate_dataset,
+    data_sample,
+    delete_column,
+    join_dataset,
+    read_dataset,
+    recast_column,
+    recommend_type,
+    rename_column,
+    select_column,
+    write_dataset,
+)
+from anovos_tpu.shared.table import Table
+
+INCOME_PARQUET = "/root/reference/examples/data/income_dataset/parquet"
+INCOME_AVRO = "/root/reference/examples/data/income_dataset/join"
+
+
+def test_read_parquet_dir():
+    t = read_dataset(INCOME_PARQUET, "parquet")
+    assert t.nrows == 32561
+    assert "age" in t and "workclass" in t
+
+
+def test_read_avro_snappy():
+    t = read_dataset(INCOME_AVRO, "avro")
+    assert t.nrows > 0
+    assert set(t.col_names) == {"ifa", "age", "workclass"}
+    df = t.to_pandas()
+    assert df["workclass"].iloc[0] == "Self-emp-not-inc"
+
+
+def test_write_roundtrip(tmp_path):
+    df = pd.DataFrame({"a": [1.0, 2.0, np.nan], "c": ["x", None, "z"]})
+    t = Table.from_pandas(df)
+    for ftype in ("csv", "parquet", "json", "avro"):
+        path = str(tmp_path / f"out_{ftype}")
+        write_dataset(t, path, ftype, {"mode": "overwrite", "header": True, "repartition": 2})
+        back = read_dataset(path, ftype)
+        assert back.nrows == 3
+        bdf = back.to_pandas()
+        np.testing.assert_allclose(bdf["a"].to_numpy(), df["a"].to_numpy())
+        assert bdf["c"].iloc[0] == "x" and bdf["c"].iloc[2] == "z"
+
+
+def test_write_mode_error(tmp_path):
+    t = Table.from_pandas(pd.DataFrame({"a": [1.0]}))
+    path = str(tmp_path / "dup")
+    write_dataset(t, path, "csv", {"mode": "overwrite"})
+    with pytest.raises(FileExistsError):
+        write_dataset(t, path, "csv", {"mode": "error"})
+
+
+def test_concatenate_name_method():
+    t1 = Table.from_pandas(pd.DataFrame({"a": [1.0, 2.0], "c": ["x", "y"]}))
+    t2 = Table.from_pandas(pd.DataFrame({"c": ["z", "x"], "a": [3.0, 4.0]}))
+    out = concatenate_dataset(t1, t2, method_type="name")
+    assert out.nrows == 4
+    df = out.to_pandas()
+    assert df["a"].tolist() == [1.0, 2.0, 3.0, 4.0]
+    assert df["c"].tolist() == ["x", "y", "z", "x"]
+
+
+def test_concatenate_missing_col_errors():
+    t1 = Table.from_pandas(pd.DataFrame({"a": [1.0]}))
+    t2 = Table.from_pandas(pd.DataFrame({"b": [2.0]}))
+    with pytest.raises(ValueError):
+        concatenate_dataset(t1, t2, method_type="name")
+
+
+def test_join_inner_and_left():
+    left = Table.from_pandas(pd.DataFrame({"k": ["a", "b", "c"], "x": [1.0, 2.0, 3.0]}))
+    right = Table.from_pandas(pd.DataFrame({"k": ["b", "c", "d"], "y": [20.0, 30.0, 40.0]}))
+    inner = join_dataset(left, right, join_cols="k", join_type="inner").to_pandas()
+    assert sorted(inner["k"].tolist()) == ["b", "c"]
+    assert inner.set_index("k")["y"].to_dict() == {"b": 20.0, "c": 30.0}
+    lj = join_dataset(left, right, join_cols="k", join_type="left").to_pandas()
+    assert len(lj) == 3
+    assert np.isnan(lj.set_index("k")["y"]["a"])
+    anti = join_dataset(left, right, join_cols="k", join_type="left_anti").to_pandas()
+    assert anti["k"].tolist() == ["a"]
+
+
+def test_join_validations():
+    t1 = Table.from_pandas(pd.DataFrame({"k": ["a"], "x": [1.0]}))
+    t2 = Table.from_pandas(pd.DataFrame({"k": ["a"], "x": [2.0]}))
+    with pytest.raises(ValueError):
+        join_dataset(t1, t2, join_cols="k", join_type="inner")  # duplicate non-join col
+
+
+def test_column_ops():
+    t = Table.from_pandas(pd.DataFrame({"a": [1.0], "b": [2.0], "c": ["x"]}))
+    assert delete_column(t, ["b"]).col_names == ["a", "c"]
+    assert select_column(t, "a|c").col_names == ["a", "c"]
+    assert rename_column(t, ["a"], ["aa"]).col_names == ["aa", "b", "c"]
+
+
+def test_recast_cat_to_num():
+    t = Table.from_pandas(pd.DataFrame({"s": ["1", "2", "bad", None]}))
+    out = recast_column(t, ["s"], ["double"])
+    df = out.to_pandas()
+    np.testing.assert_allclose(df["s"][:2].to_numpy(), [1.0, 2.0])
+    assert np.isnan(df["s"][2]) and np.isnan(df["s"][3])
+
+
+def test_recast_num_to_string():
+    t = Table.from_pandas(pd.DataFrame({"n": [1, 2, 3]}))
+    out = recast_column(t, ["n"], ["string"])
+    assert out["n"].kind == "cat"
+    assert out.to_pandas()["n"].tolist() == ["1", "2", "3"]
+
+
+def test_recommend_type():
+    n = 500
+    df = pd.DataFrame(
+        {
+            "lowcard": np.tile(np.arange(3), n // 3 + 1)[:n].astype(float),
+            "highcard": np.arange(n).astype(float),
+            "cat": np.tile(["a", "b"], n // 2),
+        }
+    )
+    out = recommend_type(Table.from_pandas(df), static_threshold=100, dynamic_threshold=0.5)
+    rec = out.set_index("attribute")["recommended_form"].to_dict()
+    assert rec["lowcard"] == "categorical"
+    assert rec["highcard"] == "numerical"
+    assert rec["cat"] == "categorical"
+
+
+def test_data_sample_random():
+    df = pd.DataFrame({"a": np.arange(10000, dtype=float)})
+    t = Table.from_pandas(df)
+    s = data_sample(t, fraction=0.2, method_type="random", seed_value=7)
+    assert 0.15 * 10000 < s.nrows < 0.25 * 10000
+
+
+def test_data_sample_stratified_population():
+    n = 9000
+    df = pd.DataFrame({"g": np.repeat(["a", "b", "c"], n // 3), "v": np.arange(n, dtype=float)})
+    t = Table.from_pandas(df)
+    s = data_sample(t, strata_cols=["g"], fraction=0.3, method_type="stratified")
+    out = s.to_pandas()["g"].value_counts()
+    for g in ("a", "b", "c"):
+        assert 0.2 * n / 3 < out[g] < 0.4 * n / 3
+
+
+def test_data_sample_balanced():
+    df = pd.DataFrame({"g": ["a"] * 8000 + ["b"] * 1000, "v": np.arange(9000, dtype=float)})
+    t = Table.from_pandas(df)
+    s = data_sample(
+        t, strata_cols=["g"], fraction=0.9, method_type="stratified", stratified_type="balanced"
+    )
+    out = s.to_pandas()["g"].value_counts()
+    assert abs(out["a"] - out["b"]) < 0.25 * max(out["a"], out["b"])
