@@ -1,0 +1,82 @@
+"""Association evaluator tests."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from anovos_tpu.data_analyzer import association_evaluator as ae
+from anovos_tpu.shared.table import Table
+
+
+@pytest.fixture(scope="module")
+def assoc_df(rng=None):
+    g = np.random.default_rng(7)
+    n = 2000
+    x = g.normal(size=n)
+    y = 2 * x + g.normal(size=n) * 0.3
+    z = g.normal(size=n)
+    label = (x + g.normal(size=n) * 0.5 > 0).astype(int)
+    cat = np.where(x > 0.5, "hi", np.where(x < -0.5, "lo", "mid"))
+    return pd.DataFrame({"x": x, "y": y, "z": z, "cat": cat, "label": label})
+
+
+def test_correlation_matrix(assoc_df):
+    t = Table.from_pandas(assoc_df)
+    out = ae.correlation_matrix(t, ["x", "y", "z"])
+    m = out.set_index("attribute")
+    np.testing.assert_allclose(m.loc["x", "y"], assoc_df["x"].corr(assoc_df["y"]), atol=2e-3)
+    np.testing.assert_allclose(m.loc["x", "x"], 1.0, atol=1e-6)
+    assert list(out.columns) == ["attribute", "x", "y", "z"]
+
+
+def test_iv_ranking(assoc_df):
+    t = Table.from_pandas(assoc_df)
+    out = ae.IV_calculation(t, ["x", "z", "cat"], label_col="label", event_label=1).set_index("attribute")
+    assert out.loc["x", "iv"] > out.loc["z", "iv"]
+    assert out.loc["cat", "iv"] > out.loc["z", "iv"]
+    assert out.loc["x", "iv"] > 0.5  # strongly predictive
+
+
+def test_ig_ranking(assoc_df):
+    t = Table.from_pandas(assoc_df)
+    out = ae.IG_calculation(t, ["x", "z"], label_col="label", event_label=1).set_index("attribute")
+    assert out.loc["x", "ig"] > out.loc["z", "ig"]
+    assert out.loc["z", "ig"] < 0.05
+
+
+def test_variable_clustering():
+    g = np.random.default_rng(3)
+    n = 2000
+    x = g.normal(size=n)
+    z = g.normal(size=n)
+    df = pd.DataFrame(
+        {
+            "x": x,
+            "y": x + g.normal(size=n) * 0.2,
+            "z": z,
+            "w": z + g.normal(size=n) * 0.2,
+        }
+    )
+    t = Table.from_pandas(df)
+    out = ae.variable_clustering(t, ["x", "y", "z", "w"])
+    assert set(out.columns) == {"Cluster", "Attribute", "RS_Ratio"}
+    byattr = out.set_index("Attribute")["Cluster"]
+    # two clean correlated pairs → two clusters
+    assert byattr["x"] == byattr["y"]
+    assert byattr["z"] == byattr["w"]
+    assert byattr["z"] != byattr["x"]
+    assert (out["RS_Ratio"] < 0.5).all()
+
+
+def test_iv_against_reference_formula(assoc_df):
+    """Hand-computed IV for the 3-category column."""
+    t = Table.from_pandas(assoc_df)
+    out = ae.IV_calculation(t, ["cat"], label_col="label", event_label=1).set_index("attribute")
+    df = assoc_df
+    tab = df.groupby("cat")["label"].agg(["sum", "count"])
+    l1 = tab["sum"].to_numpy(float)
+    l0 = (tab["count"] - tab["sum"]).to_numpy(float)
+    ev, nev = l1 / l1.sum(), l0 / l0.sum()
+    woe = np.log(nev / ev)
+    iv = round(float(np.sum((nev - ev) * woe)), 4)
+    np.testing.assert_allclose(out.loc["cat", "iv"], iv, atol=2e-4)

@@ -1,0 +1,96 @@
+"""stats_generator golden tests (mirroring the reference's
+test_stats_generator.py style: small frames, hand-computed expectations,
+plus income-dataset spot checks against pandas)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from anovos_tpu.data_analyzer import stats_generator as sg
+from anovos_tpu.shared.table import Table
+
+
+@pytest.fixture()
+def tdf():
+    return Table.from_pandas(
+        pd.DataFrame(
+            {
+                "num": [1.0, 2.0, 2.0, np.nan],
+                "intc": [5, 5, 7, 9],
+                "cat": ["a", "b", "a", None],
+            }
+        )
+    )
+
+
+def test_global_summary(tdf):
+    out = sg.global_summary(tdf)
+    d = dict(zip(out["metric"], out["value"]))
+    assert d["rows_count"] == "4"
+    assert d["columns_count"] == "3"
+    assert d["numcols_count"] == "2"
+    assert d["catcols_count"] == "1"
+    assert "cat" in d["catcols_name"]
+
+
+def test_missing_and_counts(tdf):
+    out = sg.missingCount_computation(tdf).set_index("attribute")
+    assert out.loc["num", "missing_count"] == 1
+    assert out.loc["num", "missing_pct"] == 0.25
+    assert out.loc["cat", "missing_count"] == 1
+    moc = sg.measures_of_counts(tdf).set_index("attribute")
+    assert moc.loc["num", "fill_count"] == 3
+    assert moc.loc["intc", "nonzero_count"] == 4
+    assert np.isnan(moc.loc["cat", "nonzero_count"])  # cat has no nonzero stat
+
+
+def test_central_tendency(tdf):
+    out = sg.measures_of_centralTendency(tdf).set_index("attribute")
+    np.testing.assert_allclose(out.loc["num", "mean"], 5 / 3, rtol=1e-3)
+    assert out.loc["num", "median"] == 2.0
+    assert out.loc["cat", "mode"] == "a"
+    assert out.loc["cat", "mode_rows"] == 2
+    assert out.loc["intc", "mode"] == "5"
+    assert out.loc["intc", "mode_pct"] == 0.5
+    assert pd.isna(out.loc["num", "mode"])  # float column: no mode
+
+
+def test_cardinality(tdf):
+    out = sg.measures_of_cardinality(tdf).set_index("attribute")
+    assert out.loc["cat", "unique_values"] == 2
+    np.testing.assert_allclose(out.loc["cat", "IDness"], 2 / 3, atol=1e-4)
+    assert out.loc["intc", "unique_values"] == 3
+
+
+def test_dispersion_and_shape(tdf):
+    out = sg.measures_of_dispersion(tdf).set_index("attribute")
+    s = pd.Series([5, 5, 7, 9.0])
+    np.testing.assert_allclose(out.loc["intc", "stddev"], round(s.std(), 4))
+    np.testing.assert_allclose(out.loc["intc", "range"], 4.0)
+    sh = sg.measures_of_shape(tdf).set_index("attribute")
+    from scipy import stats as sps
+
+    np.testing.assert_allclose(sh.loc["intc", "skewness"], round(sps.skew(s), 4), atol=1e-3)
+
+
+def test_percentiles(tdf):
+    out = sg.measures_of_percentiles(tdf).set_index("attribute")
+    assert out.loc["intc", "min"] == 5
+    assert out.loc["intc", "max"] == 9
+    assert out.loc["intc", "50%"] == 5  # lower interpolation → dataset element
+
+
+def test_invalid_cols_raise(tdf):
+    with pytest.raises(TypeError):
+        sg.missingCount_computation(tdf, ["nope"])
+    with pytest.raises(TypeError):
+        sg.global_summary(tdf, [])
+
+
+def test_income_parity(income_df):
+    t = Table.from_pandas(income_df)
+    out = sg.measures_of_centralTendency(t, drop_cols=["ifa"]).set_index("attribute")
+    np.testing.assert_allclose(out.loc["age", "mean"], round(income_df["age"].mean(), 4), atol=1e-3)
+    assert out.loc["sex", "mode"] == income_df["sex"].mode()[0]
+    card = sg.measures_of_cardinality(t, drop_cols=["ifa"]).set_index("attribute")
+    assert card.loc["education", "unique_values"] == income_df["education"].nunique()
