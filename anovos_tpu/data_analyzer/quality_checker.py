@@ -68,15 +68,14 @@ def duplicate_detection(
     M = jnp.stack([sub.columns[c].mask for c in cols], 1)
     sig = np.asarray(row_signature(X, M))[: idf.nrows]
     df_sig = pd.DataFrame({"h1": sig[:, 0], "h2": sig[:, 1]})
-    first_idx = (~df_sig.duplicated()).to_numpy()
-    # hash-bucket duplicates are verified exactly on host (rare path)
-    dup_pos = np.nonzero(~first_idx)[0]
-    if len(dup_pos):
-        host = sub.gather_rows(np.arange(idf.nrows)).to_pandas()
-        exact_first = ~host.duplicated().to_numpy()
-        keep = exact_first
-    else:
-        keep = first_idx
+    # only rows in colliding hash buckets need exact host verification —
+    # rows with unique signatures cannot be duplicates of anything
+    colliding = df_sig.duplicated(keep=False).to_numpy()
+    keep = np.ones(idf.nrows, dtype=bool)
+    coll_rows = np.nonzero(colliding)[0]
+    if len(coll_rows):
+        host = sub.gather_rows(coll_rows).to_pandas()
+        keep[coll_rows] = ~host.duplicated().to_numpy()
     n_unique = int(keep.sum())
     odf = idf.filter_rows(keep) if treatment else idf
     stats = pd.DataFrame(
@@ -178,9 +177,17 @@ def nullColumns_detection(
     if treatment:
         threshold = treatment_configs.get("treatment_threshold", None)
         if treatment_method == "row_removal":
-            M = jnp.stack([idf.columns[c].mask for c in cols], 1)
-            keep = np.asarray(M.all(axis=1))[: idf.nrows]
-            odf = idf.filter_rows(keep)
+            # reference (quality_checker.py:473-484): 100%-missing columns are
+            # excluded from the dropna subset (they would empty the table),
+            # and a threshold restricts the subset to columns above it
+            pct = stats.set_index("attribute")["missing_pct"].astype(float)
+            subset = [c for c in cols if pct.get(c, 0.0) < 1.0]
+            if threshold is not None:
+                subset = [c for c in subset if pct.get(c, 0.0) > float(threshold)]
+            if subset:
+                M = jnp.stack([idf.columns[c].mask for c in subset], 1)
+                keep = np.asarray(M.all(axis=1))[: idf.nrows]
+                odf = idf.filter_rows(keep)
         elif treatment_method == "column_removal":
             if threshold is None:
                 raise TypeError("Invalid input for column removal threshold")
@@ -272,13 +279,20 @@ def outlier_detection(
         lower = np.array([bounds[c][0] if bounds[c][0] is not None else -np.inf for c in cols])
         upper = np.array([bounds[c][1] if bounds[c][1] is not None else np.inf for c in cols])
     else:
-        methodologies = []
-        if "pctile_lower" in cfg or "pctile_upper" in cfg:
-            methodologies.append("pctile")
-        if "stdev_lower" in cfg or "stdev_upper" in cfg:
-            methodologies.append("stdev")
-        if "IQR_lower" in cfg or "IQR_upper" in cfg:
-            methodologies.append("IQR")
+        lower_m = {m for m in ("pctile", "stdev", "IQR") if f"{m}_lower" in cfg}
+        upper_m = {m for m in ("pctile", "stdev", "IQR") if f"{m}_upper" in cfg}
+        if detection_side == "both" and lower_m != upper_m:
+            # reference :809-815 — asymmetric configs would silently produce
+            # a bound equal to the mean/quartile itself (multiplier 0)
+            raise TypeError(
+                "Invalid input for detection_configs: methodologies used on both sides should be the same"
+            )
+        methodologies = sorted(
+            upper_m if detection_side == "upper" else lower_m if detection_side == "lower" else lower_m,
+            key=["pctile", "stdev", "IQR"].index,
+        )
+        if not methodologies:
+            raise TypeError("Invalid input for detection_configs: no methodology specified")
         n_vote = int(cfg.get("min_validation", len(methodologies)))
         if n_vote > len(methodologies):
             raise TypeError("Invalid input for min_validation of detection_configs.")

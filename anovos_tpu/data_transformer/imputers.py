@@ -293,11 +293,11 @@ def auto_imputation(
         probe = probe.with_column(c, Column("num", col.data, M_train[:, i], dtype_name=col.dtype_name))
 
     candidates = {
-        "MMM_mean": lambda t: imputation_MMM(t, list_of_cols=cols, method_type="mean"),
-        "MMM_median": lambda t: imputation_MMM(t, list_of_cols=cols, method_type="median"),
-        "KNN": lambda t: imputation_sklearn(t, list_of_cols=cols, method_type="KNN"),
-        "regression": lambda t: imputation_sklearn(t, list_of_cols=cols, method_type="regression"),
-        "MF": lambda t: imputation_matrixFactorization(t, list_of_cols=cols),
+        "MMM_mean": lambda t, om="replace": imputation_MMM(t, list_of_cols=cols, method_type="mean", output_mode=om),
+        "MMM_median": lambda t, om="replace": imputation_MMM(t, list_of_cols=cols, method_type="median", output_mode=om),
+        "KNN": lambda t, om="replace": imputation_sklearn(t, list_of_cols=cols, method_type="KNN", output_mode=om),
+        "regression": lambda t, om="replace": imputation_sklearn(t, list_of_cols=cols, method_type="regression", output_mode=om),
+        "MF": lambda t, om="replace": imputation_matrixFactorization(t, list_of_cols=cols, output_mode=om),
     }
     col_mean = np.asarray(masked_moments(X, M)["mean"])
     scores: Dict[str, float] = {}
@@ -318,4 +318,4 @@ def auto_imputation(
     best = min(scores, key=scores.get)
     if print_impact:
         print("auto_imputation scores (lower better):", {k: round(v, 4) for k, v in scores.items()}, "→", best)
-    return candidates[best](idf) if output_mode == "replace" else candidates[best](idf)
+    return candidates[best](idf, output_mode)

@@ -41,10 +41,15 @@ __all__ = [
     "IQR_standardization",
     "normalization",
     "imputation_MMM",
+    "imputation_sklearn",
+    "imputation_matrixFactorization",
+    "auto_imputation",
     "feature_transformation",
     "boxcox_transformation",
     "outlier_categories",
     "expression_parser",
+    "autoencoder_latentFeatures",
+    "PCA_latentFeatures",
 ]
 
 
@@ -952,3 +957,17 @@ def expression_parser(idf: Table, list_of_expr, postfix: str = "", print_impact:
     if print_impact:
         print(f"expressions added: {list_of_expr}")
     return odf
+
+
+# model-based imputers and latent-feature transformers live in sibling
+# modules but belong to this namespace for reflection dispatch parity with
+# the reference (workflow.py getattr(transformers, fn))
+from anovos_tpu.data_transformer.imputers import (  # noqa: E402
+    auto_imputation,
+    imputation_matrixFactorization,
+    imputation_sklearn,
+)
+from anovos_tpu.data_transformer.latent_features import (  # noqa: E402
+    PCA_latentFeatures,
+    autoencoder_latentFeatures,
+)

@@ -1,0 +1,89 @@
+"""Validation helpers + CV→SI scoring (reference: drift_stability/validations.py)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def check_distance_method(method_type: str) -> List[str]:
+    """Normalize method_type (reference validations.py:71-94): a name, a
+    pipe-list, or "all"."""
+    all_methods = ["PSI", "HD", "JSD", "KS"]
+    if isinstance(method_type, str):
+        methods = all_methods if method_type == "all" else [m.strip() for m in method_type.split("|")]
+    else:
+        methods = list(method_type)
+    bad = [m for m in methods if m not in all_methods]
+    if bad:
+        raise TypeError(f"Invalid input for method_type: {bad}")
+    return methods
+
+
+def compute_score(value: Optional[float], method_type: str, cv_thresholds=(0.03, 0.1, 0.2, 0.5)):
+    """Map |CV| (or SD for binary) to a 0..4 stability score
+    (reference validations.py:97-126)."""
+    if value is None or value != value:  # None or NaN
+        return None
+    if method_type == "cv":
+        cv = abs(value)
+        for i, thresh in enumerate(cv_thresholds):
+            if cv < thresh:
+                return float([4, 3, 2, 1, 0][i])
+        return 0.0
+    if method_type == "sd":
+        sd = value
+        if sd <= 0.005:
+            return 4.0
+        if sd <= 0.01:
+            return round(-100 * sd + 4.5, 1)
+        if sd <= 0.05:
+            return round(-50 * sd + 4, 1)
+        if sd <= 0.1:
+            return round(-30 * sd + 3, 1)
+        return 0.0
+    raise TypeError("method_type must be either 'cv' or 'sd'.")
+
+
+def compute_si(metric_weightages: dict):
+    """Weighted stability index factory (reference validations.py:129-150)."""
+
+    def compute_si_(attr_type, mean_stddev, mean_cv, stddev_cv, kurtosis_cv):
+        if attr_type == "Binary":
+            mean_si = compute_score(mean_stddev, "sd")
+            return [mean_si, None, None, mean_si]
+        mean_si = compute_score(mean_cv, "cv")
+        stddev_si = compute_score(stddev_cv, "cv")
+        kurtosis_si = compute_score(kurtosis_cv, "cv")
+        if mean_si is None or stddev_si is None or kurtosis_si is None:
+            si = None
+        else:
+            si = round(
+                mean_si * metric_weightages.get("mean", 0)
+                + stddev_si * metric_weightages.get("stddev", 0)
+                + kurtosis_si * metric_weightages.get("kurtosis", 0),
+                4,
+            )
+        return [mean_si, stddev_si, kurtosis_si, si]
+
+    return compute_si_
+
+
+def check_metric_weightages(metric_weightages: dict) -> None:
+    if (
+        round(
+            metric_weightages.get("mean", 0)
+            + metric_weightages.get("stddev", 0)
+            + metric_weightages.get("kurtosis", 0),
+            3,
+        )
+        != 1
+    ):
+        raise ValueError(
+            "Invalid input for metric weightages. Either metric name is incorrect or "
+            "sum of metric weightages is not 1.0."
+        )
+
+
+def check_threshold(threshold) -> None:
+    if (threshold < 0) or (threshold > 4):
+        raise ValueError("Invalid input for metric threshold. It must be a number between 0 and 4.")

@@ -1,0 +1,115 @@
+"""Benchmark: PSI drift wall-time (the BASELINE.json headline metric).
+
+Runs the drift_detector.statistics pipeline — source binning, target binning
+with source cutoffs, per-column frequencies, PSI — over a scaled income
+dataset on the available accelerator, and compares against a faithful
+single-process pandas implementation of the reference's per-column loop
+(drift_detector.py:216-344).  The Spark reference itself cannot run here
+(no JVM in the image; BASELINE.md notes the baseline must be measured), so
+``vs_baseline`` reports speedup over that pandas per-column loop — a
+conservative stand-in for Spark local[*] driver-side compute.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+
+TARGET_ROWS = int(os.environ.get("BENCH_ROWS", 4_000_000))
+BIN_SIZE = 10
+
+
+def load_scaled_income(target_rows: int) -> pd.DataFrame:
+    files = glob.glob("/root/reference/examples/data/income_dataset/parquet/*.parquet")
+    df = pd.concat([pd.read_parquet(f) for f in files], ignore_index=True)
+    df = df.drop(columns=["ifa", "dt_1", "dt_2", "empty", "logfnl"], errors="ignore")
+    reps = max(1, target_rows // len(df))
+    big = pd.concat([df] * reps, ignore_index=True)
+    return big.iloc[:target_rows].copy()
+
+
+def pandas_reference_psi(src: pd.DataFrame, tgt: pd.DataFrame, bin_size: int) -> dict:
+    """The reference algorithm, column at a time (host single-core)."""
+    out = {}
+    for col in src.columns:
+        s, t = src[col], tgt[col]
+        if pd.api.types.is_numeric_dtype(s):
+            lo, hi = s.min(), s.max()
+            cuts = [lo + j * (hi - lo) / bin_size for j in range(1, bin_size)]
+            sb = np.searchsorted(cuts, s.to_numpy(), side="left")
+            tb = np.searchsorted(cuts, t.to_numpy(), side="left")
+            p = np.bincount(sb[~s.isna()], minlength=bin_size) / len(s)
+            q = np.bincount(np.clip(tb[~t.isna()], 0, bin_size - 1), minlength=bin_size) / len(t)
+        else:
+            cats = sorted(set(s.dropna().unique()) | set(t.dropna().unique()))
+            p = s.value_counts(normalize=False).reindex(cats).fillna(0).to_numpy() / len(s)
+            q = t.value_counts(normalize=False).reindex(cats).fillna(0).to_numpy() / len(t)
+        p = np.where(p <= 0, 1e-4, p)
+        q = np.where(q <= 0, 1e-4, q)
+        out[col] = float(((p - q) * np.log(p / q)).sum())
+    return out
+
+
+def main() -> None:
+    df = load_scaled_income(TARGET_ROWS)
+    n = len(df)
+    src_pd = df.iloc[: n // 2].reset_index(drop=True)
+    tgt_pd = df.iloc[n // 2 :].reset_index(drop=True)
+
+    # ---- pandas reference loop (measured baseline) ----------------------
+    t0 = time.perf_counter()
+    ref = pandas_reference_psi(src_pd, tgt_pd, BIN_SIZE)
+    t_ref = time.perf_counter() - t0
+
+    # ---- anovos_tpu ------------------------------------------------------
+    import jax  # noqa: E402  (after env decided by the driver)
+
+    from anovos_tpu.shared import Table, init_runtime
+    from anovos_tpu.drift_stability import statistics
+
+    init_runtime()
+    src = Table.from_pandas(src_pd)
+    tgt = Table.from_pandas(tgt_pd)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        # warmup at IDENTICAL shapes: XLA compiles per shape, and on remote
+        # backends compilation is the dominant one-time cost — the steady-state
+        # number is what the pipeline sees on every subsequent run
+        statistics(tgt, src, method_type="PSI", use_sampling=False,
+                   source_path=os.path.join(d, "warm"), bin_size=BIN_SIZE)
+        t0 = time.perf_counter()
+        odf = statistics(
+            tgt, src, method_type="PSI", use_sampling=False,
+            source_path=os.path.join(d, "run"), bin_size=BIN_SIZE,
+        )
+        t_tpu = time.perf_counter() - t0
+
+    # sanity: PSI values must agree with the reference loop
+    ours = dict(zip(odf["attribute"], odf["PSI"]))
+    for col, v in ref.items():
+        if col in ours and abs(ours[col] - v) > 0.05:
+            print(f"WARNING: PSI mismatch on {col}: {ours[col]} vs {v}", file=sys.stderr)
+
+    rows_per_sec = n / t_tpu
+    print(
+        json.dumps(
+            {
+                "metric": "psi_drift_rows_per_sec",
+                "value": round(rows_per_sec, 1),
+                "unit": f"rows/s ({n} rows, {len(ref)} cols, wall {t_tpu:.3f}s; pandas-loop baseline {t_ref:.3f}s)",
+                "vs_baseline": round(t_ref / t_tpu, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

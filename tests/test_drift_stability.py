@@ -1,0 +1,134 @@
+"""Drift & stability tests: metric formulas vs hand-computed numpy oracles."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from anovos_tpu.drift_stability import (
+    feature_stability_estimation,
+    stability_index_computation,
+    statistics,
+)
+from anovos_tpu.shared.table import Table
+
+
+@pytest.fixture()
+def src_tgt():
+    g = np.random.default_rng(11)
+    n = 20000
+    src = pd.DataFrame(
+        {
+            "stable": g.normal(0, 1, n),
+            "shifted": g.normal(0, 1, n),
+            "cat": g.choice(["a", "b", "c"], n, p=[0.6, 0.3, 0.1]),
+        }
+    )
+    tgt = pd.DataFrame(
+        {
+            "stable": g.normal(0, 1, n),
+            "shifted": g.normal(1.5, 1, n),  # strong covariate shift
+            "cat": g.choice(["a", "b", "c"], n, p=[0.2, 0.3, 0.5]),
+        }
+    )
+    return Table.from_pandas(src), Table.from_pandas(tgt), src, tgt
+
+
+def test_drift_psi_flags_shift(src_tgt, tmp_path):
+    tsrc, ttgt, _, _ = src_tgt
+    out = statistics(
+        ttgt, tsrc, method_type="all", source_path=str(tmp_path / "drift")
+    ).set_index("attribute")
+    assert out.loc["shifted", "PSI"] > 0.5
+    assert out.loc["shifted", "flagged"] == 1
+    assert out.loc["stable", "PSI"] < 0.05
+    assert out.loc["stable", "flagged"] == 0
+    assert out.loc["cat", "PSI"] > 0.1  # category mix changed
+    for m in ("HD", "JSD", "KS"):
+        assert 0 <= out.loc["stable", m] < 0.05
+        assert out.loc["shifted", m] > 0.2
+
+
+def test_drift_psi_formula_parity(src_tgt, tmp_path):
+    """PSI for the cat column against a direct numpy computation with the
+    reference's smoothing (0→0.0001)."""
+    tsrc, ttgt, src, tgt = src_tgt
+    out = statistics(
+        ttgt, tsrc, list_of_cols=["cat"], method_type="PSI", source_path=str(tmp_path / "d2")
+    ).set_index("attribute")
+    p = src["cat"].value_counts(normalize=True).sort_index().to_numpy()
+    q = tgt["cat"].value_counts(normalize=True).sort_index().to_numpy()
+    psi = float(((p - q) * np.log(p / q)).sum())
+    np.testing.assert_allclose(out.loc["cat", "PSI"], psi, atol=2e-4)
+
+
+def test_drift_pre_existing_source(src_tgt, tmp_path):
+    tsrc, ttgt, _, _ = src_tgt
+    sp = str(tmp_path / "drift_model")
+    a = statistics(ttgt, tsrc, method_type="PSI", source_path=sp)
+    b = statistics(ttgt, None, method_type="PSI", pre_existing_source=True, source_path=sp)
+    pd.testing.assert_frame_equal(
+        a.sort_values("attribute").reset_index(drop=True),
+        b.sort_values("attribute").reset_index(drop=True),
+    )
+
+
+def test_stability_index():
+    g = np.random.default_rng(2)
+    idfs = []
+    for t in range(6):
+        idfs.append(
+            Table.from_pandas(
+                pd.DataFrame(
+                    {
+                        "steady": g.normal(100, 5, 2000),
+                        "wandering": g.normal(100 * (1 + 0.5 * t), 5 + 4 * t, 2000),
+                    }
+                )
+            )
+        )
+    out = stability_index_computation(*idfs, threshold=2).set_index("attribute")
+    assert out.loc["steady", "stability_index"] >= 3
+    # mean/stddev wander (scores 0-1) but kurtosis of a normal stays ~3,
+    # contributing 4*0.2 — so the SI lands below 2, not 0
+    assert out.loc["wandering", "stability_index"] < 2
+    assert out.loc["wandering", "flagged"] == 1
+    assert set(out.columns) >= {"type", "mean_cv", "stddev_cv", "kurtosis_cv", "stability_index"}
+
+
+def test_stability_metric_history_append(tmp_path):
+    g = np.random.default_rng(3)
+    mk = lambda: Table.from_pandas(pd.DataFrame({"v": g.normal(0, 1, 500)}))
+    path = str(tmp_path / "hist")
+    stability_index_computation(mk(), mk(), appended_metric_path=path)
+    hist = pd.read_csv(path + "/part-00000.csv")
+    assert len(hist) == 2 and set(hist["idx"]) == {1, 2}
+    # append run: existing + 2 new periods
+    stability_index_computation(
+        mk(), mk(), existing_metric_path=path, appended_metric_path=path
+    )
+    hist2 = pd.read_csv(path + "/part-00000.csv")
+    assert len(hist2) == 4 and hist2["idx"].max() == 4
+
+
+def test_feature_stability_estimation():
+    # two attributes with metric history over 4 periods
+    rows = []
+    for idx in range(1, 5):
+        rows.append({"idx": idx, "attribute": "a", "mean": 10 + idx * 0.01, "stddev": 1.0, "kurtosis": 3.0})
+        rows.append({"idx": idx, "attribute": "b", "mean": 5.0, "stddev": 0.5, "kurtosis": 3.0})
+    stats = pd.DataFrame(rows)
+    out = feature_stability_estimation(stats, {"a|b": "a*b", "a": "a**2"})
+    assert len(out) == 2
+    f = out.set_index("feature_formula")
+    assert f.loc["a*b", "stability_index_lower_bound"] is not None
+    assert f.loc["a*b", "stability_index_upper_bound"] >= f.loc["a*b", "stability_index_lower_bound"]
+    # stable inputs → high stability
+    assert f.loc["a*b", "stability_index_lower_bound"] >= 2
+
+
+def test_weightage_validation():
+    with pytest.raises(ValueError):
+        stability_index_computation(
+            Table.from_pandas(pd.DataFrame({"v": [1.0, 2.0]})),
+            metric_weightages={"mean": 0.9},
+        )

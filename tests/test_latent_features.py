@@ -1,0 +1,79 @@
+"""Autoencoder + PCA latent feature tests."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from anovos_tpu.data_transformer.latent_features import (
+    PCA_latentFeatures,
+    autoencoder_latentFeatures,
+)
+from anovos_tpu.models.autoencoder import AutoEncoder
+from anovos_tpu.shared.table import Table
+import jax.numpy as jnp
+
+
+@pytest.fixture(scope="module")
+def latent_df():
+    """4 observed columns driven by 2 latent factors."""
+    g = np.random.default_rng(21)
+    n = 2000
+    f1, f2 = g.normal(size=n), g.normal(size=n)
+    return pd.DataFrame(
+        {
+            "a": f1 + 0.05 * g.normal(size=n),
+            "b": -f1 + 0.05 * g.normal(size=n),
+            "c": f2 + 0.05 * g.normal(size=n),
+            "d": f2 + f1 + 0.05 * g.normal(size=n),
+        }
+    )
+
+
+def test_autoencoder_trains_and_reconstructs(latent_df):
+    t = Table.from_pandas(latent_df)
+    ae = AutoEncoder(4, 2)
+    from anovos_tpu.data_transformer.latent_features import _prep_block
+
+    X, _, _ = _prep_block(t, ["a", "b", "c", "d"], True, True)
+    Xr = X[: t.nrows]
+    params = ae.fit(Xr, epochs=100, batch_size=256)  # reference default epochs
+    mse = float(jnp.mean((ae.reconstruct(params, Xr) - Xr) ** 2))
+    assert mse < 0.1  # 2 latent dims explain 4 correlated columns
+
+
+def test_autoencoder_latentFeatures_transformer(latent_df):
+    t = Table.from_pandas(latent_df)
+    out = autoencoder_latentFeatures(t, reduction_params=0.5, epochs=20, output_mode="replace")
+    df = out.to_pandas()
+    assert {"latent_0", "latent_1"} <= set(df.columns)
+    assert "a" not in df.columns
+    assert not df["latent_0"].isna().any()
+
+
+def test_autoencoder_model_roundtrip(latent_df, tmp_path):
+    t = Table.from_pandas(latent_df)
+    mp = str(tmp_path / "ae")
+    a = autoencoder_latentFeatures(t, epochs=5, model_path=mp, output_mode="append").to_pandas()
+    b = autoencoder_latentFeatures(
+        t, pre_existing_model=True, model_path=mp, output_mode="append"
+    ).to_pandas()
+    np.testing.assert_allclose(a["latent_0"].to_numpy(), b["latent_0"].to_numpy(), atol=1e-5)
+
+
+def test_pca_latentFeatures(latent_df):
+    t = Table.from_pandas(latent_df)
+    out = PCA_latentFeatures(t, explained_variance_cutoff=0.95, output_mode="replace")
+    df = out.to_pandas()
+    latents = [c for c in df.columns if c.startswith("latent_")]
+    # 2 factors dominate → ≤3 components reach 95%
+    assert 2 <= len(latents) <= 3
+    v = df[latents].var()
+    assert v.iloc[0] >= v.iloc[-1]  # components ordered by variance
+
+
+def test_pca_model_roundtrip(latent_df, tmp_path):
+    t = Table.from_pandas(latent_df)
+    mp = str(tmp_path / "pca")
+    a = PCA_latentFeatures(t, model_path=mp, output_mode="append").to_pandas()
+    b = PCA_latentFeatures(t, pre_existing_model=True, model_path=mp, output_mode="append").to_pandas()
+    np.testing.assert_allclose(a["latent_0"].to_numpy(), b["latent_0"].to_numpy(), atol=1e-4)
