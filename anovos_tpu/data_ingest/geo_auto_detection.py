@@ -1,0 +1,69 @@
+"""Latitude/longitude/geohash column auto-detection
+(reference: data_ingest/geo_auto_detection.py: reg_lat_lon :23, ll_gh_cols
+:177, geo_to_latlong :101).
+
+Detection heuristics: numeric columns whose values fit lat ([-90, 90]) or
+lon ([-180, 180]) ranges with decimal precision and suggestive names;
+categorical columns whose dictionary values are geohash-alphabet strings.
+Value scans ride the dictionary/device stats — no per-row Python.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Tuple
+
+import numpy as np
+
+from anovos_tpu.data_transformer.geo_utils import geohash_decode
+from anovos_tpu.shared.table import Table
+
+_LAT_NAME = re.compile(r"lat", re.I)
+_LON_NAME = re.compile(r"lon|lng", re.I)
+_GH_NAME = re.compile(r"geohash|gh", re.I)
+_GH_VALUE = re.compile(r"^[0123456789bcdefghjkmnpqrstuvwxyz]{4,12}$")
+
+
+def reg_lat_lon(idf: Table, col: str) -> str:
+    """Classify one column as 'lat' / 'lon' / 'geohash' / '' (reference :23-175)."""
+    c = idf.columns[col]
+    if c.kind == "num":
+        vals = np.asarray(c.data)[: idf.nrows].astype(float)
+        mask = np.asarray(c.mask)[: idf.nrows]
+        v = vals[mask]
+        if len(v) == 0:
+            return ""
+        frac = np.abs(v - np.round(v))
+        has_decimals = (frac > 1e-9).mean() > 0.5
+        if not has_decimals:
+            return ""
+        if np.all((v >= -90) & (v <= 90)) and _LAT_NAME.search(col):
+            return "lat"
+        if np.all((v >= -180) & (v <= 180)) and _LON_NAME.search(col):
+            return "lon"
+        return ""
+    if c.kind == "cat" and len(c.vocab):
+        sample = c.vocab[: min(len(c.vocab), 500)]
+        hits = sum(bool(_GH_VALUE.match(str(v))) for v in sample)
+        if hits / len(sample) > 0.9 and (_GH_NAME.search(col) or hits / len(sample) > 0.99):
+            return "geohash"
+    return ""
+
+
+def ll_gh_cols(idf: Table, max_records: int = 100000) -> Tuple[List[str], List[str], List[str]]:
+    """Detect (lat_cols, lon_cols, geohash_cols) (reference :177-298)."""
+    lat_cols, lon_cols, gh_cols = [], [], []
+    for col in idf.col_names:
+        kind = reg_lat_lon(idf, col)
+        if kind == "lat":
+            lat_cols.append(col)
+        elif kind == "lon":
+            lon_cols.append(col)
+        elif kind == "geohash":
+            gh_cols.append(col)
+    return lat_cols, lon_cols, gh_cols
+
+
+def geo_to_latlong(gh: str) -> Tuple[float, float]:
+    """Geohash cell center (reference :101-175)."""
+    return geohash_decode(gh)

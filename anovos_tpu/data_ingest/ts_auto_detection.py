@@ -1,0 +1,146 @@
+"""Timestamp auto-detection (reference: data_ingest/ts_auto_detection.py).
+
+The reference triages candidate columns by dtype and value length ∈
+{4, 6, 8, 10, 13} (``ts_loop_cols_pre`` :554-619), then parses with a
+regex/heuristic battery (``regex_date_time_parser`` :51).  Here the triage is
+the same but parsing rides the column dictionary: each DISTINCT value is
+parsed once on host (pandas' inference + the reference's epoch-length rules)
+and conversion maps back through codes; detection stats persist to
+``ts_cols_stats.csv`` (ref :735).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from anovos_tpu.shared.runtime import get_runtime
+from anovos_tpu.shared.table import Column, Table, _host_to_column
+from anovos_tpu.shared.utils import ends_with
+
+_VALID_LENGTHS = {4, 6, 8, 10, 13}
+_MIN_PARSE_FRACTION = 0.8
+
+
+def _try_parse_values(values: np.ndarray) -> Tuple[Optional[pd.Series], float]:
+    """Parse an array of distinct string/number values to timestamps.
+    Returns (parsed series aligned to input, fraction parsed)."""
+    s = pd.Series(values.astype(str))
+    # epoch seconds (len 10) / millis (len 13) — reference length heuristic
+    lengths = s.str.len()
+    if (lengths == 10).mean() > 0.9 and s.str.fullmatch(r"\d{10}").mean() > 0.9:
+        parsed = pd.to_datetime(pd.to_numeric(s, errors="coerce"), unit="s", errors="coerce")
+        return parsed, float(parsed.notna().mean())
+    if (lengths == 13).mean() > 0.9 and s.str.fullmatch(r"\d{13}").mean() > 0.9:
+        parsed = pd.to_datetime(pd.to_numeric(s, errors="coerce"), unit="ms", errors="coerce")
+        return parsed, float(parsed.notna().mean())
+    if (lengths == 8).mean() > 0.9 and s.str.fullmatch(r"\d{8}").mean() > 0.9:
+        parsed = pd.to_datetime(s, format="%Y%m%d", errors="coerce")
+        return parsed, float(parsed.notna().mean())
+    if (lengths == 6).mean() > 0.9 and s.str.fullmatch(r"\d{6}").mean() > 0.9:
+        parsed = pd.to_datetime(s, format="%y%m%d", errors="coerce")
+        return parsed, float(parsed.notna().mean())
+    with pd.option_context("mode.chained_assignment", None):
+        try:
+            parsed = pd.to_datetime(s, errors="coerce", format="mixed")
+        except (ValueError, TypeError):
+            return None, 0.0
+    return parsed, float(parsed.notna().mean())
+
+
+def ts_loop_cols_pre(idf: Table, id_col: Optional[str] = None) -> List[str]:
+    """Candidate triage (reference :554-619): string columns whose values
+    look date-length-ish, plus int columns with epoch-plausible magnitudes."""
+    candidates = []
+    for c, col in idf.columns.items():
+        if c == id_col:
+            continue
+        if col.kind == "ts":
+            continue
+        if col.kind == "cat":
+            vocab = col.vocab
+            if len(vocab) == 0:
+                continue
+            lengths = {len(str(v)) for v in vocab[: min(len(vocab), 1000)]}
+            if lengths & _VALID_LENGTHS or any(
+                re.search(r"\d{4}-\d{2}-\d{2}", str(v)) for v in vocab[:50]
+            ):
+                candidates.append(c)
+        elif col.kind == "num" and col.dtype_name in ("int", "bigint", "long"):
+            host = np.asarray(col.data)[: min(idf.nrows, 1000)]
+            hmask = np.asarray(col.mask)[: min(idf.nrows, 1000)]
+            vals = host[hmask]  # null cells store 0 — judge valid entries only
+            if len(vals) and np.all((vals >= 1e9) & (vals < 2e9)):
+                candidates.append(c)
+    return candidates
+
+
+def regex_date_time_parser(idf: Table, col: str) -> Tuple[Optional[Column], float]:
+    """Parse one candidate column through its dictionary (cat) or values."""
+    rt = get_runtime()
+    c = idf.columns[col]
+    if c.kind == "cat":
+        parsed, frac = _try_parse_values(c.vocab) if len(c.vocab) else (None, 0.0)
+        if parsed is None or frac < _MIN_PARSE_FRACTION:
+            return None, frac
+        # map vocab → epoch seconds, then gather through the codes
+        # (astype datetime64[s] first — pandas returns ns/us/s units depending
+        # on the parse path, so integer division by 1e9 would be unit-dependent)
+        epoch = parsed.to_numpy().astype("datetime64[s]").astype("int64")
+        valid = parsed.notna().to_numpy()
+        codes = np.asarray(c.data)
+        mask = np.asarray(c.mask)
+        safe = np.clip(codes, 0, len(epoch) - 1)
+        secs = np.where((codes >= 0) & valid[safe], epoch[safe], 0).astype(np.int32)
+        ok = mask & (codes >= 0) & valid[safe]
+        return Column("ts", rt.shard_rows(secs), rt.shard_rows(ok), dtype_name="timestamp"), frac
+    host = np.asarray(c.data)[: idf.nrows]
+    mask = np.asarray(c.mask)[: idf.nrows]
+    parsed, frac = _try_parse_values(host[mask])
+    if parsed is None or frac < _MIN_PARSE_FRACTION:
+        return None, frac
+    secs = np.zeros(idf.padded_rows, np.int32)
+    ok = np.zeros(idf.padded_rows, bool)
+    vals = parsed.to_numpy().astype("datetime64[s]").astype("int64")
+    good = parsed.notna().to_numpy()
+    idxs = np.nonzero(mask)[0]
+    secs[idxs] = np.where(good, vals, 0).astype(np.int32)
+    ok[idxs] = good
+    return Column("ts", rt.shard_rows(secs), rt.shard_rows(ok), dtype_name="timestamp"), frac
+
+
+def ts_preprocess(
+    idf: Table,
+    id_col: Optional[str] = None,
+    output_path: str = ".",
+    tz_offset: str = "local",
+    run_type: str = "local",
+    mlflow_config=None,
+    auth_key: str = "NA",
+    **_ignored,
+) -> Table:
+    """Detect + convert timestamp columns; persist ``ts_cols_stats.csv``
+    (reference :622-761)."""
+    odf = idf
+    rows = []
+    for c in ts_loop_cols_pre(idf, id_col):
+        try:
+            new_col, frac = regex_date_time_parser(idf, c)
+        except Exception:  # detection must never break the pipeline (ref :707)
+            new_col, frac = None, 0.0
+        if new_col is not None:
+            odf = odf.with_column(c, new_col)
+            rows.append({"attribute": c, "parsed_fraction": round(frac, 4), "status": "converted"})
+        else:
+            rows.append({"attribute": c, "parsed_fraction": round(frac, 4), "status": "skipped"})
+    if output_path and output_path != "NA":
+        Path(output_path).mkdir(parents=True, exist_ok=True)
+        pd.DataFrame(rows, columns=["attribute", "parsed_fraction", "status"]).to_csv(
+            ends_with(output_path) + "ts_cols_stats.csv", index=False
+        )
+    return odf

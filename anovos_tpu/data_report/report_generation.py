@@ -1,0 +1,240 @@
+"""Full report generation (reference: data_report/report_generation.py:3984).
+
+Consumes the master_path CSV/JSON contract (files named after analyzer
+functions + ``freqDist_``/``eventDist_``/``drift_`` chart JSONs) and emits a
+single self-contained ``ml_anovos_report.html``.  The reference renders via
+datapane; here the report is a dependency-free HTML document with tabbed
+sections, inline tables, and plotly.js (CDN) hydrating the same chart JSON
+objects the preprocessing step wrote.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from html import escape
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from anovos_tpu.shared.utils import ends_with
+
+# stats files per tab (reference report_generation.py:4111-4136 tab lists)
+_SG_FILES = [
+    "global_summary",
+    "measures_of_counts",
+    "measures_of_centralTendency",
+    "measures_of_cardinality",
+    "measures_of_dispersion",
+    "measures_of_percentiles",
+    "measures_of_shape",
+]
+_QC_FILES = [
+    "duplicate_detection",
+    "nullRows_detection",
+    "nullColumns_detection",
+    "outlier_detection",
+    "IDness_detection",
+    "biasedness_detection",
+    "invalidEntries_detection",
+]
+_AE_FILES = ["correlation_matrix", "IV_calculation", "IG_calculation", "variable_clustering"]
+_DRIFT_FILES = ["drift_statistics", "stability_index", "stabilityIndex_metrics"]
+
+_PLOTLY_CDN = "https://cdn.plot.ly/plotly-2.35.2.min.js"
+
+
+def _json_for_script(obj) -> str:
+    """JSON safe for embedding inside a <script> element: '</' would
+    terminate the script tag (stored-XSS vector via data values)."""
+    return json.dumps(obj).replace("</", "<\\/")
+
+
+def _read_csv(master_path: str, name: str) -> Optional[pd.DataFrame]:
+    p = ends_with(master_path) + name + ".csv"
+    if os.path.exists(p):
+        try:
+            return pd.read_csv(p)
+        except Exception:
+            return None
+    return None
+
+
+def _table_html(df: pd.DataFrame, title: str) -> str:
+    return (
+        f"<h3>{escape(title)}</h3>"
+        + df.head(200).to_html(index=False, classes="stats", border=0, na_rep="")
+    )
+
+
+def _charts_html(master_path: str, prefix: str, title: str, limit: int = 60) -> str:
+    files = sorted(glob.glob(ends_with(master_path) + prefix + "*"))
+    files = [f for f in files if not f.endswith(".csv")]
+    if not files:
+        return ""
+    out = [f"<h3>{escape(title)}</h3><div class='chartgrid'>"]
+    for i, f in enumerate(files[:limit]):
+        try:
+            with open(f) as fh:
+                fig = json.load(fh)
+        except Exception:
+            continue
+        div_id = f"{prefix}{i}"
+        out.append(
+            f"<div class='chart' id='{div_id}'></div>"
+            f"<script>Plotly.newPlot('{div_id}', {_json_for_script(fig['data'])}, "
+            f"{_json_for_script(fig.get('layout', {}))}, {{displayModeBar: false}});</script>"
+        )
+    out.append("</div>")
+    return "".join(out)
+
+
+_CSS = """
+body { font-family: -apple-system, Segoe UI, Helvetica, sans-serif; margin: 0; background: #fafafa; }
+header { background: #1a1a2e; color: white; padding: 18px 28px; }
+nav { display: flex; gap: 4px; background: #16213e; padding: 0 20px; flex-wrap: wrap; }
+nav button { background: none; border: none; color: #bbb; padding: 12px 18px; cursor: pointer; font-size: 14px; }
+nav button.active { color: white; border-bottom: 3px solid #e94560; }
+section { display: none; padding: 24px 32px; }
+section.active { display: block; }
+table.stats { border-collapse: collapse; font-size: 13px; margin-bottom: 18px; background: white; }
+table.stats th { background: #16213e; color: white; padding: 6px 10px; text-align: left; }
+table.stats td { padding: 5px 10px; border-bottom: 1px solid #eee; }
+.chartgrid { display: grid; grid-template-columns: repeat(auto-fill, minmax(420px, 1fr)); gap: 14px; }
+.chart { height: 320px; background: white; border: 1px solid #eee; }
+"""
+
+_JS = """
+function showTab(i) {
+  document.querySelectorAll('nav button').forEach((b, j) => b.classList.toggle('active', i === j));
+  document.querySelectorAll('main section').forEach((s, j) => s.classList.toggle('active', i === j));
+}
+"""
+
+
+def anovos_report(
+    master_path: str = ".",
+    id_col: str = "",
+    label_col: str = "",
+    corr_threshold: float = 0.4,
+    iv_threshold: float = 0.02,
+    drift_threshold_model: float = 0.1,
+    dataDict_path: str = "NA",
+    metricDict_path: str = "NA",
+    final_report_path: str = ".",
+    run_type: str = "local",
+    **_ignored,
+) -> str:
+    """Assemble ``ml_anovos_report.html`` from the master_path contract."""
+    Path(final_report_path).mkdir(parents=True, exist_ok=True)
+    tabs: List[tuple] = []
+
+    # executive summary (reference :524)
+    gs = _read_csv(master_path, "global_summary")
+    exec_html = ""
+    if gs is not None:
+        kv = dict(zip(gs["metric"], gs["value"]))
+        cards = "".join(
+            f"<div style='display:inline-block;background:white;border:1px solid #eee;"
+            f"padding:14px 22px;margin:6px;border-radius:6px'><div style='font-size:22px;"
+            f"font-weight:600'>{escape(str(kv.get(k, '')))}</div><div style='color:#777'>{escape(lbl)}</div></div>"
+            for k, lbl in [
+                ("rows_count", "rows"),
+                ("columns_count", "columns"),
+                ("numcols_count", "numerical"),
+                ("catcols_count", "categorical"),
+            ]
+        )
+        exec_html = cards + _table_html(gs, "global summary")
+        if id_col:
+            exec_html += f"<p>id column: <b>{escape(id_col)}</b>; label column: <b>{escape(label_col)}</b></p>"
+    tabs.append(("Executive Summary", exec_html or "<p>no global summary found</p>"))
+
+    # wiki: data + metric dictionary (reference :909)
+    wiki = ""
+    for path, title in [(dataDict_path, "data dictionary"), (metricDict_path, "metric dictionary")]:
+        if path and path != "NA" and os.path.exists(path):
+            try:
+                wiki += _table_html(pd.read_csv(path), title)
+            except Exception:
+                pass
+    tabs.append(("Wiki", wiki or "<p>no dictionaries configured</p>"))
+
+    # descriptive stats (reference :994)
+    sg_html = "".join(
+        _table_html(df, name) for name in _SG_FILES if (df := _read_csv(master_path, name)) is not None
+    )
+    sg_html += _charts_html(master_path, "freqDist_", "frequency distributions")
+    if label_col:
+        sg_html += _charts_html(master_path, "eventDist_", f"event rates vs {label_col}")
+    tabs.append(("Descriptive Statistics", sg_html or "<p>no stats found</p>"))
+
+    # quality (reference :1154)
+    qc_html = "".join(
+        _table_html(df, name) for name in _QC_FILES if (df := _read_csv(master_path, name)) is not None
+    )
+    qc_html += _charts_html(master_path, "outlier_", "outlier distributions")
+    tabs.append(("Quality Check", qc_html or "<p>no quality stats found</p>"))
+
+    # associations (reference :1291)
+    ae_html = ""
+    corr = _read_csv(master_path, "correlation_matrix")
+    if corr is not None:
+        attrs = list(corr["attribute"])
+        z = corr.drop(columns=["attribute"]).to_numpy(dtype=float).tolist()
+        fig = {
+            "data": [{"type": "heatmap", "z": z, "x": list(corr.columns[1:]), "y": attrs, "colorscale": "RdBu", "zmid": 0}],
+            "layout": {"title": {"text": "correlation matrix"}, "template": "plotly_white"},
+        }
+        ae_html += (
+            "<div class='chart' id='corrheat' style='height:480px'></div>"
+            f"<script>Plotly.newPlot('corrheat', {_json_for_script(fig['data'])}, {_json_for_script(fig['layout'])});</script>"
+        )
+    for name in _AE_FILES[1:]:
+        df = _read_csv(master_path, name)
+        if df is not None:
+            ae_html += _table_html(df, name)
+    tabs.append(("Attribute Associations", ae_html or "<p>no association stats found</p>"))
+
+    # drift & stability (reference :1434)
+    dr_html = "".join(
+        _table_html(df, name) for name in _DRIFT_FILES if (df := _read_csv(master_path, name)) is not None
+    )
+    dr_html += _charts_html(master_path, "drift_", "source vs target distributions")
+    tabs.append(("Drift & Stability", dr_html or "<p>no drift stats found</p>"))
+
+    # time-series + geospatial tabs appear when their stats exist
+    ts_files = sorted(glob.glob(ends_with(master_path) + "ts_*.csv"))
+    if ts_files:
+        ts_html = "".join(
+            _table_html(pd.read_csv(f), os.path.basename(f)[:-4]) for f in ts_files[:12]
+        )
+        tabs.append(("Time Series", ts_html))
+    geo_files = sorted(glob.glob(ends_with(master_path) + "geospatial_*.csv"))
+    if geo_files:
+        geo_html = "".join(
+            _table_html(pd.read_csv(f), os.path.basename(f)[:-4]) for f in geo_files[:12]
+        )
+        tabs.append(("Geospatial", geo_html))
+
+    nav = "".join(
+        f"<button class=\"{'active' if i == 0 else ''}\" onclick='showTab({i})'>{escape(t)}</button>"
+        for i, (t, _) in enumerate(tabs)
+    )
+    sections = "".join(
+        f"<section class=\"{'active' if i == 0 else ''}\">{body}</section>"
+        for i, (_, body) in enumerate(tabs)
+    )
+    html = (
+        "<!DOCTYPE html><html><head><meta charset='utf-8'><title>Anovos-TPU Report</title>"
+        f"<script src='{_PLOTLY_CDN}'></script><style>{_CSS}</style><script>{_JS}</script></head>"
+        "<body><header><h2>Anovos-TPU — Data Report</h2></header>"
+        f"<nav>{nav}</nav><main>{sections}</main></body></html>"
+    )
+    out = ends_with(final_report_path) + "ml_anovos_report.html"
+    with open(out, "w") as f:
+        f.write(html)
+    return out

@@ -1,0 +1,239 @@
+"""Stats persistence + chart-object generation
+(reference: data_report/report_preprocessing.py).
+
+``save_stats`` (ref :40) → ``<master_path>/<function_name>.csv``.
+``charts_to_objects`` (ref :469) → plotly-JSON chart files per column:
+``freqDist_<col>``, ``eventDist_<col>`` (binary label), ``drift_<col>``
+(source vs target frequencies, reusing the drift binning model + persisted
+source frequency CSVs), ``outlier_<col>`` (numeric distribution), plus
+``data_type.csv``.  Chart payloads are plotly figure dicts written as JSON —
+the report embeds them with plotly.js; no plotly python dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from anovos_tpu.ops.drift_kernels import binned_histograms, fit_cutoffs
+from anovos_tpu.ops.quantiles import masked_quantiles
+from anovos_tpu.ops.segment import code_counts
+from anovos_tpu.shared.table import Table
+from anovos_tpu.shared.utils import ends_with, parse_cols
+
+global_theme = "#8000ff"
+global_theme_r = "#ff0055"
+
+
+def save_stats(
+    idf: pd.DataFrame,
+    master_path: str,
+    function_name: str,
+    reread: bool = False,
+    run_type: str = "local",
+    mlflow_config=None,
+    auth_key: str = "NA",
+) -> pd.DataFrame:
+    """Persist a stats frame as ``<master_path>/<function_name>.csv``
+    (reference :40-119; emr/ak8s artifact shuttling not applicable here)."""
+    Path(master_path).mkdir(parents=True, exist_ok=True)
+    idf.to_csv(ends_with(master_path) + function_name + ".csv", index=False)
+    if mlflow_config is not None:
+        try:  # pragma: no cover - optional dependency
+            import mlflow
+
+            mlflow.log_artifact(master_path)
+        except ImportError:
+            pass
+    if reread:
+        return pd.read_csv(ends_with(master_path) + function_name + ".csv")
+    return idf
+
+
+def _bar_fig(x, y, name: str, color: str = global_theme) -> dict:
+    return {
+        "data": [{"type": "bar", "x": list(x), "y": list(y), "name": name, "marker": {"color": color}}],
+        "layout": {"title": {"text": name}, "template": "plotly_white"},
+    }
+
+
+def _grouped_fig(x, series: dict, title: str) -> dict:
+    data = [
+        {"type": "bar", "x": list(x), "y": list(np.asarray(v, dtype=float)), "name": k}
+        for k, v in series.items()
+    ]
+    return {"data": data, "layout": {"title": {"text": title}, "barmode": "group", "template": "plotly_white"}}
+
+
+def _violin_fig(values: np.ndarray, name: str) -> dict:
+    return {
+        "data": [
+            {
+                "type": "violin",
+                "y": [float(v) for v in values],
+                "name": name,
+                "box": {"visible": True},
+                "line": {"color": global_theme},
+            }
+        ],
+        "layout": {"title": {"text": f"outlier distribution: {name}"}, "template": "plotly_white"},
+    }
+
+
+def _write_json(fig: dict, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(fig, f)
+
+
+def charts_to_objects(
+    idf: Table,
+    list_of_cols="all",
+    drop_cols=[],
+    label_col=None,
+    event_label=None,
+    bin_method: str = "equal_frequency",
+    bin_size: int = 10,
+    coverage: float = 1.0,
+    drift_detector: bool = False,
+    source_path: str = "NA",
+    model_directory: str = "drift_statistics",
+    outlier_charts: bool = False,
+    stats_unique: dict = {},
+    master_path: str = ".",
+    run_type: str = "local",
+    auth_key: str = "NA",
+    chart_sample: int = 500000,
+    **_ignored,
+) -> None:
+    """Write per-column chart JSONs + data_type.csv (reference :469-735)."""
+    Path(master_path).mkdir(parents=True, exist_ok=True)
+    num_all, cat_all, _ = idf.attribute_type_segregation()
+    cols = parse_cols(
+        list_of_cols if list_of_cols != "all" else num_all + cat_all, idf.col_names, drop_cols
+    )
+    cols = [c for c in cols if c != label_col]
+    num_cols = [c for c in cols if idf.columns[c].kind == "num"]
+    cat_cols = [c for c in cols if idf.columns[c].kind == "cat"]
+
+    # label event vector (for eventDist charts)
+    y = ym = None
+    if label_col and label_col in idf.columns:
+        from anovos_tpu.data_transformer.transformers import _event_vector
+
+        y, ym = _event_vector(idf, label_col, event_label)
+
+    # drift source frequencies (reuse the persisted drift model when present;
+    # "NA" falls back to the drift detector's default dir, reference :573-574)
+    drift_freqs = {}
+    drift_model_dir = os.path.join(
+        source_path if source_path != "NA" else "intermediate_data", model_directory
+    )
+    if drift_detector and drift_model_dir and os.path.isdir(os.path.join(drift_model_dir, "frequency_counts")):
+        for c in cols:
+            fpath = os.path.join(drift_model_dir, "frequency_counts", c, "part-00000.csv")
+            if os.path.exists(fpath):
+                fdf = pd.read_csv(fpath, dtype=str)
+                drift_freqs[c] = (fdf.iloc[:, 0].astype(str).tolist(), fdf["p"].astype(float).to_numpy())
+
+    # ---- numeric columns: bin once (reuse drift cutoffs when available) ----
+    if num_cols:
+        cut_map = {}
+        if drift_model_dir and os.path.isdir(os.path.join(drift_model_dir, "attribute_binning")):
+            from anovos_tpu.data_transformer.model_io import load_model_df
+
+            dfm = load_model_df(drift_model_dir, "attribute_binning")
+            cut_map = {r["attribute"]: np.asarray(list(r["parameters"]), float) for _, r in dfm.iterrows()}
+        fit_cols = [c for c in num_cols if c not in cut_map]
+        if fit_cols:
+            cuts = np.asarray(
+                fit_cutoffs(
+                    tuple(idf.columns[c].data for c in fit_cols),
+                    tuple(idf.columns[c].mask for c in fit_cols),
+                    bin_size,
+                    bin_method,
+                )
+            )
+            for c, row in zip(fit_cols, cuts):
+                cut_map[c] = row
+        cutoffs = np.stack([cut_map[c] for c in num_cols])
+        X, M = idf.numeric_block(num_cols)
+        counts = np.asarray(binned_histograms(X, M, jnp.asarray(cutoffs, jnp.float32), bin_size))
+        ev_counts = None
+        if y is not None:
+            from anovos_tpu.ops.histogram import masked_bincount
+            from anovos_tpu.ops.drift_kernels import compare_digitize
+
+            bins = compare_digitize(X, jnp.asarray(cutoffs, jnp.float32))
+            Mv = M & ym[:, None]
+            tot = np.asarray(masked_bincount(bins, Mv, bin_size))
+            evs = np.asarray(
+                masked_bincount(bins, Mv & (y[:, None] > 0), bin_size)
+            )
+            ev_counts = (tot, evs)
+        for i, c in enumerate(num_cols):
+            labels = [f"{j + 1}" for j in range(bin_size)]
+            _write_json(_bar_fig(labels, counts[i].tolist(), c), ends_with(master_path) + "freqDist_" + c)
+            if ev_counts is not None:
+                tot, evs = ev_counts
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    rate = np.where(tot[i] > 0, evs[i] / np.maximum(tot[i], 1), 0.0)
+                _write_json(
+                    _bar_fig(labels, rate.tolist(), f"event rate: {c}", global_theme_r),
+                    ends_with(master_path) + "eventDist_" + c,
+                )
+            if c in drift_freqs:
+                skeys, sfreq = drift_freqs[c]
+                tfreq = counts[i] / max(counts[i].sum(), 1)
+                _write_json(
+                    _grouped_fig(skeys, {"source": sfreq, "target": tfreq[: len(skeys)]}, f"drift: {c}"),
+                    ends_with(master_path) + "drift_" + c,
+                )
+            if outlier_charts:
+                vals = np.asarray(idf.columns[c].data)[: idf.nrows].astype(float)
+                mask = np.asarray(idf.columns[c].mask)[: idf.nrows]
+                sample = vals[mask]
+                if len(sample) > chart_sample:
+                    sample = np.random.default_rng(0).choice(sample, chart_sample, replace=False)
+                _write_json(_violin_fig(sample, c), ends_with(master_path) + "outlier_" + c)
+
+    # ---- categorical columns ------------------------------------------------
+    for c in cat_cols:
+        col = idf.columns[c]
+        vsize = max(len(col.vocab), 1)
+        cnts = np.asarray(code_counts(col.data, col.mask, vsize))
+        order = np.argsort(-cnts)
+        cats = [str(col.vocab[j]) for j in order if cnts[j] > 0]
+        vals = [float(cnts[j]) for j in order if cnts[j] > 0]
+        _write_json(_bar_fig(cats, vals, c), ends_with(master_path) + "freqDist_" + c)
+        if y is not None:
+            from anovos_tpu.ops.segment import code_label_counts
+
+            m_eff = col.mask & ym
+            tot = np.asarray(code_label_counts(col.data, m_eff, jnp.ones_like(y), vsize))
+            evs = np.asarray(code_label_counts(col.data, m_eff, y, vsize))
+            with np.errstate(invalid="ignore", divide="ignore"):
+                rate = np.where(tot > 0, evs / np.maximum(tot, 1), 0.0)
+            _write_json(
+                _bar_fig([str(col.vocab[j]) for j in order if cnts[j] > 0],
+                         [float(rate[j]) for j in order if cnts[j] > 0],
+                         f"event rate: {c}", global_theme_r),
+                ends_with(master_path) + "eventDist_" + c,
+            )
+        if c in drift_freqs:
+            skeys, sfreq = drift_freqs[c]
+            tmap = {str(col.vocab[j]): cnts[j] / max(cnts.sum(), 1) for j in range(vsize)}
+            _write_json(
+                _grouped_fig(skeys, {"source": sfreq, "target": [tmap.get(k, 0.0) for k in skeys]}, f"drift: {c}"),
+                ends_with(master_path) + "drift_" + c,
+            )
+
+    # ---- dtype manifest (reference :712) -----------------------------------
+    pd.DataFrame(idf.dtypes(), columns=["attribute", "data_type"]).to_csv(
+        ends_with(master_path) + "data_type.csv", index=False
+    )

@@ -1,0 +1,383 @@
+"""Geospatial transformers (reference: data_transformer/geospatial.py:6-17).
+
+Format conversion (dd/dms/radian/cartesian/geohash), distances, geohash
+precision control, country containment, centroids and radius of gyration.
+Numeric math runs vectorized (host numpy over decoded columns or device
+where natural); geohash strings ride the dictionary like every other
+categorical.  Cites: geo_format_latlon :39, geo_format_cartesian :190,
+geo_format_geohash :333, location_distance :460, geohash_precision_control
+:653, location_in_country :814, centroid :975, weighted_centroid :1099,
+rog_calculation :1223, reverse_geocoding :1335.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List, Optional, Union
+
+import numpy as np
+import pandas as pd
+
+from anovos_tpu.data_transformer import geo_utils
+from anovos_tpu.shared.runtime import get_runtime
+from anovos_tpu.shared.table import Column, Table, _host_to_column
+
+EARTH_RADIUS_M = geo_utils.EARTH_RADIUS_M
+
+
+def _host_num(idf: Table, col: str) -> tuple:
+    c = idf.columns[col]
+    vals = np.asarray(c.data)[: idf.nrows].astype(float)
+    mask = np.asarray(c.mask)[: idf.nrows]
+    vals = np.where(mask, vals, np.nan)
+    return vals, mask
+
+
+def _host_cat(idf: Table, col: str) -> np.ndarray:
+    c = idf.columns[col]
+    codes = np.asarray(c.data)[: idf.nrows]
+    mask = np.asarray(c.mask)[: idf.nrows] & (codes >= 0)
+    out = np.full(idf.nrows, None, dtype=object)
+    out[mask] = c.vocab[codes[mask]]
+    return out
+
+
+def _add_num(idf: Table, name: str, values: np.ndarray) -> Table:
+    rt = get_runtime()
+    return idf.with_column(
+        name, _host_to_column(np.asarray(values, float), idf.nrows, rt.pad_rows(max(idf.nrows, 1)), rt)
+    )
+
+
+def _add_cat(idf: Table, name: str, values: np.ndarray) -> Table:
+    rt = get_runtime()
+    return idf.with_column(
+        name, _host_to_column(np.asarray(values, object), idf.nrows, rt.pad_rows(max(idf.nrows, 1)), rt)
+    )
+
+
+def _dd_to_dms_str(v: np.ndarray) -> np.ndarray:
+    av = np.abs(v)
+    d = np.floor(av)
+    m = np.floor((av - d) * 60)
+    s = (av - d - m / 60) * 3600
+    # explicit sign prefix: int(sg*dd) would lose the '-' for values in
+    # (-1, 0) where the degree part is zero
+    out = np.array(
+        [
+            None
+            if not np.isfinite(x)
+            else f"{'-' if x < 0 else ''}{int(dd)}°{int(mm)}'{ss:.4f}\""
+            for x, dd, mm, ss in zip(v, d, m, s)
+        ],
+        dtype=object,
+    )
+    return out
+
+
+def _dms_str_to_dd(vals: np.ndarray) -> np.ndarray:
+    import re
+
+    out = np.full(len(vals), np.nan)
+    pat = re.compile(r"(-?\d+)[°d:\s]+(\d+)['m:\s]+([\d.]+)")
+    for i, v in enumerate(vals):
+        if v is None:
+            continue
+        sv = str(v).strip()
+        m = pat.search(sv)
+        if m:
+            d, mi, s = float(m.group(1)), float(m.group(2)), float(m.group(3))
+            # sign from the string, not float(d): "-0°30'" parses d as -0.0
+            neg = sv.startswith("-")
+            out[i] = (abs(d) + mi / 60 + s / 3600) * (-1 if neg else 1)
+    return out
+
+
+def geo_format_latlon(
+    idf: Table,
+    list_of_lat: Union[str, List[str]],
+    list_of_lon: Union[str, List[str]],
+    loc_input_format: str = "dd",
+    loc_output_format: str = "dms",
+    result_prefix: str = "",
+    output_mode: str = "append",
+) -> Table:
+    """Convert lat/lon pairs between dd / dms / radian / cartesian / geohash
+    (reference :39-188)."""
+    if isinstance(list_of_lat, str):
+        list_of_lat = [x.strip() for x in list_of_lat.split("|")]
+    if isinstance(list_of_lon, str):
+        list_of_lon = [x.strip() for x in list_of_lon.split("|")]
+    odf = idf
+    for lat_c, lon_c in zip(list_of_lat, list_of_lon):
+        if loc_input_format == "dd":
+            lat, _ = _host_num(idf, lat_c)
+            lon, _ = _host_num(idf, lon_c)
+        elif loc_input_format == "radian":
+            lat, _ = _host_num(idf, lat_c)
+            lon, _ = _host_num(idf, lon_c)
+            lat, lon = np.degrees(lat), np.degrees(lon)
+        elif loc_input_format == "dms":
+            lat = _dms_str_to_dd(_host_cat(idf, lat_c))
+            lon = _dms_str_to_dd(_host_cat(idf, lon_c))
+        else:
+            raise ValueError(f"unsupported loc_input_format {loc_input_format}")
+        pre = (result_prefix + "_") if result_prefix else ""
+        if loc_output_format == "dd":
+            odf = _add_num(odf, f"{pre}{lat_c}_dd", lat)
+            odf = _add_num(odf, f"{pre}{lon_c}_dd", lon)
+        elif loc_output_format == "radian":
+            odf = _add_num(odf, f"{pre}{lat_c}_radian", np.radians(lat))
+            odf = _add_num(odf, f"{pre}{lon_c}_radian", np.radians(lon))
+        elif loc_output_format == "dms":
+            odf = _add_cat(odf, f"{pre}{lat_c}_dms", _dd_to_dms_str(lat))
+            odf = _add_cat(odf, f"{pre}{lon_c}_dms", _dd_to_dms_str(lon))
+        elif loc_output_format == "cartesian":
+            latr, lonr = np.radians(lat), np.radians(lon)
+            odf = _add_num(odf, f"{pre}{lat_c}_{lon_c}_x", EARTH_RADIUS_M * np.cos(latr) * np.cos(lonr))
+            odf = _add_num(odf, f"{pre}{lat_c}_{lon_c}_y", EARTH_RADIUS_M * np.cos(latr) * np.sin(lonr))
+            odf = _add_num(odf, f"{pre}{lat_c}_{lon_c}_z", EARTH_RADIUS_M * np.sin(latr))
+        elif loc_output_format == "geohash":
+            gh = np.array(
+                [
+                    None if not (np.isfinite(a) and np.isfinite(o)) else geo_utils.geohash_encode(a, o, 9)
+                    for a, o in zip(lat, lon)
+                ],
+                dtype=object,
+            )
+            odf = _add_cat(odf, f"{pre}{lat_c}_{lon_c}_geohash", gh)
+        else:
+            raise ValueError(f"unsupported loc_output_format {loc_output_format}")
+        if output_mode == "replace":
+            odf = odf.drop([lat_c, lon_c])
+    return odf
+
+
+def geo_format_cartesian(
+    idf: Table, list_of_x, list_of_y, list_of_z, loc_output_format: str = "dd", result_prefix: str = "", **_ignored
+) -> Table:
+    """Cartesian → dd/radian/geohash (reference :190-331)."""
+    if isinstance(list_of_x, str):
+        list_of_x = [v.strip() for v in list_of_x.split("|")]
+    if isinstance(list_of_y, str):
+        list_of_y = [v.strip() for v in list_of_y.split("|")]
+    if isinstance(list_of_z, str):
+        list_of_z = [v.strip() for v in list_of_z.split("|")]
+    odf = idf
+    for xc, yc, zc in zip(list_of_x, list_of_y, list_of_z):
+        x, _ = _host_num(idf, xc)
+        y, _ = _host_num(idf, yc)
+        z, _ = _host_num(idf, zc)
+        lat = np.degrees(np.arcsin(np.clip(z / EARTH_RADIUS_M, -1, 1)))
+        lon = np.degrees(np.arctan2(y, x))
+        pre = (result_prefix + "_") if result_prefix else ""
+        if loc_output_format == "dd":
+            odf = _add_num(odf, f"{pre}{xc}_{yc}_{zc}_lat", lat)
+            odf = _add_num(odf, f"{pre}{xc}_{yc}_{zc}_lon", lon)
+        elif loc_output_format == "radian":
+            odf = _add_num(odf, f"{pre}{xc}_{yc}_{zc}_lat_radian", np.radians(lat))
+            odf = _add_num(odf, f"{pre}{xc}_{yc}_{zc}_lon_radian", np.radians(lon))
+        elif loc_output_format == "geohash":
+            gh = np.array(
+                [
+                    None if not (np.isfinite(a) and np.isfinite(o)) else geo_utils.geohash_encode(a, o, 9)
+                    for a, o in zip(lat, lon)
+                ],
+                dtype=object,
+            )
+            odf = _add_cat(odf, f"{pre}{xc}_{yc}_{zc}_geohash", gh)
+        else:
+            raise ValueError(f"unsupported loc_output_format {loc_output_format}")
+    return odf
+
+
+def geo_format_geohash(
+    idf: Table, list_of_geohash, loc_output_format: str = "dd", result_prefix: str = "", **_ignored
+) -> Table:
+    """Geohash → lat/lon (decode once per distinct hash via the dictionary;
+    reference :333-458)."""
+    if isinstance(list_of_geohash, str):
+        list_of_geohash = [v.strip() for v in list_of_geohash.split("|")]
+    odf = idf
+    for c in list_of_geohash:
+        col = idf.columns[c]
+        decoded = np.array(
+            [geo_utils.geohash_decode(str(v)) if v else (np.nan, np.nan) for v in col.vocab]
+        )
+        codes = np.asarray(col.data)[: idf.nrows]
+        mask = np.asarray(col.mask)[: idf.nrows] & (codes >= 0)
+        lat = np.full(idf.nrows, np.nan)
+        lon = np.full(idf.nrows, np.nan)
+        if len(decoded):
+            lat[mask] = decoded[codes[mask], 0]
+            lon[mask] = decoded[codes[mask], 1]
+        pre = (result_prefix + "_") if result_prefix else ""
+        if loc_output_format == "radian":
+            lat, lon = np.radians(lat), np.radians(lon)
+        odf = _add_num(odf, f"{pre}{c}_latitude", lat)
+        odf = _add_num(odf, f"{pre}{c}_longitude", lon)
+    return odf
+
+
+def location_distance(
+    idf: Table,
+    list_of_lat,
+    list_of_lon,
+    distance_type: str = "haversine",
+    unit: str = "m",
+    result_prefix: str = "",
+    **_ignored,
+) -> Table:
+    """Pairwise distance between two lat/lon column pairs
+    (reference :460-651; haversine/vincenty/euclidean in geo_utils)."""
+    if isinstance(list_of_lat, str):
+        list_of_lat = [v.strip() for v in list_of_lat.split("|")]
+    if isinstance(list_of_lon, str):
+        list_of_lon = [v.strip() for v in list_of_lon.split("|")]
+    if len(list_of_lat) != 2 or len(list_of_lon) != 2:
+        raise ValueError("location_distance expects exactly two lat and two lon columns")
+    lat1, _ = _host_num(idf, list_of_lat[0])
+    lat2, _ = _host_num(idf, list_of_lat[1])
+    lon1, _ = _host_num(idf, list_of_lon[0])
+    lon2, _ = _host_num(idf, list_of_lon[1])
+    fn = {
+        "haversine": geo_utils.haversine_distance,
+        "vincenty": geo_utils.vincenty_distance,
+        "euclidean": geo_utils.euclidean_distance,
+    }.get(distance_type)
+    if fn is None:
+        raise ValueError(f"unsupported distance_type {distance_type}")
+    d = fn(lat1, lon1, lat2, lon2, unit=unit)
+    pre = (result_prefix + "_") if result_prefix else ""
+    return _add_num(idf, f"{pre}distance_{distance_type}", d)
+
+
+def geohash_precision_control(
+    idf: Table, list_of_geohash, km_max_error: float = 10.0, output_mode: str = "replace", **_ignored
+) -> Table:
+    """Truncate geohashes to the precision bounding the error radius
+    (reference :653-812; the standard precision→error table)."""
+    if isinstance(list_of_geohash, str):
+        list_of_geohash = [v.strip() for v in list_of_geohash.split("|")]
+    err_km = [2500, 630, 78, 20, 2.4, 0.61, 0.076, 0.019, 0.0024, 0.0006, 0.000074]
+    precision = next((i + 1 for i, e in enumerate(err_km) if e <= km_max_error), len(err_km))
+    odf = idf
+    for c in list_of_geohash:
+        vals = _host_cat(idf, c)
+        trunc = np.array([None if v is None else str(v)[:precision] for v in vals], dtype=object)
+        name = c if output_mode == "replace" else c + "_precision"
+        odf = _add_cat(odf, name, trunc)
+    return odf
+
+
+def location_in_country(
+    idf: Table,
+    list_of_lat,
+    list_of_lon,
+    country: str = "US",
+    country_shapefile_path: Optional[str] = None,
+    method_type: str = "approx",
+    result_prefix: str = "",
+    **_ignored,
+) -> Table:
+    """Flag rows inside a country (reference :814-973): "approx" uses the
+    bounding-box table; "exact" ray-casts against a geojson polygon file."""
+    if isinstance(list_of_lat, str):
+        list_of_lat = [v.strip() for v in list_of_lat.split("|")]
+    if isinstance(list_of_lon, str):
+        list_of_lon = [v.strip() for v in list_of_lon.split("|")]
+    odf = idf
+    for lat_c, lon_c in zip(list_of_lat, list_of_lon):
+        lat, _ = _host_num(idf, lat_c)
+        lon, _ = _host_num(idf, lon_c)
+        if method_type == "approx" or not country_shapefile_path:
+            inside = geo_utils.point_in_country_approx(lat, lon, country)
+        else:
+            inside = geo_utils.point_in_geojson(lat, lon, country_shapefile_path)
+        pre = (result_prefix + "_") if result_prefix else ""
+        odf = _add_num(odf, f"{pre}{lat_c}_{lon_c}_in_{country}", inside.astype(float))
+    return odf
+
+
+def centroid(idf: Table, lat_col: str, long_col: str, id_col: Optional[str] = None) -> pd.DataFrame:
+    """Per-id (or global) centroid via cartesian mean (reference :975-1097).
+    Returns a small host frame [id?, <lat>_centroid, <long>_centroid]."""
+    lat, _ = _host_num(idf, lat_col)
+    lon, _ = _host_num(idf, long_col)
+    latr, lonr = np.radians(lat), np.radians(lon)
+    x, y, z = np.cos(latr) * np.cos(lonr), np.cos(latr) * np.sin(lonr), np.sin(latr)
+    df = pd.DataFrame({"x": x, "y": y, "z": z})
+    if id_col:
+        df[id_col] = _host_cat(idf, id_col) if idf.columns[id_col].kind == "cat" else _host_num(idf, id_col)[0]
+        g = df.groupby(id_col, dropna=True)[["x", "y", "z"]].mean()
+    else:
+        g = df[["x", "y", "z"]].mean().to_frame().T
+    clat = np.degrees(np.arctan2(g["z"], np.hypot(g["x"], g["y"])))
+    clon = np.degrees(np.arctan2(g["y"], g["x"]))
+    out = pd.DataFrame({lat_col + "_centroid": clat.round(6), long_col + "_centroid": clon.round(6)})
+    if id_col:
+        out.insert(0, id_col, g.index)
+    return out.reset_index(drop=True)
+
+
+def weighted_centroid(
+    idf: Table, lat_col: str, long_col: str, id_col: str, weight_col: str
+) -> pd.DataFrame:
+    """Weight-averaged centroid (reference :1099-1221)."""
+    lat, _ = _host_num(idf, lat_col)
+    lon, _ = _host_num(idf, long_col)
+    w, _ = _host_num(idf, weight_col)
+    latr, lonr = np.radians(lat), np.radians(lon)
+    df = pd.DataFrame(
+        {
+            "x": np.cos(latr) * np.cos(lonr) * w,
+            "y": np.cos(latr) * np.sin(lonr) * w,
+            "z": np.sin(latr) * w,
+            "w": w,
+            id_col: _host_cat(idf, id_col) if idf.columns[id_col].kind == "cat" else _host_num(idf, id_col)[0],
+        }
+    )
+    g = df.groupby(id_col, dropna=True)[["x", "y", "z", "w"]].sum()
+    clat = np.degrees(np.arctan2(g["z"] / g["w"], np.hypot(g["x"] / g["w"], g["y"] / g["w"])))
+    clon = np.degrees(np.arctan2(g["y"] / g["w"], g["x"] / g["w"]))
+    out = pd.DataFrame(
+        {id_col: g.index, lat_col + "_weighted_centroid": clat.round(6), long_col + "_weighted_centroid": clon.round(6)}
+    )
+    return out.reset_index(drop=True)
+
+
+def rog_calculation(idf: Table, lat_col: str, long_col: str, id_col: str) -> pd.DataFrame:
+    """Radius of gyration per id: RMS haversine distance to the centroid
+    (reference :1223-1333)."""
+    cent = centroid(idf, lat_col, long_col, id_col).set_index(id_col)
+    lat, _ = _host_num(idf, lat_col)
+    lon, _ = _host_num(idf, long_col)
+    ids = _host_cat(idf, id_col) if idf.columns[id_col].kind == "cat" else _host_num(idf, id_col)[0]
+    df = pd.DataFrame({"lat": lat, "lon": lon, id_col: ids}).dropna()
+    rows = []
+    for gid, sub in df.groupby(id_col):
+        clat = cent.loc[gid, lat_col + "_centroid"]
+        clon = cent.loc[gid, long_col + "_centroid"]
+        d = geo_utils.haversine_distance(sub["lat"], sub["lon"], clat, clon)
+        rows.append({id_col: gid, "rog": float(np.sqrt(np.mean(d**2)))})
+    return pd.DataFrame(rows)
+
+
+def reverse_geocoding(idf: Table, lat_col: str, long_col: str, **_ignored) -> pd.DataFrame:
+    """Nearest-city lookup (reference :1335-1409 uses the offline
+    reverse_geocoder package).  Not bundled here — raises with guidance."""
+    try:  # pragma: no cover - optional dependency
+        import reverse_geocoder as rg
+    except ImportError as e:
+        raise ImportError(
+            "reverse_geocoding requires the optional 'reverse_geocoder' package "
+            "(offline city database); install it to enable this function"
+        ) from e
+    lat, _ = _host_num(idf, lat_col)
+    lon, _ = _host_num(idf, long_col)
+    ok = np.isfinite(lat) & np.isfinite(lon)
+    results = rg.search(list(zip(lat[ok], lon[ok])))
+    out = pd.DataFrame(results)
+    out.insert(0, lat_col, lat[ok])
+    out.insert(1, long_col, lon[ok])
+    return out

@@ -1,0 +1,120 @@
+"""Recommender bootstrap (reference: feature_recommender/featrec_init.py).
+
+Lazy embedding-model singleton (ref ``_TransformerModel`` :42-59) with an
+offline TF-IDF fallback, corpus loading, and the shared text-prep helpers
+(camel-case splitting :114, column-name cleanup :83).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional
+
+import numpy as np
+import pandas as pd
+
+# the corpus ships with the package (reference packages the same CSV under
+# feature_recommender/data); FR_CORPUS_PATH overrides for custom corpora
+_DEFAULT_CORPUS_PATHS = [
+    os.environ.get("FR_CORPUS_PATH", ""),
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "data", "flatten_fr_db.csv"),
+]
+
+_MODEL = None
+_VECTORIZER = None
+
+
+class _EmbeddingModel:
+    """sentence-transformers when available offline; TF-IDF otherwise."""
+
+    def __init__(self):
+        self.backend = "tfidf"
+        self.model = None
+        try:  # pragma: no cover - requires downloaded weights
+            from sentence_transformers import SentenceTransformer
+
+            self.model = SentenceTransformer(detect_model_path())
+            self.backend = "sentence-transformers"
+        except Exception:
+            from sklearn.feature_extraction.text import TfidfVectorizer
+
+            self.model = TfidfVectorizer(
+                analyzer="char_wb", ngram_range=(2, 4), min_df=1, sublinear_tf=True
+            )
+            self._fitted = False
+
+    def fit_corpus(self, texts: List[str]) -> None:
+        if self.backend == "tfidf":
+            self.model.fit(texts)
+            self._fitted = True
+
+    def encode(self, texts: List[str]) -> np.ndarray:
+        if self.backend == "sentence-transformers":  # pragma: no cover
+            return np.asarray(self.model.encode(texts))
+        if not getattr(self, "_fitted", False):
+            self.fit_corpus(texts)
+        return np.asarray(self.model.transform(texts).todense())
+
+
+def detect_model_path() -> str:
+    """Reference :11-34: env override, else the default model name."""
+    return os.environ.get("FR_MODEL_PATH", "all-mpnet-base-v2")
+
+
+def model_download() -> None:  # pragma: no cover - network-dependent
+    """Eager model fetch (reference :36-59)."""
+    get_model()
+
+
+def get_model() -> _EmbeddingModel:
+    global _MODEL
+    if _MODEL is None:
+        _MODEL = _EmbeddingModel()
+    return _MODEL
+
+
+def load_corpus(corpus_path: Optional[str] = None) -> pd.DataFrame:
+    paths = [corpus_path] if corpus_path else _DEFAULT_CORPUS_PATHS
+    for p in paths:
+        if p and os.path.exists(p):
+            df = pd.read_csv(p)
+            df.columns = [c.strip() for c in df.columns]
+            return df
+    raise FileNotFoundError(
+        "feature recommender corpus not found; pass corpus_path or place flatten_fr_db.csv under feature_recommender/data/"
+    )
+
+
+def camel_case_split(identifier: str) -> str:
+    """Reference :114-131: CamelCase → spaced words."""
+    matches = re.finditer(r".+?(?:(?<=[a-z])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])|$)", str(identifier))
+    return " ".join(m.group(0) for m in matches)
+
+
+def get_column_name(df: pd.DataFrame):
+    """Reference :83-112: resolve the corpus column names."""
+    cols = list(df.columns)
+    name = cols[0]
+    desc = cols[1] if len(cols) > 1 else cols[0]
+    industry = next((c for c in cols if c.lower() == "industry"), cols[-2])
+    usecase = next((c for c in cols if c.lower() == "usecase"), cols[-1])
+    return name, desc, industry, usecase
+
+
+def recommendation_data_prep(df: pd.DataFrame, name_col: str, desc_col: Optional[str]) -> List[str]:
+    """Reference :133-180: cleaned text for embedding (name + description)."""
+    texts = []
+    for _, row in df.iterrows():
+        name = camel_case_split(str(row[name_col])).replace("_", " ").replace("-", " ")
+        if desc_col and desc_col in df.columns and pd.notna(row.get(desc_col)):
+            texts.append((name + " " + str(row[desc_col])).lower().strip())
+        else:
+            texts.append(name.lower().strip())
+    return texts
+
+
+def cosine_sim_matrix(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    An = A / np.maximum(np.linalg.norm(A, axis=1, keepdims=True), 1e-30)
+    Bn = B / np.maximum(np.linalg.norm(B, axis=1, keepdims=True), 1e-30)
+    return An @ Bn.T

@@ -1,0 +1,141 @@
+"""Attribute → corpus-feature mapping (reference: feature_recommender/feature_mapper.py).
+
+``feature_mapper`` (ref :35): embed the user's attribute names/descriptions
+and the corpus, rank matches by cosine similarity.  ``find_attr_by_relevance``
+(ref :322): the reverse direction — given target feature descriptions, find
+the user attributes most relevant to each.  ``sankey_visualization`` (ref
+:465) emits the plotly sankey JSON dict.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import numpy as np
+import pandas as pd
+
+from anovos_tpu.feature_recommender.featrec_init import (
+    cosine_sim_matrix,
+    get_column_name,
+    get_model,
+    load_corpus,
+    recommendation_data_prep,
+)
+
+
+def _prep_user_frame(attr_names, attr_descriptions) -> pd.DataFrame:
+    if isinstance(attr_names, dict):
+        return pd.DataFrame(
+            {"Attribute Name": list(attr_names.keys()), "Attribute Description": list(attr_names.values())}
+        )
+    if attr_descriptions is None:
+        attr_descriptions = [""] * len(attr_names)
+    return pd.DataFrame({"Attribute Name": attr_names, "Attribute Description": attr_descriptions})
+
+
+def feature_mapper(
+    attr_names: Union[dict, List[str]],
+    attr_descriptions: Optional[List[str]] = None,
+    industry: Optional[str] = None,
+    usecase: Optional[str] = None,
+    top_n: int = 2,
+    threshold: float = 0.3,
+    corpus_path: Optional[str] = None,
+) -> pd.DataFrame:
+    """[Attribute Name, Feature Name, Feature Description, Industry, Usecase,
+    Similarity Score] — top_n corpus features per user attribute."""
+    corpus = load_corpus(corpus_path)
+    name, desc, ind, uc = get_column_name(corpus)
+    if industry:
+        corpus = corpus[corpus[ind].str.lower() == industry.lower()]
+    if usecase:
+        corpus = corpus[corpus[uc].str.lower() == usecase.lower()]
+    corpus = corpus.reset_index(drop=True)
+    user = _prep_user_frame(attr_names, attr_descriptions)
+    corpus_texts = recommendation_data_prep(corpus, name, desc)
+    user_texts = recommendation_data_prep(
+        user.rename(columns={"Attribute Name": name, "Attribute Description": desc}), name, desc
+    )
+    model = get_model()
+    model.fit_corpus(corpus_texts + user_texts)
+    S = cosine_sim_matrix(model.encode(user_texts), model.encode(corpus_texts))
+    rows = []
+    for i, attr in enumerate(user["Attribute Name"]):
+        order = np.argsort(-S[i])[:top_n]
+        for j in order:
+            score = float(S[i, j])
+            if score < threshold:
+                continue
+            rows.append(
+                {
+                    "Attribute Name": attr,
+                    "Feature Name": corpus.iloc[j][name],
+                    "Feature Description": corpus.iloc[j][desc],
+                    "Industry": corpus.iloc[j][ind],
+                    "Usecase": corpus.iloc[j][uc],
+                    "Similarity Score": round(score, 4),
+                }
+            )
+    return pd.DataFrame(
+        rows,
+        columns=["Attribute Name", "Feature Name", "Feature Description", "Industry", "Usecase", "Similarity Score"],
+    )
+
+
+def find_attr_by_relevance(
+    attr_names: Union[dict, List[str]],
+    building_corpus: List[str],
+    attr_descriptions: Optional[List[str]] = None,
+    threshold: float = 0.3,
+    corpus_path: Optional[str] = None,
+) -> pd.DataFrame:
+    """Rank user attributes against target feature descriptions (ref :322)."""
+    user = _prep_user_frame(attr_names, attr_descriptions)
+    user_texts = [
+        f"{n} {d}".lower().strip()
+        for n, d in zip(user["Attribute Name"], user["Attribute Description"])
+    ]
+    model = get_model()
+    model.fit_corpus(user_texts + [str(b).lower() for b in building_corpus])
+    S = cosine_sim_matrix(
+        model.encode([str(b).lower() for b in building_corpus]), model.encode(user_texts)
+    )
+    rows = []
+    for i, target in enumerate(building_corpus):
+        for j in np.argsort(-S[i]):
+            score = float(S[i, j])
+            if score < threshold:
+                continue
+            rows.append(
+                {
+                    "Input Feature Desc": target,
+                    "Recommended Input Attribute": user["Attribute Name"].iloc[j],
+                    "Input Attribute Similarity Score": round(score, 4),
+                }
+            )
+    return pd.DataFrame(
+        rows, columns=["Input Feature Desc", "Recommended Input Attribute", "Input Attribute Similarity Score"]
+    )
+
+
+def sankey_visualization(mapping_df: pd.DataFrame) -> dict:
+    """Plotly sankey JSON of attribute→feature links (ref :465-560)."""
+    attrs = list(dict.fromkeys(mapping_df["Attribute Name"]))
+    feats = list(dict.fromkeys(mapping_df["Feature Name"]))
+    labels = attrs + feats
+    src = [attrs.index(a) for a in mapping_df["Attribute Name"]]
+    tgt = [len(attrs) + feats.index(f) for f in mapping_df["Feature Name"]]
+    return {
+        "data": [
+            {
+                "type": "sankey",
+                "node": {"label": labels, "pad": 12},
+                "link": {
+                    "source": src,
+                    "target": tgt,
+                    "value": [float(v) for v in mapping_df["Similarity Score"]],
+                },
+            }
+        ],
+        "layout": {"title": {"text": "attribute → feature mapping"}},
+    }

@@ -1,0 +1,125 @@
+"""Clustering kernels: KMeans (jitted Lloyd) + DBSCAN via tiled distances.
+
+Replaces sklearn MiniBatchKMeans / DBSCAN in the geospatial analyzer
+(reference geospatial_analyzer.py:26-33, :390-733): Lloyd iterations are one
+``lax.fori_loop`` of MXU distance matmuls; DBSCAN neighbor counts come from
+the same tiled distance computation (core-point expansion on host over the
+sparse neighbor lists — the dense part is the O(n²) distance work).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k", "iters"))
+def kmeans_fit(X: jax.Array, k: int, iters: int = 50, seed: int = 0) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Lloyd's algorithm.  X: (n, d) → (centers (k, d), labels (n,), inertia)."""
+    n, d = X.shape
+    key = jax.random.PRNGKey(seed)
+    init_idx = jax.random.choice(key, n, (k,), replace=False)
+    centers0 = X[init_idx]
+
+    def dists(C):
+        # (n, k) squared distances via matmul expansion (MXU)
+        return (
+            (X**2).sum(1, keepdims=True) - 2 * X @ C.T + (C**2).sum(1)[None, :]
+        )
+
+    def body(_, C):
+        D = dists(C)
+        lbl = jnp.argmin(D, axis=1)
+        onehot = jax.nn.one_hot(lbl, k, dtype=X.dtype)  # (n, k)
+        counts = onehot.sum(0)
+        sums = onehot.T @ X  # (k, d)
+        return jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1), C)
+
+    centers = jax.lax.fori_loop(0, iters, body, centers0)
+    D = dists(centers)
+    labels = jnp.argmin(D, axis=1)
+    inertia = jnp.take_along_axis(D, labels[:, None], axis=1).sum()
+    return centers, labels, jnp.maximum(inertia, 0.0)
+
+
+def kmeans_elbow(X: np.ndarray, max_k: int = 20, seed: int = 0) -> Tuple[int, np.ndarray]:
+    """Pick k by the knee of the inertia curve (reference's elbow method)."""
+    Xd = jnp.asarray(X, jnp.float32)
+    inertias = []
+    ks = list(range(1, max(2, max_k) + 1))
+    for k in ks:
+        _, _, inert = kmeans_fit(Xd, k)
+        inertias.append(float(inert))
+    inertias = np.array(inertias)
+    if len(inertias) < 3:
+        return ks[-1], inertias
+    # knee: max distance from the line joining the first and last points
+    x = np.array(ks, float)
+    y = inertias / max(inertias[0], 1e-30)
+    x0, y0, x1, y1 = x[0], y[0], x[-1], y[-1]
+    denom = np.hypot(x1 - x0, y1 - y0)
+    dist = np.abs((y1 - y0) * x - (x1 - x0) * y + x1 * y0 - y1 * x0) / max(denom, 1e-30)
+    return int(x[np.argmax(dist)]), inertias
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _neighbor_counts_tile(Xq: jax.Array, Xs: jax.Array, eps2: jax.Array) -> jax.Array:
+    D = (Xq**2).sum(1, keepdims=True) - 2 * Xq @ Xs.T + (Xs**2).sum(1)[None, :]
+    return (D <= eps2).sum(axis=1)
+
+
+def dbscan_fit(X: np.ndarray, eps: float, min_samples: int, tile: int = 4096) -> np.ndarray:
+    """DBSCAN labels (−1 = noise).  Neighbor counting runs on device in
+    tiles; the union-find expansion over core points runs on host."""
+    n = len(X)
+    Xd = jnp.asarray(X, jnp.float32)
+    eps2 = jnp.asarray(eps * eps, jnp.float32)
+    counts = np.concatenate(
+        [np.asarray(_neighbor_counts_tile(Xd[s : s + tile], Xd, eps2)) for s in range(0, n, tile)]
+    )
+    core = counts >= min_samples
+    labels = np.full(n, -1, np.int64)
+    # union-find over core points linked within eps (host; n² in tiles)
+    parent = np.arange(n)
+
+    def find(i):
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    for s in range(0, n, tile):
+        D = np.asarray(
+            (Xd[s : s + tile] ** 2).sum(1, keepdims=True) - 2 * Xd[s : s + tile] @ Xd.T + (Xd**2).sum(1)[None, :]
+        )
+        within = D <= float(eps2)
+        for li, i in enumerate(range(s, min(s + tile, n))):
+            if not core[i]:
+                continue
+            for j in np.nonzero(within[li] & core)[0]:
+                ri, rj = find(i), find(int(j))
+                if ri != rj:
+                    parent[rj] = ri
+    roots = {}
+    for i in range(n):
+        if core[i]:
+            r = find(i)
+            if r not in roots:
+                roots[r] = len(roots)
+            labels[i] = roots[r]
+    # border points adopt the cluster of any core neighbor
+    for s in range(0, n, tile):
+        D = np.asarray(
+            (Xd[s : s + tile] ** 2).sum(1, keepdims=True) - 2 * Xd[s : s + tile] @ Xd.T + (Xd**2).sum(1)[None, :]
+        )
+        within = D <= float(eps2)
+        for li, i in enumerate(range(s, min(s + tile, n))):
+            if labels[i] == -1 and counts[i] > 0:
+                nbr_core = np.nonzero(within[li] & core)[0]
+                if len(nbr_core):
+                    labels[i] = labels[nbr_core[0]]
+    return labels

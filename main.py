@@ -1,0 +1,14 @@
+"""CLI entry (reference: src/main/main.py:6-13):
+``python main.py <config.yaml> <run_type> [auth_key]``."""
+
+import sys
+
+from anovos_tpu import workflow
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        sys.exit("usage: python main.py <config.yaml> [run_type] [auth_key]")
+    config_path = sys.argv[1]
+    run_type = sys.argv[2] if len(sys.argv) > 2 else "local"
+    auth_key_val = {"auth_key": sys.argv[3]} if len(sys.argv) > 3 else {}
+    workflow.run(config_path, run_type, auth_key_val)

@@ -1,0 +1,205 @@
+"""Tests for ts/geo analyzers, datetime + geospatial transformers,
+feature recommender, feast exporter."""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from anovos_tpu.shared.table import Table
+
+
+@pytest.fixture(scope="module")
+def ts_table():
+    g = np.random.default_rng(0)
+    n = 1000
+    base = pd.Timestamp("2023-01-01")
+    ts = base + pd.to_timedelta(g.integers(0, 365 * 24 * 3600, n), unit="s")
+    return Table.from_pandas(
+        pd.DataFrame(
+            {
+                "ts": ts,
+                "ts_str": ts.strftime("%Y-%m-%d %H:%M:%S"),
+                "val": g.normal(10, 2, n),
+                "id": g.choice(["u1", "u2", "u3"], n),
+            }
+        )
+    )
+
+
+def test_ts_auto_detection(ts_table, tmp_path):
+    from anovos_tpu.data_ingest.ts_auto_detection import ts_preprocess
+
+    out = ts_preprocess(ts_table, output_path=str(tmp_path))
+    assert out["ts_str"].kind == "ts"
+    stats = pd.read_csv(tmp_path / "ts_cols_stats.csv")
+    assert (stats["status"] == "converted").any()
+    # parsed values round-trip to real datetimes
+    df = out.to_pandas()
+    orig = ts_table.to_pandas()
+    assert (df["ts_str"].dt.year >= 2023).all()
+    pd.testing.assert_series_equal(
+        df["ts_str"].dt.floor("s"), orig["ts"].dt.floor("s"), check_names=False
+    )
+
+
+def test_ts_analyzer(ts_table, tmp_path):
+    from anovos_tpu.data_analyzer.ts_analyzer import ts_analyzer
+
+    ts_analyzer(ts_table, id_col="id", output_path=str(tmp_path))
+    stats = pd.read_csv(tmp_path / "ts_stats.csv")
+    assert stats.set_index("attribute").loc["ts", "eligible"] == 1
+    hourly = pd.read_csv(tmp_path / "ts_hourly_ts.csv")
+    assert hourly["count"].sum() == 1000
+
+
+def test_datetime_transforms(ts_table):
+    from anovos_tpu.data_transformer import datetime as dtm
+
+    out = dtm.timeUnits_extraction(ts_table, ["ts"], units=["year", "month", "hour", "dayofweek"])
+    df = out.to_pandas()
+    assert (df["ts_year"] >= 2023).all()
+    assert df["ts_month"].between(1, 12).all()
+    out2 = dtm.adding_timeUnits(ts_table, ["ts"], unit="days", unit_value=7, output_mode="append")
+    df2 = out2.to_pandas()
+    delta = (df2["ts_adjusted"] - df2["ts"]).dt.days
+    assert (delta == 7).all()
+    out3 = dtm.is_weekend(ts_table, ["ts"])
+    assert set(out3.to_pandas()["ts_isweekend"].dropna().unique()) <= {0.0, 1.0}
+    agg = dtm.aggregator(ts_table, ["val"], ["mean", "count"], "ts", granularity_format="%Y-%m")
+    assert len(agg) == 12 and "val_mean" in agg.columns
+
+
+def test_geo_detection_and_transforms():
+    g = np.random.default_rng(1)
+    n = 500
+    lat = g.uniform(37.0, 38.0, n)
+    lon = g.uniform(-122.5, -121.5, n)
+    from anovos_tpu.data_transformer.geo_utils import geohash_encode, geohash_decode
+
+    gh = [geohash_encode(a, o, 7) for a, o in zip(lat, lon)]
+    t = Table.from_pandas(pd.DataFrame({"latitude": lat, "longitude": lon, "geohash": gh, "x": g.normal(size=n)}))
+    from anovos_tpu.data_ingest.geo_auto_detection import ll_gh_cols
+
+    lat_cols, lon_cols, gh_cols = ll_gh_cols(t)
+    assert lat_cols == ["latitude"] and lon_cols == ["longitude"] and gh_cols == ["geohash"]
+    # geohash codec round trip
+    la, lo = geohash_decode(geohash_encode(37.7749, -122.4194, 9))
+    assert abs(la - 37.7749) < 1e-3 and abs(lo + 122.4194) < 1e-3
+    # distance sanity: SF → LA ≈ 559 km
+    from anovos_tpu.data_transformer.geo_utils import haversine_distance, vincenty_distance
+
+    d_h = haversine_distance(37.7749, -122.4194, 34.0522, -118.2437, unit="km")
+    d_v = vincenty_distance(37.7749, -122.4194, 34.0522, -118.2437, unit="km")
+    assert abs(d_h - 559) < 5 and abs(d_v - 559) < 5
+
+
+def test_geospatial_transformers():
+    g = np.random.default_rng(2)
+    n = 200
+    df = pd.DataFrame(
+        {
+            "lat1": g.uniform(37, 38, n),
+            "lon1": g.uniform(-122, -121, n),
+            "lat2": g.uniform(34, 35, n),
+            "lon2": g.uniform(-119, -118, n),
+            "uid": g.choice(["a", "b"], n),
+        }
+    )
+    t = Table.from_pandas(df)
+    from anovos_tpu.data_transformer import geospatial as geo
+
+    out = geo.location_distance(t, ["lat1", "lat2"], ["lon1", "lon2"], distance_type="haversine", unit="km")
+    d = out.to_pandas()["distance_haversine"]
+    assert (d > 100).all() and (d < 700).all()
+    cent = geo.centroid(t, "lat1", "lon1", "uid")
+    assert len(cent) == 2 and cent["lat1_centroid"].between(37, 38).all()
+    rog = geo.rog_calculation(t, "lat1", "lon1", "uid")
+    assert (rog["rog"] > 0).all()
+    inc = geo.location_in_country(t, ["lat1"], ["lon1"], country="US", method_type="approx")
+    assert inc.to_pandas()["lat1_lon1_in_US"].eq(1.0).all()
+    ghed = geo.geo_format_latlon(t, ["lat1"], ["lon1"], loc_output_format="geohash")
+    assert "lat1_lon1_geohash" in ghed.col_names
+
+
+def test_geospatial_analyzer(tmp_path):
+    g = np.random.default_rng(3)
+    # two well-separated blobs
+    lat = np.concatenate([g.normal(37.7, 0.01, 300), g.normal(34.0, 0.01, 300)])
+    lon = np.concatenate([g.normal(-122.4, 0.01, 300), g.normal(-118.2, 0.01, 300)])
+    t = Table.from_pandas(pd.DataFrame({"latitude": lat, "longitude": lon}))
+    from anovos_tpu.data_analyzer.geospatial_analyzer import geospatial_autodetection
+
+    lat_cols, lon_cols, gh_cols = geospatial_autodetection(
+        t, master_path=str(tmp_path), eps="0.05,0.1,0.05", min_samples="5,10,5", max_cluster=6
+    )
+    assert lat_cols == ["latitude"]
+    km = pd.read_csv(tmp_path / "geospatial_kmeans_latitude_longitude.csv")
+    assert len(km) >= 2  # the elbow finds at least the two blobs
+    db = pd.read_csv(tmp_path / "geospatial_dbscan_latitude_longitude.csv")
+    assert (db["n_clusters"] >= 2).any()
+
+
+def test_kmeans_and_dbscan_kernels():
+    g = np.random.default_rng(4)
+    X = np.concatenate([g.normal(0, 0.3, (200, 2)), g.normal(5, 0.3, (200, 2))])
+    import jax.numpy as jnp
+
+    from anovos_tpu.ops.cluster import dbscan_fit, kmeans_fit
+
+    centers, labels, inertia = kmeans_fit(jnp.asarray(X, jnp.float32), 2)
+    c = np.sort(np.asarray(centers)[:, 0])
+    assert abs(c[0] - 0) < 0.3 and abs(c[1] - 5) < 0.3
+    db = dbscan_fit(X, eps=1.0, min_samples=5)
+    assert len(set(db[db >= 0])) == 2
+    assert (db >= 0).mean() > 0.95
+
+
+def test_feature_recommender():
+    from anovos_tpu.feature_recommender.feature_explorer import (
+        list_all_industry,
+        list_feature_by_industry,
+    )
+    from anovos_tpu.feature_recommender.feature_mapper import feature_mapper, sankey_visualization
+
+    inds = list_all_industry()
+    assert len(inds) > 3
+    feats = list_feature_by_industry(inds["Industry"].iloc[0], num_of_feat=5)
+    assert len(feats) <= 5 and "Feature Name" in feats.columns
+    mapping = feature_mapper(
+        {"cust_age": "age of the customer", "txn_amt": "transaction amount in dollars"},
+        top_n=2,
+        threshold=0.0,
+    )
+    assert set(mapping["Attribute Name"]) == {"cust_age", "txn_amt"}
+    fig = sankey_visualization(mapping)
+    assert fig["data"][0]["type"] == "sankey"
+
+
+def test_feast_exporter(tmp_path):
+    from anovos_tpu.feature_store import feast_exporter as fe
+
+    t = Table.from_pandas(pd.DataFrame({"ifa": ["a", "b"], "age": [1, 2]}))
+    cfg = {
+        "file_path": str(tmp_path),
+        "entity": {"name": "userid", "id_col": "ifa", "description": "the user"},
+        "file_source": {
+            "timestamp_col": "event_ts",
+            "create_timestamp_col": "create_ts",
+            "description": "anovos output",
+            "owner": "me@x.io",
+        },
+        "feature_view": {"name": "income_view", "ttl_in_seconds": 3600, "owner": "me@x.io"},
+        "service_name": "income_svc",
+    }
+    fe.check_feast_configuration(cfg, 1)
+    with pytest.raises(ValueError):
+        fe.check_feast_configuration(cfg, 2)
+    t2 = fe.add_timestamp_columns(t, cfg["file_source"])
+    assert "event_ts" in t2.col_names and t2["event_ts"].kind == "ts"
+    out = fe.generate_feature_description(t2.dtypes(), cfg, "part-00000.parquet")
+    code = open(out).read()
+    assert "FeatureView" in code and 'join_keys=["ifa"]' in code and "income_svc" in code
+    compile(code, out, "exec")  # generated repo file must be valid python

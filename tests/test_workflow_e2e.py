@@ -1,0 +1,115 @@
+"""End-to-end config-driven run (reference test strategy: the full-demo
+workflow on the income dataset, SURVEY.md §4)."""
+
+import json
+import os
+
+import pandas as pd
+import pytest
+import yaml
+
+from anovos_tpu import workflow
+
+CFG = {
+    "input_dataset": {
+        "read_dataset": {
+            "file_path": "/root/reference/examples/data/income_dataset/parquet",
+            "file_type": "parquet",
+        },
+        "delete_column": ["logfnl", "empty", "dt_1", "dt_2"],
+        "rename_column": {
+            "list_of_cols": ["marital-status", "education-num"],
+            "list_of_newcols": ["marital_status", "education_num"],
+        },
+    },
+    "anovos_basic_report": {"basic_report": False},
+    "stats_generator": {
+        "metric": ["global_summary", "measures_of_counts", "measures_of_centralTendency"],
+        "metric_args": {"list_of_cols": "all", "drop_cols": ["ifa"]},
+    },
+    "quality_checker": {
+        "duplicate_detection": {"list_of_cols": "all", "drop_cols": ["ifa"], "treatment": True},
+        "nullColumns_detection": {
+            "list_of_cols": "all",
+            "drop_cols": ["ifa", "income"],
+            "treatment": True,
+            "treatment_method": "MMM",
+            "treatment_configs": {"method_type": "median"},
+        },
+    },
+    "association_evaluator": {
+        "IV_calculation": {
+            "list_of_cols": "all",
+            "drop_cols": "ifa",
+            "label_col": "income",
+            "event_label": ">50K",
+        }
+    },
+    "drift_detector": {
+        "drift_statistics": {
+            "configs": {
+                "list_of_cols": "all",
+                "drop_cols": ["ifa", "income"],
+                "method_type": "PSI",
+                "threshold": 0.1,
+                "sample_size": 20000,
+            },
+            "source_dataset": {
+                "read_dataset": {
+                    "file_path": "/root/reference/examples/data/income_dataset/parquet",
+                    "file_type": "parquet",
+                },
+                "delete_column": ["logfnl", "empty", "dt_1", "dt_2"],
+                "rename_column": {
+                    "list_of_cols": ["marital-status", "education-num"],
+                    "list_of_newcols": ["marital_status", "education_num"],
+                },
+            },
+        }
+    },
+    "report_preprocessing": {
+        "master_path": "report_stats",
+        "charts_to_objects": {
+            "list_of_cols": "all",
+            "drop_cols": "ifa",
+            "label_col": "income",
+            "event_label": ">50K",
+            "bin_size": 10,
+        },
+    },
+    "report_generation": {
+        "master_path": "report_stats",
+        "id_col": "ifa",
+        "label_col": "income",
+        "final_report_path": "report_stats",
+    },
+    "write_main": {"file_path": "output", "file_type": "parquet", "file_configs": {"mode": "overwrite"}},
+}
+
+
+@pytest.mark.slow
+def test_workflow_end_to_end(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cfg_path = tmp_path / "cfg.yaml"
+    # sort_keys=False: block execution follows YAML author order, exactly like
+    # the reference's insertion-ordered dict iteration
+    cfg_path.write_text(yaml.safe_dump(CFG, sort_keys=False))
+    workflow.run(str(cfg_path), "local")
+
+    rs = tmp_path / "report_stats"
+    # stats contract
+    gs = pd.read_csv(rs / "global_summary.csv")
+    assert str(dict(zip(gs["metric"], gs["value"]))["columns_count"]) == "19"
+    ct = pd.read_csv(rs / "measures_of_centralTendency.csv").set_index("attribute")
+    assert abs(float(ct.loc["age", "mean"]) - 38.5065) < 0.01
+    iv = pd.read_csv(rs / "IV_calculation.csv")
+    assert "iv" in iv.columns and len(iv) > 5
+    drift = pd.read_csv(rs / "drift_statistics.csv")
+    assert (drift["PSI"] < 0.05).all()  # same dataset → no drift
+    # chart contract
+    with open(rs / "freqDist_age") as f:
+        fig = json.load(f)
+    assert fig["data"][0]["type"] == "bar"
+    # report + final dataset
+    assert (rs / "ml_anovos_report.html").exists()
+    assert (tmp_path / "output" / "final_dataset" / "_SUCCESS").exists()
