@@ -74,6 +74,27 @@ def _decompress(block: bytes, codec: str) -> bytes:
     raise ValueError(f"unsupported avro codec: {codec}")
 
 
+def _try_native_decode(raw: bytes, header_offset: int, sync: bytes, codec: str, fields):
+    """Map the schema onto the native decoder's field spec; None = unsupported."""
+    try:
+        from anovos_tpu.shared.native import native_avro_decode
+    except ImportError:  # pragma: no cover
+        return None
+    spec = []
+    for f in fields:
+        base, branches = _field_reader(f["type"])
+        if base == "union":
+            bases = [_field_reader(b)[0] for b in branches]
+            if len(bases) != 2 or "null" not in bases:
+                return None
+            null_idx = bases.index("null")
+            value_base = bases[1 - null_idx]
+            spec.append((f["name"], value_base, null_idx))
+        else:
+            spec.append((f["name"], base, -1))
+    return native_avro_decode(raw, header_offset, sync, codec, spec)
+
+
 def _field_reader(ftype) -> Tuple[str, List]:
     """Normalize a field type to (base_type, union_branches)."""
     if isinstance(ftype, list):
@@ -108,7 +129,12 @@ def _decode_value(buf, ftype):
 
 
 def read_avro(path: str) -> Dict[str, np.ndarray]:
-    """Read one .avro container file → dict of host column arrays."""
+    """Read one .avro container file → dict of host column arrays.
+
+    Decodes through the native C++ library when available (two-phase
+    columnar decode, anovos_native.cpp); falls back to the pure-Python
+    record loop for exotic schemas or when no toolchain exists.
+    """
     with open(path, "rb") as f:
         raw = f.read()
     buf = io.BytesIO(raw)
@@ -126,6 +152,10 @@ def read_avro(path: str) -> Dict[str, np.ndarray]:
     codec = meta.get("avro.codec", b"null").decode()
     sync = buf.read(16)
     fields = schema["fields"]
+
+    native_out = _try_native_decode(raw, buf.tell(), sync, codec, fields)
+    if native_out is not None:
+        return native_out
     cols: Dict[str, list] = {f["name"]: [] for f in fields}
     while buf.tell() < len(raw):
         try:

@@ -59,6 +59,27 @@ def _resolve_files(file_path: str, file_type: str) -> List[str]:
     raise FileNotFoundError(f"no {file_type} files at {file_path}")
 
 
+def _coerce_numeric_strings(decoded: dict) -> dict:
+    """Schema-inference parity for the decoded-Table path: a string column
+    whose every value parses numeric becomes numeric (the pandas route's
+    inferSchema re-coercion).  Cheap — the parse runs over the VOCAB."""
+    from anovos_tpu.shared.native import NativeEncodedStrings
+
+    out = {}
+    for name, arr in decoded.items():
+        if isinstance(arr, NativeEncodedStrings) and len(arr.vocab):
+            parsed = pd.to_numeric(pd.Series(arr.vocab.astype(str)), errors="coerce")
+            if parsed.notna().all():
+                lut = parsed.to_numpy(np.float64)
+                vals = np.full(len(arr.codes), np.nan)
+                valid = arr.codes >= 0
+                vals[valid] = lut[arr.codes[valid]]
+                out[name] = vals
+                continue
+        out[name] = arr
+    return out
+
+
 def read_dataset(file_path: str, file_type: str, file_configs: Optional[dict] = None) -> Table:
     """Read csv/parquet/avro/json into a device Table.
 
@@ -69,6 +90,20 @@ def read_dataset(file_path: str, file_type: str, file_configs: Optional[dict] = 
     """
     cfg = dict(file_configs or {})
     files = _resolve_files(file_path, file_type)
+    if file_type == "avro":
+        # native-friendly path: per-file decode straight to Tables (string
+        # columns stay dictionary codes), row-union via concatenate_dataset's
+        # vocab-union remap.  Falls through to pandas only on decode failure.
+        tables = []
+        for f in files:
+            decoded = avro_io.read_avro(f)
+            if not decoded:
+                tables = None
+                break
+            n = len(next(iter(decoded.values())))
+            tables.append(Table.from_numpy(_coerce_numeric_strings(decoded), nrows=n))
+        if tables:
+            return tables[0] if len(tables) == 1 else concatenate_dataset(*tables, method_type="name")
     frames = []
     for f in files:
         if file_type == "csv":
@@ -83,7 +118,14 @@ def read_dataset(file_path: str, file_type: str, file_configs: Optional[dict] = 
         elif file_type == "parquet":
             frames.append(pd.read_parquet(f))
         elif file_type == "avro":
-            frames.append(pd.DataFrame(avro_io.read_avro(f)))
+            from anovos_tpu.shared.native import NativeEncodedStrings
+
+            dec = avro_io.read_avro(f)
+            dec = {
+                k: (v.to_object_array() if isinstance(v, NativeEncodedStrings) else v)
+                for k, v in dec.items()
+            }
+            frames.append(pd.DataFrame(dec))
         elif file_type == "json":
             opener = gzip.open if f.endswith(".gz") else open
             with opener(f, "rt") as fh:

@@ -99,8 +99,12 @@ class Table:
             return Table(cols, 0)
         n = nrows if nrows is not None else len(next(iter(data.values())))
         npad = rt.pad_rows(max(n, 1))
+        from anovos_tpu.shared.native import NativeEncodedStrings
+
         for name, arr in data.items():
-            cols[name] = _host_to_column(np.asarray(arr), n, npad, rt)
+            if not isinstance(arr, NativeEncodedStrings):
+                arr = np.asarray(arr)
+            cols[name] = _host_to_column(arr, n, npad, rt)
         return Table(cols, n)
 
     @staticmethod
@@ -307,6 +311,15 @@ def _gather_program(datas, masks, idx, valid):
 
 def _host_to_column(arr: np.ndarray, n: int, npad: int, rt) -> Column:
     """Convert one host array to a device Column (pad + shard)."""
+    from anovos_tpu.shared.native import NativeEncodedStrings
+
+    if isinstance(arr, NativeEncodedStrings):
+        # already dictionary-encoded by the native decoder (codes + vocab,
+        # strings never became Python objects)
+        code_arr = arr.codes[:n]
+        data = rt.shard_rows(_pad_to(code_arr, npad, -1))
+        mask = rt.shard_rows(_pad_to(code_arr >= 0, npad, False))
+        return Column("cat", data, mask, vocab=arr.vocab, dtype_name="string")
     if arr.dtype == object or arr.dtype.kind in ("U", "S"):
         # categorical: dictionary-encode on host, codes on device
         vals = arr[:n]

@@ -1,0 +1,304 @@
+// anovos_native — host-side columnar decode kernels for the ingest layer.
+//
+// The reference's "native layer" is the Spark JVM + spark-avro JAR
+// (SURVEY.md §2.9).  Here the native layer is this small C++ library, loaded
+// via ctypes (no pybind11 in the image):
+//
+//  - Avro object-container decode (deflate via zlib, raw snappy implemented
+//    inline) straight into columnar buffers — replaces the pure-Python
+//    varint/record loop (~100× faster per record);
+//  - dictionary encoding of string columns (hash map over string views) —
+//    the host-side step feeding int32 codes to the device.
+//
+// Memory protocol: two-phase.  Phase 1 (count) walks the container and
+// returns record/byte counts so Python can allocate numpy buffers; phase 2
+// (decode) fills them.  All buffers are caller-owned numpy arrays.
+//
+// Build: g++ -O3 -shared -fPIC anovos_native.cpp -o libanovos_native.so -lz
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+#include <zlib.h>
+
+extern "C" {
+
+// field type codes (subset Spark writes for flat frames)
+enum FieldType : int32_t {
+  FT_NULL = 0,
+  FT_BOOL = 1,
+  FT_INT = 2,    // int | long  (zigzag varint)
+  FT_FLOAT = 3,  // float32
+  FT_DOUBLE = 4, // float64
+  FT_STRING = 5, // length-prefixed utf8
+};
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  int64_t read_long() {
+    uint64_t n = 0;
+    int shift = 0;
+    while (p < end) {
+      uint8_t b = *p++;
+      n |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) return static_cast<int64_t>(n >> 1) ^ -static_cast<int64_t>(n & 1);
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  bool skip(int64_t n) {
+    if (p + n > end) { ok = false; return false; }
+    p += n;
+    return true;
+  }
+};
+
+// raw snappy decompress (format: uncompressed-length varint, then literal /
+// copy tagged elements)
+static bool snappy_uncompress(const uint8_t* src, size_t n, std::vector<uint8_t>& out) {
+  size_t pos = 0;
+  uint64_t ulen = 0;
+  int shift = 0;
+  while (pos < n) {
+    uint8_t b = src[pos++];
+    ulen |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  // sanity bound: snappy caps expansion; a corrupt length varint must not
+  // drive a multi-GB allocation
+  if (ulen > n * 64 + (1u << 20)) return false;
+  out.clear();
+  out.reserve(ulen);
+  while (pos < n) {
+    uint8_t tag = src[pos++];
+    uint32_t type = tag & 3;
+    if (type == 0) {  // literal
+      uint32_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        uint32_t extra = len - 60;
+        if (pos + extra > n) return false;
+        len = 0;
+        for (uint32_t i = 0; i < extra; i++) len |= static_cast<uint32_t>(src[pos + i]) << (8 * i);
+        len += 1;
+        pos += extra;
+      }
+      if (pos + len > n) return false;
+      out.insert(out.end(), src + pos, src + pos + len);
+      pos += len;
+    } else {
+      uint32_t len, offset;
+      if (type == 1) {
+        if (pos >= n) return false;
+        len = ((tag >> 2) & 7) + 4;
+        offset = (static_cast<uint32_t>(tag >> 5) << 8) | src[pos++];
+      } else if (type == 2) {
+        if (pos + 2 > n) return false;
+        len = (tag >> 2) + 1;
+        offset = src[pos] | (static_cast<uint32_t>(src[pos + 1]) << 8);
+        pos += 2;
+      } else {
+        if (pos + 4 > n) return false;
+        len = (tag >> 2) + 1;
+        offset = src[pos] | (static_cast<uint32_t>(src[pos + 1]) << 8) |
+                 (static_cast<uint32_t>(src[pos + 2]) << 16) | (static_cast<uint32_t>(src[pos + 3]) << 24);
+        pos += 4;
+      }
+      if (offset == 0 || offset > out.size()) return false;
+      size_t start = out.size() - offset;
+      for (uint32_t i = 0; i < len; i++) out.push_back(out[start + i]);  // may overlap
+    }
+  }
+  return out.size() == ulen;
+}
+
+static bool inflate_raw(const uint8_t* src, size_t n, std::vector<uint8_t>& out) {
+  z_stream zs{};
+  if (inflateInit2(&zs, -15) != Z_OK) return false;
+  out.clear();
+  out.resize(n * 4 + 4096);
+  zs.next_in = const_cast<Bytef*>(src);
+  zs.avail_in = static_cast<uInt>(n);
+  size_t written = 0;
+  int ret = Z_OK;
+  while (ret != Z_STREAM_END) {
+    if (written == out.size()) out.resize(out.size() * 2);
+    zs.next_out = out.data() + written;
+    zs.avail_out = static_cast<uInt>(out.size() - written);
+    ret = inflate(&zs, Z_NO_FLUSH);
+    if (ret != Z_OK && ret != Z_STREAM_END) { inflateEnd(&zs); return false; }
+    written = out.size() - zs.avail_out;
+    if (ret == Z_OK && zs.avail_in == 0 && zs.avail_out > 0) break;
+  }
+  out.resize(written);
+  inflateEnd(&zs);
+  return true;
+}
+
+// codec: 0 = null, 1 = deflate, 2 = snappy (4-byte CRC suffix stripped by caller? no — handled here)
+static bool decode_block_bytes(const uint8_t* src, size_t n, int codec, std::vector<uint8_t>& out) {
+  if (codec == 0) { out.assign(src, src + n); return true; }
+  if (codec == 1) return inflate_raw(src, n, out);
+  if (codec == 2) return n >= 4 && snappy_uncompress(src, n - 4, out);
+  return false;
+}
+
+// Walk all container blocks once.  Outputs per record, per field into
+// caller buffers.  For each field i (nullable union assumed):
+//   doubles[i] : double* (numeric/bool) or nullptr for strings
+//   valid[i]   : uint8_t* (1 = non-null)
+//   str_off[i] : int64_t* cumulative byte offsets (len nrec+1), strings only
+// String bytes append into one shared arena per field (str_bytes[i], capacity
+// str_cap): phase 1 (fill=0) only counts; phase 2 (fill=1) writes.
+//
+// Returns number of records decoded, or -1 on error.
+static int64_t avro_decode_impl(
+    const uint8_t* data, int64_t len,
+    const int32_t* field_types, const int32_t* union_null_first, int32_t nfields,
+    int32_t codec, int64_t header_offset, const uint8_t* sync,
+    int32_t fill,
+    double** doubles, uint8_t** valid, int64_t** str_off, uint8_t** str_bytes,
+    int64_t* str_bytes_used /* per field, in+out */) {
+  const uint8_t* p = data + header_offset;
+  const uint8_t* end = data + len;
+  std::vector<uint8_t> block;
+  int64_t rec = 0;
+  std::vector<int64_t> sbytes(nfields, 0);
+  while (p < end) {
+    Reader hdr{p, end};
+    int64_t nrec = hdr.read_long();
+    int64_t blen = hdr.read_long();
+    // validate sizes BEFORE pointer arithmetic: a corrupt varint can be
+    // negative or huge and `hdr.p + blen` would wrap past `end`
+    if (!hdr.ok || nrec < 0 || blen < 0) break;
+    if (blen > end - hdr.p || end - hdr.p - blen < 16) break;
+    if (!decode_block_bytes(hdr.p, static_cast<size_t>(blen), codec, block)) return -1;
+    if (memcmp(hdr.p + blen, sync, 16) != 0) return -2;
+    p = hdr.p + blen + 16;
+    Reader r{block.data(), block.data() + block.size()};
+    for (int64_t k = 0; k < nrec; k++, rec++) {
+      for (int32_t f = 0; f < nfields; f++) {
+        int32_t ft = field_types[f];
+        bool isnull = false;
+        if (union_null_first[f] >= 0) {  // nullable union; value = branch index
+          int64_t branch = r.read_long();
+          isnull = (branch == union_null_first[f]);
+        }
+        if (fill) valid[f][rec] = isnull ? 0 : 1;
+        if (isnull) {
+          if (fill) {
+            if (ft == FT_STRING) str_off[f][rec + 1] = sbytes[f];
+            else doubles[f][rec] = 0.0;
+          } else if (ft == FT_STRING) {
+            // nothing
+          }
+          continue;
+        }
+        switch (ft) {
+          case FT_BOOL: {
+            if (r.p >= r.end) return -3;
+            double v = (*r.p++ == 1) ? 1.0 : 0.0;
+            if (fill) doubles[f][rec] = v;
+            break;
+          }
+          case FT_INT: {
+            int64_t v = r.read_long();
+            if (fill) doubles[f][rec] = static_cast<double>(v);
+            break;
+          }
+          case FT_FLOAT: {
+            float v;
+            if (r.p + 4 > r.end) return -3;
+            memcpy(&v, r.p, 4); r.p += 4;
+            if (fill) doubles[f][rec] = v;
+            break;
+          }
+          case FT_DOUBLE: {
+            double v;
+            if (r.p + 8 > r.end) return -3;
+            memcpy(&v, r.p, 8); r.p += 8;
+            if (fill) doubles[f][rec] = v;
+            break;
+          }
+          case FT_STRING: {
+            int64_t slen = r.read_long();
+            if (slen < 0 || r.p + slen > r.end) return -3;
+            if (fill) {
+              memcpy(str_bytes[f] + sbytes[f], r.p, static_cast<size_t>(slen));
+              str_off[f][rec + 1] = sbytes[f] + slen;
+            }
+            sbytes[f] += slen;
+            r.p += slen;
+            break;
+          }
+          default:
+            return -4;
+        }
+        if (!r.ok) return -3;
+      }
+    }
+  }
+  for (int32_t f = 0; f < nfields; f++) str_bytes_used[f] = sbytes[f];
+  return rec;
+}
+
+int64_t avro_decode(
+    const uint8_t* data, int64_t len,
+    const int32_t* field_types, const int32_t* union_null_first, int32_t nfields,
+    int32_t codec, int64_t header_offset, const uint8_t* sync,
+    int32_t fill,
+    double** doubles, uint8_t** valid, int64_t** str_off, uint8_t** str_bytes,
+    int64_t* str_bytes_used) {
+  // exceptions (bad_alloc from corrupt sizes) must not cross the C ABI
+  try {
+    return avro_decode_impl(data, len, field_types, union_null_first, nfields,
+                            codec, header_offset, sync, fill, doubles, valid,
+                            str_off, str_bytes, str_bytes_used);
+  } catch (...) {
+    return -5;
+  }
+}
+
+// Dictionary-encode one string column given as offsets+bytes: codes out,
+// returns vocab size; vocab emitted as (vocab_off, vocab_bytes).
+int64_t dict_encode(
+    const uint8_t* bytes, const int64_t* offsets, const uint8_t* valid, int64_t n,
+    int32_t* codes, int64_t* vocab_off, uint8_t* vocab_bytes, int64_t vocab_cap,
+    int64_t* vocab_bytes_used) {
+  std::unordered_map<std::string_view, int32_t> lut;
+  lut.reserve(static_cast<size_t>(n) / 4 + 8);
+  int64_t vb = 0;
+  int32_t next = 0;
+  vocab_off[0] = 0;
+  for (int64_t i = 0; i < n; i++) {
+    if (!valid[i]) { codes[i] = -1; continue; }
+    std::string_view sv(reinterpret_cast<const char*>(bytes + offsets[i]),
+                        static_cast<size_t>(offsets[i + 1] - offsets[i]));
+    auto it = lut.find(sv);
+    if (it == lut.end()) {
+      if (vb + static_cast<int64_t>(sv.size()) > vocab_cap) return -1;
+      memcpy(vocab_bytes + vb, sv.data(), sv.size());
+      vb += static_cast<int64_t>(sv.size());
+      vocab_off[next + 1] = vb;
+      // the key must view the arena copy (stable storage), not the input
+      std::string_view stable(reinterpret_cast<const char*>(vocab_bytes + vocab_off[next]), sv.size());
+      lut.emplace(stable, next);
+      codes[i] = next;
+      next++;
+    } else {
+      codes[i] = it->second;
+    }
+  }
+  *vocab_bytes_used = vb;
+  return next;
+}
+
+}  // extern "C"
