@@ -1,10 +1,13 @@
 """Descriptive statistics (reference: data_analyzer/stats_generator.py).
 
 Every function keeps the reference's output schema (column names, 4-decimal
-rounding, string-typed mode) so the data_report CSV contract is unchanged,
-but the mechanism is one batched masked kernel over the (rows, cols) block —
-the reference's 🔥 per-column Spark-job loops (SURVEY.md §3.2) collapse into
-single XLA reductions with psum merges across row shards.
+rounding, string-typed mode) so the data_report CSV contract is unchanged.
+All seven public metrics draw from ONE pair of fused kernels
+(ops/describe.py: moments + percentiles + distinct + mode share a single
+sort; categorical histograms share a single sweep), memoized per Table —
+the reference's 🔥 per-column Spark-job loops (SURVEY.md §3.2) and a naive
+one-kernel-per-function port both collapse into two device dispatches for
+the entire stats block.
 
 Returns are host pandas DataFrames: stats frames are tiny ([attribute, …]),
 exactly like the reference's driver-collected stats DataFrames.
@@ -12,16 +15,13 @@ exactly like the reference's driver-collected stats DataFrames.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Union
+from typing import List
 
 import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
-from anovos_tpu.ops.mode import masked_mode
-from anovos_tpu.ops.quantiles import masked_quantiles
-from anovos_tpu.ops.reductions import masked_moments
-from anovos_tpu.ops.segment import code_counts, masked_nunique
+from anovos_tpu.ops.describe import PCTL_QS, table_describe
 from anovos_tpu.shared.table import Table
 from anovos_tpu.shared.utils import parse_cols
 
@@ -42,10 +42,43 @@ def _validate(idf: Table, cols: List[str], numeric_only: bool = False) -> None:
             raise TypeError(f"Invalid input for Column(s): non-numerical {nonnum}")
 
 
-def _num_cat(idf: Table, cols: List[str]):
-    num = [c for c in cols if idf.columns[c].kind == "num"]
-    cat = [c for c in cols if idf.columns[c].kind == "cat"]
-    return num, cat
+def _desc(idf: Table):
+    """Fused, memoized description over ALL of the table's num/cat columns;
+    callers index into it for their column subset."""
+    num_all, cat_all, _ = idf.attribute_type_segregation()
+    num_out, cat_out = table_describe(idf, num_all, cat_all)
+    return num_out, cat_out, {c: i for i, c in enumerate(num_all)}, {c: i for i, c in enumerate(cat_all)}
+
+
+def _fill_count(idf: Table, col: str, num_out, cat_out, ni, ci) -> int:
+    if col in ni:
+        return int(num_out["count"][ni[col]])
+    if col in ci:
+        return int(cat_out["count"][ci[col]])
+    c = idf.columns[col]
+    return int(np.asarray(c.mask).sum())  # ts/other columns: direct mask sum
+
+
+def _fill_counts_light(idf: Table, cols: List[str]) -> np.ndarray:
+    """Count-only path: ONE stacked mask reduction.  Used by the count
+    metrics so a standalone missingCount call doesn't pay the full fused
+    describe (sorts etc.); when describe is already cached, reuse it."""
+    cache = getattr(idf, "_describe_cache", None)
+    if cache:
+        num_out, cat_out = next(iter(cache.values()))
+        num_all, cat_all, _ = idf.attribute_type_segregation()
+        ni = {c: i for i, c in enumerate(num_all)}
+        ci = {c: i for i, c in enumerate(cat_all)}
+        if all(c in ni or c in ci for c in cols):
+            return np.array([_fill_count(idf, c, num_out, cat_out, ni, ci) for c in cols])
+    M = jnp.stack(
+        [
+            idf.columns[c].mask & ((idf.columns[c].data >= 0) if idf.columns[c].kind == "cat" else True)
+            for c in cols
+        ],
+        axis=1,
+    )
+    return np.asarray(M.sum(axis=0, dtype=jnp.int32)).astype(np.int64)
 
 
 def global_summary(idf: Table, list_of_cols="all", drop_cols=[], print_impact=False) -> pd.DataFrame:
@@ -70,18 +103,13 @@ def global_summary(idf: Table, list_of_cols="all", drop_cols=[], print_impact=Fa
     return odf
 
 
-def _fill_counts(idf: Table, cols: List[str]) -> np.ndarray:
-    M = jnp.stack([idf.columns[c].mask for c in cols], axis=1)
-    return np.asarray(M.sum(axis=0)).astype(np.int64)
-
-
 def missingCount_computation(
     idf: Table, list_of_cols="all", drop_cols=[], print_impact=False
 ) -> pd.DataFrame:
     """[attribute, missing_count, missing_pct] (reference :116-176)."""
     cols = parse_cols(list_of_cols, idf.col_names, drop_cols)
     _validate(idf, cols)
-    fill = _fill_counts(idf, cols)
+    fill = _fill_counts_light(idf, cols)
     missing = idf.nrows - fill
     odf = pd.DataFrame(
         {
@@ -107,9 +135,9 @@ def nonzeroCount_computation(
 
         warnings.warn("No Non-Zero Count Computation - No numerical column(s) to analyze")
         return pd.DataFrame(columns=["attribute", "nonzero_count", "nonzero_pct"])
-    _validate(idf, cols)
-    X, M = idf.numeric_block(cols)
-    nz = np.asarray(masked_moments(X, M)["nonzero"]).astype(np.int64)
+    _validate(idf, cols, numeric_only=True)
+    num_out, _, ni, _ = _desc(idf)
+    nz = np.array([num_out["nonzero"][ni[c]] for c in cols]).astype(np.int64)
     odf = pd.DataFrame(
         {
             "attribute": cols,
@@ -130,7 +158,7 @@ def measures_of_counts(
     cols = parse_cols(list_of_cols, idf.col_names, drop_cols)
     _validate(idf, cols)
     num_cols = [c for c in cols if idf.columns[c].kind == "num"]
-    fill = _fill_counts(idf, cols)
+    fill = _fill_counts_light(idf, cols)
     odf = pd.DataFrame(
         {
             "attribute": cols,
@@ -154,7 +182,6 @@ def mode_computation(
 ) -> pd.DataFrame:
     """[attribute, mode, mode_rows] over discrete (cat + integer) columns
     (reference :328-421).  mode is string-typed for schema parity."""
-    num_all, cat_all, _ = idf.attribute_type_segregation()
     discrete_all = [
         c
         for c in idf.col_names
@@ -170,29 +197,23 @@ def mode_computation(
 
         warnings.warn("No Mode Computation - No discrete column(s) to analyze")
         return pd.DataFrame(columns=["attribute", "mode", "mode_rows"])
+    num_out, cat_out, ni, ci = _desc(idf)
     modes, counts = [], []
-    int_cols = [c for c in cols if idf.columns[c].kind == "num"]
-    if int_cols:
-        X, M = idf.numeric_block(int_cols)
-        mv, mc = masked_mode(X, M)
-        mv, mc = np.asarray(mv), np.asarray(mc)
-    int_i = 0
     for c in cols:
         col = idf.columns[c]
         if col.kind == "cat":
-            cnts = np.asarray(code_counts(col.data, col.mask, max(len(col.vocab), 1)))
-            if len(col.vocab) == 0 or cnts.max() == 0:
+            j = ci[c]
+            if len(col.vocab) == 0 or cat_out["mode_count"][j] == 0:
                 modes.append(None)
                 counts.append(0)
             else:
-                best = int(np.argmax(cnts))
-                modes.append(str(col.vocab[best]))
-                counts.append(int(cnts[best]))
+                modes.append(str(col.vocab[int(cat_out["mode_code"][j])]))
+                counts.append(int(cat_out["mode_count"][j]))
         else:
-            v, n = mv[int_i], int(mc[int_i])
-            int_i += 1
+            j = ni[c]
+            v = num_out["mode_value"][j]
             modes.append(None if np.isnan(v) else str(int(v)))
-            counts.append(n)
+            counts.append(int(num_out["mode_count"][j]))
     odf = pd.DataFrame({"attribute": cols, "mode": modes, "mode_rows": counts})
     if print_impact:
         print(odf.to_string(index=False))
@@ -209,32 +230,22 @@ def measures_of_centralTendency(
         list_of_cols if list_of_cols != "all" else num_all + cat_all, idf.col_names, drop_cols
     )
     _validate(idf, cols)
-    num_cols = [c for c in cols if idf.columns[c].kind == "num"]
-    fill = _fill_counts(idf, cols)
-    count_by_attr = dict(zip(cols, fill))
-    means = {}
-    medians = {}
-    if num_cols:
-        X, M = idf.numeric_block(num_cols)
-        mom = masked_moments(X, M)
-        med = np.asarray(masked_quantiles(X, M, jnp.array([0.5], jnp.float32), interpolation="lower"))[0]
-        for i, c in enumerate(num_cols):
-            means[c] = _R(float(mom["mean"][i]))
-            medians[c] = _R(float(med[i]))
+    num_out, cat_out, ni, ci = _desc(idf)
+    med_row = PCTL_QS.index(0.50)
     dfm = mode_computation(idf, [c for c in cols], [])
     mode_map = dfm.set_index("attribute")[["mode", "mode_rows"]].to_dict("index")
     rows = []
     for c in cols:
         m = mode_map.get(c, {"mode": None, "mode_rows": None})
-        cnt = count_by_attr[c]
+        cnt = _fill_count(idf, c, num_out, cat_out, ni, ci)
         mode_pct = (
             _R(m["mode_rows"] / cnt) if m.get("mode_rows") not in (None, np.nan) and cnt else None
         )
         rows.append(
             {
                 "attribute": c,
-                "mean": means.get(c),
-                "median": medians.get(c),
+                "mean": _R(float(num_out["mean"][ni[c]])) if c in ni else None,
+                "median": _R(float(num_out["percentiles"][med_row, ni[c]])) if c in ni else None,
                 "mode": m.get("mode"),
                 "mode_rows": m.get("mode_rows"),
                 "mode_pct": mode_pct,
@@ -250,7 +261,7 @@ def uniqueCount_computation(
     idf: Table, list_of_cols="all", drop_cols=[], print_impact=False, **_ignored
 ) -> pd.DataFrame:
     """[attribute, unique_values] (reference :529-620).  Exact distinct via
-    device sort; the HLL approx path is unnecessary (exact is one kernel)."""
+    the shared device sort; the HLL approx path is unnecessary."""
     num_all, cat_all, _ = idf.attribute_type_segregation()
     cols = parse_cols(
         list_of_cols if list_of_cols != "all" else num_all + cat_all, idf.col_names, drop_cols
@@ -261,15 +272,10 @@ def uniqueCount_computation(
 
         warnings.warn("No Unique Count Computation - No discrete column(s) to analyze")
         return pd.DataFrame(columns=["attribute", "unique_values"])
-    X = jnp.stack([idf.columns[c].data.astype(jnp.float32) for c in cols], 1)
-    M = jnp.stack(
-        [
-            idf.columns[c].mask & ((idf.columns[c].data >= 0) if idf.columns[c].kind == "cat" else True)
-            for c in cols
-        ],
-        1,
-    )
-    nu = np.asarray(masked_nunique(X, M)).astype(np.int64)
+    num_out, cat_out, ni, ci = _desc(idf)
+    nu = np.array(
+        [num_out["nunique"][ni[c]] if c in ni else cat_out["nunique"][ci[c]] for c in cols]
+    ).astype(np.int64)
     odf = pd.DataFrame({"attribute": cols, "unique_values": nu})
     if print_impact:
         print(odf.to_string(index=False))
@@ -302,14 +308,13 @@ def measures_of_dispersion(
     num_all, _, _ = idf.attribute_type_segregation()
     cols = parse_cols(list_of_cols if list_of_cols != "all" else num_all, num_all, drop_cols)
     _validate(idf, cols, numeric_only=True)
-    X, M = idf.numeric_block(cols)
-    mom = masked_moments(X, M)
-    q = np.asarray(
-        masked_quantiles(X, M, jnp.array([0.25, 0.75], jnp.float32), interpolation="lower")
-    )
-    std = np.asarray(mom["stddev"])
-    mean = np.asarray(mom["mean"])
-    rng = np.asarray(mom["max"]) - np.asarray(mom["min"])
+    num_out, _, ni, _ = _desc(idf)
+    idx = [ni[c] for c in cols]
+    std = num_out["stddev"][idx]
+    mean = num_out["mean"][idx]
+    q1 = num_out["percentiles"][PCTL_QS.index(0.25)][idx]
+    q3 = num_out["percentiles"][PCTL_QS.index(0.75)][idx]
+    rng = num_out["max"][idx] - num_out["min"][idx]
     with np.errstate(divide="ignore", invalid="ignore"):
         cov = std / mean
     odf = pd.DataFrame(
@@ -318,7 +323,7 @@ def measures_of_dispersion(
             "stddev": _R(std),
             "variance": _R(np.round(std, 4) ** 2),
             "cov": _R(cov),
-            "IQR": _R(q[1] - q[0]),
+            "IQR": _R(q3 - q1),
             "range": _R(rng),
         }
     )
@@ -328,7 +333,6 @@ def measures_of_dispersion(
 
 
 _PCTL_STATS = ["min", "1%", "5%", "10%", "25%", "50%", "75%", "90%", "95%", "99%", "max"]
-_PCTL_QS = [0.0, 0.01, 0.05, 0.10, 0.25, 0.50, 0.75, 0.90, 0.95, 0.99, 1.0]
 
 
 def measures_of_percentiles(
@@ -339,13 +343,11 @@ def measures_of_percentiles(
     num_all, _, _ = idf.attribute_type_segregation()
     cols = parse_cols(list_of_cols if list_of_cols != "all" else num_all, num_all, drop_cols)
     _validate(idf, cols, numeric_only=True)
-    X, M = idf.numeric_block(cols)
-    q = np.asarray(
-        masked_quantiles(X, M, jnp.array(_PCTL_QS, jnp.float32), interpolation="lower")
-    )
+    num_out, _, ni, _ = _desc(idf)
+    idx = [ni[c] for c in cols]
     odf = pd.DataFrame({"attribute": cols})
     for i, s in enumerate(_PCTL_STATS):
-        odf[s] = _R(q[i])
+        odf[s] = _R(num_out["percentiles"][i][idx])
     if print_impact:
         print(odf.to_string(index=False))
     return odf
@@ -359,13 +361,13 @@ def measures_of_shape(
     num_all, _, _ = idf.attribute_type_segregation()
     cols = parse_cols(list_of_cols if list_of_cols != "all" else num_all, num_all, drop_cols)
     _validate(idf, cols, numeric_only=True)
-    X, M = idf.numeric_block(cols)
-    mom = masked_moments(X, M)
+    num_out, _, ni, _ = _desc(idf)
+    idx = [ni[c] for c in cols]
     odf = pd.DataFrame(
         {
             "attribute": cols,
-            "skewness": _R(np.asarray(mom["skewness"])),
-            "kurtosis": _R(np.asarray(mom["kurtosis"])),
+            "skewness": _R(num_out["skewness"][idx]),
+            "kurtosis": _R(num_out["kurtosis"][idx]),
         }
     )
     if print_impact:

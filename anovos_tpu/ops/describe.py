@@ -1,0 +1,187 @@
+"""Fused whole-table description kernels.
+
+stats_generator's seven public functions each need a slice of the same
+underlying statistics.  Computing them per function costs one device
+dispatch each — expensive on remote backends and wasteful anywhere.  These
+kernels compute EVERYTHING for a column block in ONE program:
+
+- ``describe_numeric``: count/sum/mean/var/std/skew/kurt/min/max/nonzero,
+  the full percentile grid, and exact distinct counts — one sort, shared.
+- ``describe_cat``: per-column code histograms (padded to the max vocab),
+  from which mode, unique, missing, and frequency charts all derive.
+
+``table_describe`` memoizes per (table, column tuple) so a pipeline's stats
+block issues two dispatches total instead of ~14.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from anovos_tpu.shared.table import Table
+
+# the percentile grid every consumer shares (measures_of_percentiles order)
+PCTL_QS = (0.0, 0.01, 0.05, 0.10, 0.25, 0.50, 0.75, 0.90, 0.95, 0.99, 1.0)
+
+
+@jax.jit
+def describe_numeric(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
+    """One program: moments + percentiles + distinct counts for (rows, k)."""
+    dt = jnp.float32
+    Xf = X.astype(dt)
+    # exact integer valid count — a float32 ones-sum plateaus at 2^24 rows
+    n_int = M.sum(axis=0, dtype=jnp.int32)
+    n = n_int.astype(dt)
+    safe_n = jnp.maximum(n, 1.0)
+    s1 = jnp.where(M, Xf, 0).sum(axis=0)
+    mean = s1 / safe_n
+    d = jnp.where(M, Xf - mean, 0)
+    d2 = d * d
+    m2 = d2.sum(axis=0)
+    m3 = (d2 * d).sum(axis=0)
+    m4 = (d2 * d2).sum(axis=0)
+    var_samp = m2 / jnp.maximum(n - 1.0, 1.0)
+    std = jnp.sqrt(var_samp)
+    m2p = m2 / safe_n
+    skew = jnp.where(m2p > 0, (m3 / safe_n) / jnp.power(jnp.maximum(m2p, 1e-38), 1.5), jnp.nan)
+    kurt = jnp.where(m2p > 0, (m4 / safe_n) / jnp.maximum(m2p * m2p, 1e-38) - 3.0, jnp.nan)
+    nonzero = (M & (Xf != 0)).sum(axis=0, dtype=jnp.int32).astype(dt)
+
+    # ONE sort feeds percentiles AND distinct counts
+    big = jnp.asarray(jnp.finfo(dt).max, dt)
+    Xs = jnp.sort(jnp.where(M, Xf, big), axis=0)
+    rows = X.shape[0]
+    pos_idx = jnp.arange(rows, dtype=jnp.int32)[:, None]
+    valid_sorted = pos_idx < n_int[None, :]
+    trans = jnp.concatenate([jnp.ones((1, X.shape[1]), bool), Xs[1:] != Xs[:-1]], axis=0)
+    nunique = (trans & valid_sorted).sum(axis=0, dtype=jnp.int32)
+
+    # integer percentile positions: float64-free exact index arithmetic
+    qs = jnp.asarray(PCTL_QS, dt)
+    pos = qs[:, None] * jnp.maximum(n[None, :] - 1, 0)
+    lo_i = jnp.minimum(jnp.floor(pos).astype(jnp.int32), jnp.maximum(n_int[None, :] - 1, 0))
+    pctls = jnp.where(n[None, :] > 0, jnp.take_along_axis(Xs, lo_i, axis=0), jnp.nan)
+
+    # mode from the same sort: longest equal run, via cummax of run-start
+    # positions (no scatter/segment ops — cheap to compile, VPU-friendly).
+    # runlen peaks at the END of the longest run; argmax takes the first
+    # peak → earliest run → smallest value on count ties.
+    pos2 = jnp.arange(rows, dtype=jnp.int32)[:, None]
+    run_start = jax.lax.cummax(jnp.where(trans, pos2, -1), axis=0)
+    runlen = jnp.where(valid_sorted, pos2 - run_start + 1, 0)
+    best_idx = jnp.argmax(runlen, axis=0)  # (k,)
+    mode_cnt = jnp.take_along_axis(runlen, best_idx[None, :], axis=0)[0]
+    mode_val = jnp.take_along_axis(Xs, best_idx[None, :], axis=0)[0]
+
+    empty = n_int == 0
+    nanv = jnp.asarray(jnp.nan, dt)
+    return {
+        "count": n_int,
+        "mean": jnp.where(empty, nanv, mean),
+        "variance": jnp.where(n > 1, var_samp, nanv),
+        "stddev": jnp.where(n > 1, std, nanv),
+        "skewness": jnp.where(empty, nanv, skew),
+        "kurtosis": jnp.where(empty, nanv, kurt),
+        "min": pctls[0],
+        "max": pctls[-1],
+        "nonzero": nonzero,
+        "nunique": nunique,
+        "percentiles": pctls,  # (len(PCTL_QS), k), 'lower' interpolation
+        "mode_value": jnp.where(empty, nanv, mode_val),
+        "mode_count": mode_cnt,
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("max_vocab",))
+def describe_cat(C: jax.Array, M: jax.Array, max_vocab: int) -> Dict[str, jax.Array]:
+    """One program: per-column code histograms for (rows, k_cat) codes.
+    counts: (k, max_vocab); count/nunique/mode derive from it."""
+    valid = M & (C >= 0)
+    lanes = jnp.arange(max_vocab, dtype=C.dtype)
+    eq = (C[:, :, None] == lanes) & valid[:, :, None]
+    counts = eq.sum(axis=0).astype(jnp.float32)  # (k, maxv)
+    return {
+        "counts": counts,
+        "count": valid.sum(axis=0),
+        "nunique": (counts > 0).sum(axis=1),
+        "mode_code": jnp.argmax(counts, axis=1),
+        "mode_count": counts.max(axis=1),
+    }
+
+
+# above this vocab size the dense lane sweep is wasteful (O(rows·k·vocab));
+# high-cardinality columns (ids) go through the sort-based kernel on their
+# codes instead — same count/nunique/mode outputs
+_CAT_SWEEP_MAX_VOCAB = 1024
+
+
+def table_describe(idf: Table, num_cols: List[str], cat_cols: List[str]) -> Tuple[dict, dict]:
+    """Memoized fused description: (numeric dict of host arrays, cat dict
+    with per-column count/nunique/mode_code/mode_count).
+
+    The cache lives on the Table instance — any transformation produces a
+    NEW Table, so staleness is impossible by construction.
+    """
+    cache = getattr(idf, "_describe_cache", None)
+    if cache is None:
+        cache = {}
+        idf._describe_cache = cache
+    key = (tuple(num_cols), tuple(cat_cols))
+    if key in cache:
+        return cache[key]
+    num_out: dict = {}
+    if num_cols:
+        X, M = idf.numeric_block(num_cols)
+        num_out = {k: np.asarray(v) for k, v in describe_numeric(X, M).items()}
+    cat_out: dict = {}
+    if cat_cols:
+        k = len(cat_cols)
+        cat_out = {
+            "count": np.zeros(k, np.int64),
+            "nunique": np.zeros(k, np.int64),
+            "mode_code": np.zeros(k, np.int64),
+            "mode_count": np.zeros(k, np.float64),
+        }
+        small = [c for c in cat_cols if len(idf.columns[c].vocab) <= _CAT_SWEEP_MAX_VOCAB]
+        large = [c for c in cat_cols if c not in set(small)]
+        # bucket by vocab size (powers of 4): one 1000-category column must
+        # not multiply the lane count of thirty binary columns
+        buckets: Dict[int, List[str]] = {}
+        for c in small:
+            v = max(len(idf.columns[c].vocab), 1)
+            b = 4
+            while b < v:
+                b *= 4
+            buckets.setdefault(b, []).append(c)
+        for b, cols_b in sorted(buckets.items()):
+            C = jnp.stack([idf.columns[c].data for c in cols_b], axis=1)
+            Mc = jnp.stack([idf.columns[c].mask for c in cols_b], axis=1)
+            sw = {kk: np.asarray(v) for kk, v in describe_cat(C, Mc, b).items()}
+            for j, c in enumerate(cols_b):
+                i = cat_cols.index(c)
+                cat_out["count"][i] = sw["count"][j]
+                cat_out["nunique"][i] = sw["nunique"][j]
+                cat_out["mode_code"][i] = sw["mode_code"][j]
+                cat_out["mode_count"][i] = sw["mode_count"][j]
+        if large:
+            # codes are just ints: the sort-based numeric kernel yields
+            # count/nunique/mode directly, no per-vocab lanes
+            C = jnp.stack([idf.columns[c].data for c in large], axis=1)
+            Mc = jnp.stack(
+                [idf.columns[c].mask & (idf.columns[c].data >= 0) for c in large], axis=1
+            )
+            lg = describe_numeric(C, Mc)
+            for j, c in enumerate(large):
+                i = cat_cols.index(c)
+                cat_out["count"][i] = int(lg["count"][j])
+                cat_out["nunique"][i] = int(lg["nunique"][j])
+                mv = float(lg["mode_value"][j])
+                cat_out["mode_code"][i] = int(mv) if mv == mv else -1
+                cat_out["mode_count"][i] = float(lg["mode_count"][j])
+    cache[key] = (num_out, cat_out)
+    return num_out, cat_out
