@@ -59,6 +59,23 @@ def _resolve_files(file_path: str, file_type: str) -> List[str]:
     raise FileNotFoundError(f"no {file_type} files at {file_path}")
 
 
+def shard_files_for_process(files: List[str]) -> List[str]:
+    """Per-host slice of a part-file list for EXPLICIT multi-host ingest.
+
+    Not applied automatically by read_dataset: process-local reads must be
+    assembled into one global array (jax.make_array_from_process_local_data
+    with a globally-agreed row count) before any collective runs, and
+    metadata/stats reads must stay complete on every host.  A multi-host
+    loader should read its slice, all-gather row counts, and build global
+    Tables; until that loader lands, read_dataset is global-per-process.
+    """
+    import jax as _jax
+
+    if _jax.process_count() <= 1:
+        return files
+    return files[_jax.process_index() :: _jax.process_count()]
+
+
 def _coerce_numeric_strings(decoded: dict) -> dict:
     """Schema-inference parity for the decoded-Table path: a string column
     whose every value parses numeric becomes numeric (the pandas route's

@@ -49,14 +49,25 @@ def compare_digitize(X: jax.Array, interior: jax.Array) -> jax.Array:
 
 
 @functools.partial(jax.jit, static_argnames=("nbins",))
+def _binned_histograms_xla(X: jax.Array, M: jax.Array, cutoffs: jax.Array, nbins: int) -> jax.Array:
+    bins = compare_digitize(X, cutoffs)
+    return _flat_counts(bins, M, nbins)
+
+
 def binned_histograms(X: jax.Array, M: jax.Array, cutoffs: jax.Array, nbins: int) -> jax.Array:
     """Numeric columns → per-column bin frequencies in one program.
 
     X/M: (rows, k); cutoffs: (k, nbins-1) interior edges.
     Returns (k, nbins) counts (valid entries only).
+    ``ANOVOS_USE_PALLAS=1`` swaps in the hand-scheduled Pallas kernel
+    (ops/pallas_kernels.py).  The backend choice happens OUTSIDE jit so the
+    env var is honored per call, not baked into a compile cache.
     """
-    bins = compare_digitize(X, cutoffs)
-    return _flat_counts(bins, M, nbins)
+    from anovos_tpu.ops.pallas_kernels import binned_histograms_pallas, use_pallas
+
+    if use_pallas():
+        return binned_histograms_pallas(X, M, cutoffs, nbins)
+    return _binned_histograms_xla(X, M, cutoffs, nbins)
 
 
 @functools.partial(jax.jit, static_argnames=("nbins",))

@@ -389,9 +389,22 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
 
 
 def run(config_path: str, run_type: str = "local", auth_key_val: dict = {}) -> None:
-    """Entry (reference :873-888): load YAML → main."""
+    """Entry (reference :873-888): load YAML → main.
+
+    Tracing: the reference logs per-block wall times only (SURVEY.md §5);
+    here ``ANOVOS_PROFILE=<dir>`` additionally wraps the run in a JAX
+    profiler trace (xprof-compatible) for kernel-level timing.
+    """
     if run_type not in ("local", "emr", "databricks", "ak8s"):
         raise ValueError("Invalid run_type")
     with open(config_path, "r") as f:
         all_configs = yaml.load(f, yaml.SafeLoader)
-    main(all_configs, run_type, auth_key_val)
+    profile_dir = os.environ.get("ANOVOS_PROFILE", "")
+    if profile_dir:
+        import jax
+
+        ctx = jax.profiler.trace(profile_dir)
+    else:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        main(all_configs, run_type, auth_key_val)
