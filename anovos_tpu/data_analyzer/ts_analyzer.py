@@ -19,6 +19,12 @@ import pandas as pd
 from anovos_tpu.shared.table import Table
 from anovos_tpu.shared.utils import ends_with
 
+# the ts_stats.csv schema — shared by eligibility rows and the empty case
+TS_STATS_COLUMNS = [
+    "attribute", "eligible", "reason", "span_days", "distinct_days",
+    "null_pct", "min_ts", "max_ts",
+]
+
 
 def _ts_frame(idf: Table, col: str) -> pd.Series:
     c = idf.columns[col]
@@ -110,4 +116,8 @@ def ts_analyzer(
         rows.append(stats)
         if stats.get("eligible"):
             ts_viz_data(idf, c, output_path, output_type)
-    pd.DataFrame(rows).to_csv(ends_with(output_path) + "ts_stats.csv", index=False)
+    # always emit the same headered schema — a headerless empty CSV breaks
+    # readers and per-run schema drift breaks downstream joins
+    pd.DataFrame(rows).reindex(columns=TS_STATS_COLUMNS).to_csv(
+        ends_with(output_path) + "ts_stats.csv", index=False
+    )

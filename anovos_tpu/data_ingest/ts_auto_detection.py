@@ -48,8 +48,15 @@ def _try_parse_values(values: np.ndarray) -> Tuple[Optional[pd.Series], float]:
     with pd.option_context("mode.chained_assignment", None):
         try:
             parsed = pd.to_datetime(s, errors="coerce", format="mixed")
+            if parsed.dtype == object:  # mixed tz offsets → parse as UTC
+                raise ValueError("mixed offsets")
         except (ValueError, TypeError):
-            return None, 0.0
+            try:
+                parsed = pd.to_datetime(s, errors="coerce", format="mixed", utc=True).dt.tz_localize(None)
+            except (ValueError, TypeError):
+                return None, 0.0
+    if getattr(parsed.dtype, "tz", None) is not None:
+        parsed = parsed.dt.tz_localize(None)
     return parsed, float(parsed.notna().mean())
 
 
@@ -71,6 +78,17 @@ def ts_loop_cols_pre(idf: Table, id_col: Optional[str] = None) -> List[str]:
                 re.search(r"\d{4}-\d{2}-\d{2}", str(v)) for v in vocab[:50]
             ):
                 candidates.append(c)
+                continue
+            # generic probe: a small vocab sample that pandas parses cleanly
+            # (covers e.g. "Tue Apr 03 18:00:09 +0000 2012")
+            sample = pd.Series([str(v) for v in vocab[:20]])
+            if sample.str.len().min() >= 8 and sample.str.contains(r"\d").all():
+                try:
+                    parsed = pd.to_datetime(sample, errors="coerce", format="mixed", utc=True)
+                    if parsed.notna().mean() > 0.9:
+                        candidates.append(c)
+                except (ValueError, TypeError):
+                    pass
         elif col.kind == "num" and col.dtype_name in ("int", "bigint", "long"):
             host = np.asarray(col.data)[: min(idf.nrows, 1000)]
             hmask = np.asarray(col.mask)[: min(idf.nrows, 1000)]

@@ -206,19 +206,27 @@ def anovos_report(
     dr_html += _charts_html(master_path, "drift_", "source vs target distributions")
     tabs.append(("Drift & Stability", dr_html or "<p>no drift stats found</p>"))
 
-    # time-series + geospatial tabs appear when their stats exist
+    # time-series + geospatial tabs appear when their stats have content
+    def _safe_tables(files):
+        html = ""
+        for f in files[:12]:
+            name = os.path.basename(f)[:-4]
+            df = _read_csv(master_path, name)
+            if df is None or df.empty:
+                continue
+            html += _table_html(df, name)
+        return html
+
     ts_files = sorted(glob.glob(ends_with(master_path) + "ts_*.csv"))
     if ts_files:
-        ts_html = "".join(
-            _table_html(pd.read_csv(f), os.path.basename(f)[:-4]) for f in ts_files[:12]
-        )
-        tabs.append(("Time Series", ts_html))
+        ts_html = _safe_tables(ts_files)
+        if ts_html:
+            tabs.append(("Time Series", ts_html))
     geo_files = sorted(glob.glob(ends_with(master_path) + "geospatial_*.csv"))
     if geo_files:
-        geo_html = "".join(
-            _table_html(pd.read_csv(f), os.path.basename(f)[:-4]) for f in geo_files[:12]
-        )
-        tabs.append(("Geospatial", geo_html))
+        geo_html = _safe_tables(geo_files)
+        if geo_html:
+            tabs.append(("Geospatial", geo_html))
 
     nav = "".join(
         f"<button class=\"{'active' if i == 0 else ''}\" onclick='showTab({i})'>{escape(t)}</button>"
