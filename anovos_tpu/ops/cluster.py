@@ -72,9 +72,35 @@ def _neighbor_counts_tile(Xq: jax.Array, Xs: jax.Array, eps2: jax.Array) -> jax.
     return (D <= eps2).sum(axis=1)
 
 
-def dbscan_fit(X: np.ndarray, eps: float, min_samples: int, tile: int = 4096) -> np.ndarray:
-    """DBSCAN labels (−1 = noise).  Neighbor counting runs on device in
-    tiles; the union-find expansion over core points runs on host."""
+@functools.partial(jax.jit, static_argnames=())
+def _min_label_tile(Xq: jax.Array, lab_q: jax.Array, Xs: jax.Array, lab_s: jax.Array, eps2: jax.Array) -> jax.Array:
+    """One propagation step for a query tile: each core point takes the
+    minimum label among its within-eps core neighbors (non-core points carry
+    +inf labels and never propagate)."""
+    D = (Xq**2).sum(1, keepdims=True) - 2 * Xq @ Xs.T + (Xs**2).sum(1)[None, :]
+    nbr = jnp.where(D <= eps2, lab_s[None, :], jnp.inf)
+    return jnp.minimum(lab_q, nbr.min(axis=1))
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _nearest_core_tile(Xq: jax.Array, Xs: jax.Array, eps2: jax.Array):
+    """Nearest within-eps fit-set point per query row: (index, hit)."""
+    D = (Xq**2).sum(1, keepdims=True) - 2 * Xq @ Xs.T + (Xs**2).sum(1)[None, :]
+    Dm = jnp.where(D <= eps2, D, jnp.inf)
+    idx = jnp.argmin(Dm, axis=1)
+    return idx, jnp.isfinite(jnp.take_along_axis(Dm, idx[:, None], axis=1)[:, 0])
+
+
+def dbscan_fit(X: np.ndarray, eps: float, min_samples: int, tile: int = 4096, max_iter: int = 200) -> np.ndarray:
+    """DBSCAN labels (−1 = noise).
+
+    Core-component discovery is min-label propagation over the within-eps
+    core graph: O(n) memory, tiled O(n²) distance sweeps on device per
+    round, converging in graph-diameter rounds (no per-pair host loops, no
+    materialized edge list — a dense cluster's clique would otherwise cost
+    O(E) memory).  Border points adopt their NEAREST within-eps core
+    neighbor's cluster.
+    """
     n = len(X)
     Xd = jnp.asarray(X, jnp.float32)
     eps2 = jnp.asarray(eps * eps, jnp.float32)
@@ -83,43 +109,35 @@ def dbscan_fit(X: np.ndarray, eps: float, min_samples: int, tile: int = 4096) ->
     )
     core = counts >= min_samples
     labels = np.full(n, -1, np.int64)
-    # union-find over core points linked within eps (host; n² in tiles)
-    parent = np.arange(n)
-
-    def find(i):
-        while parent[i] != i:
-            parent[i] = parent[parent[i]]
-            i = parent[i]
-        return i
-
-    for s in range(0, n, tile):
-        D = np.asarray(
-            (Xd[s : s + tile] ** 2).sum(1, keepdims=True) - 2 * Xd[s : s + tile] @ Xd.T + (Xd**2).sum(1)[None, :]
+    core_idx = np.nonzero(core)[0]
+    if len(core_idx) == 0:
+        return labels
+    Xc = Xd[core_idx]
+    m = len(core_idx)
+    lab = jnp.arange(m, dtype=jnp.float32)
+    for _ in range(max_iter):
+        new = jnp.concatenate(
+            [
+                _min_label_tile(Xc[s : s + tile], lab[s : s + tile], Xc, lab, eps2)
+                for s in range(0, m, tile)
+            ]
         )
-        within = D <= float(eps2)
-        for li, i in enumerate(range(s, min(s + tile, n))):
-            if not core[i]:
-                continue
-            for j in np.nonzero(within[li] & core)[0]:
-                ri, rj = find(i), find(int(j))
-                if ri != rj:
-                    parent[rj] = ri
-    roots = {}
-    for i in range(n):
-        if core[i]:
-            r = find(i)
-            if r not in roots:
-                roots[r] = len(roots)
-            labels[i] = roots[r]
-    # border points adopt the cluster of any core neighbor
-    for s in range(0, n, tile):
-        D = np.asarray(
-            (Xd[s : s + tile] ** 2).sum(1, keepdims=True) - 2 * Xd[s : s + tile] @ Xd.T + (Xd**2).sum(1)[None, :]
-        )
-        within = D <= float(eps2)
-        for li, i in enumerate(range(s, min(s + tile, n))):
-            if labels[i] == -1 and counts[i] > 0:
-                nbr_core = np.nonzero(within[li] & core)[0]
-                if len(nbr_core):
-                    labels[i] = labels[nbr_core[0]]
+        if bool(jnp.all(new == lab)):
+            lab = new
+            break
+        lab = new
+    comp = np.unique(np.asarray(lab), return_inverse=True)[1]
+    labels[core_idx] = comp
+    # border points → nearest within-eps core
+    border_idx = np.nonzero(~core)[0]
+    if len(border_idx):
+        Xb = Xd[border_idx]
+        owners, hits = [], []
+        for s in range(0, len(border_idx), tile):
+            o, h = _nearest_core_tile(Xb[s : s + tile], Xc, eps2)
+            owners.append(np.asarray(o))
+            hits.append(np.asarray(h))
+        owner = np.concatenate(owners)
+        hit = np.concatenate(hits)
+        labels[border_idx[hit]] = comp[owner[hit]]
     return labels
