@@ -49,6 +49,17 @@ def _freqs_to_metrics(p: np.ndarray, q: np.ndarray, methods: List[str]) -> dict:
     return out
 
 
+def _drop_allnan_cutoffs(cutoffs: np.ndarray, cols: List[str]):
+    """Drop columns whose every cutoff is NaN (all-null in source) with the
+    reference's warning.  Returns (cutoffs, cols, keep mask)."""
+    cutoffs = np.asarray(cutoffs, np.float64)
+    keep = ~np.isnan(cutoffs).all(axis=1)
+    if not keep.all():
+        dropped = [c for c, k in zip(cols, keep) if not k]
+        warnings.warn("Columns contains too much null values. Dropping " + ", ".join(dropped))
+    return cutoffs[keep], [c for c, k in zip(cols, keep) if k], keep
+
+
 def statistics(
     idf_target: Table,
     idf_source: Optional[Table] = None,
@@ -108,10 +119,18 @@ def statistics(
     count_target = idf_target.nrows
     from anovos_tpu.data_transformer.model_io import load_model_df, save_model_df
     from anovos_tpu.ops.drift_kernels import drift_side_full, fit_cutoffs
+    from anovos_tpu.shared.runtime import get_runtime
+
+    # single-device meshes have no collectives, so the cutoff-fit and both
+    # side programs can be pipelined on device with ONE host sync at the end;
+    # multi-device stays strictly sequential (two collective programs in
+    # flight can interleave their rendezvous — see Table.gather_rows)
+    pipeline_ok = bool(get_runtime().n_devices == 1 and not pre_existing_source and num_cols)
 
     # ---- numeric cutoffs: fit on source (1 kernel) or load the model ------
     num_cols_eff = list(num_cols)
     cutoffs = None
+    cuts_d = None
     if num_cols:
         if pre_existing_source:
             dfm = load_model_df(model_dir, "attribute_binning")
@@ -125,20 +144,8 @@ def statistics(
                 bin_size,
                 bin_method,
             )
-            cutoffs = np.asarray(cuts_d, np.float64)
-            keep = ~np.isnan(cutoffs).all(axis=1)
-            if not keep.all():
-                dropped = [c for c, k in zip(num_cols, keep) if not k]
-                warnings.warn("Columns contains too much null values. Dropping " + ", ".join(dropped))
-            num_cols_eff = [c for c, k in zip(num_cols, keep) if k]
-            cutoffs = cutoffs[keep]
-            save_model_df(
-                pd.DataFrame(
-                    {"attribute": num_cols_eff, "parameters": [list(map(float, c)) for c in cutoffs]}
-                ),
-                model_dir,
-                "attribute_binning",
-            )
+            if not pipeline_ok:
+                cutoffs, num_cols_eff, _ = _drop_allnan_cutoffs(np.asarray(cuts_d), num_cols)
 
     # ---- union vocabularies for categorical columns -----------------------
     union_vocabs: Dict[str, np.ndarray] = {}
@@ -170,7 +177,11 @@ def statistics(
 
     # ---- ONE fused program per dataset side --------------------------------
     n_union = max((len(union_vocabs[c]) for c in cat_cols), default=1)
-    cuts_dev = jnp.asarray(cutoffs, jnp.float32) if num_cols_eff else jnp.zeros((0, bin_size - 1))
+    if pipeline_ok:
+        cuts_dev = cuts_d  # stays on device; NaN rows dropped post-hoc
+        num_cols_eff = list(num_cols)
+    else:
+        cuts_dev = jnp.asarray(cutoffs, jnp.float32) if num_cols_eff else jnp.zeros((0, bin_size - 1))
 
     def _lut_for(idf: Table):
         if not cat_cols:
@@ -183,8 +194,8 @@ def statistics(
                 luts[j, i] = pos[str(v)]
         return jnp.asarray(luts)
 
-    def side(idf: Table):
-        num_h, cat_h = drift_side_full(
+    def side(idf: Table, sync: bool = True):
+        out = drift_side_full(
             tuple(idf.columns[c].data for c in num_cols_eff),
             tuple(idf.columns[c].mask for c in num_cols_eff),
             cuts_dev,
@@ -194,9 +205,32 @@ def statistics(
             bin_size,
             max(n_union, 1),
         )
-        return jax.device_get((num_h, cat_h))
+        return jax.device_get(out) if sync else out
 
-    tgt_num, tgt_cat = side(idf_target)
+    if pipeline_ok:
+        # async dispatch of all three programs, one host sync
+        tgt_pair = side(idf_target, sync=False)
+        src_pair = side(idf_source, sync=False)
+        cutoffs, (tgt_num, tgt_cat), (src_num, src_cat) = jax.device_get(
+            (cuts_dev, tgt_pair, src_pair)
+        )
+        cutoffs, num_cols_eff, keep = _drop_allnan_cutoffs(cutoffs, num_cols_eff)
+        tgt_num = tgt_num[keep]
+        src_num = src_num[keep]
+    else:
+        tgt_num, tgt_cat = side(idf_target)
+        if not pre_existing_source:
+            src_num, src_cat = side(idf_source)
+
+    if not pre_existing_source and cutoffs is not None:
+        save_model_df(
+            pd.DataFrame(
+                {"attribute": num_cols_eff, "parameters": [list(map(float, c)) for c in cutoffs]}
+            ),
+            model_dir,
+            "attribute_binning",
+        )
+
     freq_q: Dict[str, np.ndarray] = {}
     for i, c in enumerate(num_cols_eff):
         freq_q[c] = tgt_num[i] / max(count_target, 1)
@@ -204,7 +238,6 @@ def statistics(
         freq_q[c] = tgt_cat[j][: len(union_vocabs[c])] / max(count_target, 1)
 
     if not pre_existing_source:
-        src_num, src_cat = side(idf_source)
         for i, c in enumerate(num_cols_eff):
             freq_p[c] = src_num[i] / max(idf_source.nrows, 1)
         for j, c in enumerate(cat_cols):

@@ -115,6 +115,7 @@ def dbscan_fit(X: np.ndarray, eps: float, min_samples: int, tile: int = 4096, ma
     Xc = Xd[core_idx]
     m = len(core_idx)
     lab = jnp.arange(m, dtype=jnp.float32)
+    converged = False
     for _ in range(max_iter):
         new = jnp.concatenate(
             [
@@ -122,10 +123,21 @@ def dbscan_fit(X: np.ndarray, eps: float, min_samples: int, tile: int = 4096, ma
                 for s in range(0, m, tile)
             ]
         )
+        # pointer jumping: labels are core indices, so lab[lab] follows the
+        # min-root chain — combined with the neighbor step this converges in
+        # O(log diameter) rounds instead of O(diameter) (a 0.9·eps-spaced
+        # chain would otherwise shed one hop per round)
+        for _ in range(3):
+            new = jnp.minimum(new, new[new.astype(jnp.int32)])
         if bool(jnp.all(new == lab)):
+            converged = True
             lab = new
             break
         lab = new
+    if not converged:
+        import warnings
+
+        warnings.warn(f"dbscan_fit: label propagation hit max_iter={max_iter} without converging")
     comp = np.unique(np.asarray(lab), return_inverse=True)[1]
     labels[core_idx] = comp
     # border points → nearest within-eps core

@@ -55,6 +55,45 @@ def test_sharded_train_step_matches_single_device():
         np.testing.assert_allclose(a, b, atol=2e-5 * scale + 1e-7, rtol=2e-3)
 
 
+def test_drift_pipeline_path_matches_multidevice(tmp_path):
+    """The single-device drift fast path (async-pipelined programs,
+    device-resident cutoffs, post-hoc NaN drop) must equal the sequential
+    multi-device path — including a column that's all-null in the source."""
+    import pandas as pd
+
+    from anovos_tpu.drift_stability import statistics
+    from anovos_tpu.shared.runtime import init_runtime
+    from anovos_tpu.shared.table import Table
+
+    g = np.random.default_rng(9)
+    n = 8000
+    src = pd.DataFrame(
+        {"a": g.normal(0, 1, n), "b": g.normal(5, 2, n), "dead": np.full(n, np.nan), "c": g.choice(["x", "y"], n)}
+    )
+    tgt = pd.DataFrame(
+        {"a": g.normal(0.8, 1, n), "b": g.normal(5, 2, n), "dead": np.full(n, np.nan), "c": g.choice(["x", "y"], n, p=[0.8, 0.2])}
+    )
+    out8 = statistics(
+        Table.from_pandas(tgt), Table.from_pandas(src), method_type="all",
+        use_sampling=False, source_path=str(tmp_path / "m8"),
+    )
+    init_runtime(devices=jax.devices()[:1])
+    try:
+        out1 = statistics(
+            Table.from_pandas(tgt), Table.from_pandas(src), method_type="all",
+            use_sampling=False, source_path=str(tmp_path / "m1"),
+        )
+    finally:
+        init_runtime()
+    import pandas.testing as pdt
+
+    pdt.assert_frame_equal(
+        out8.sort_values("attribute").reset_index(drop=True),
+        out1.sort_values("attribute").reset_index(drop=True),
+    )
+    assert "dead" not in set(out1["attribute"])  # all-null column dropped on both paths
+
+
 def test_sharded_stats_match_single_device(income_df):
     """The whole stats path on the 8-device mesh equals pandas on host —
     already covered elsewhere — here: DP sharding leaves results identical
