@@ -87,12 +87,100 @@ def ts_viz_data(
     feats = feats.dropna(subset=[col])
     daily = feats.groupby("yyyymmdd_col").size().reset_index(name="count")
     daily.to_csv(ends_with(output_path) + f"ts_daily_{col}.csv", index=False)
+    # seasonal decomposition + stationarity of the daily count series
+    dec = seasonal_decompose_ma(daily["count"].to_numpy(), period=7)
+    if dec is not None:
+        trend, seas, resid = dec
+        pd.DataFrame(
+            {
+                "date": daily["yyyymmdd_col"],
+                "observed": daily["count"],
+                "trend": np.round(trend, 4),
+                "seasonal": np.round(seas, 4),
+                "residual": np.round(resid, 4),
+            }
+        ).to_csv(ends_with(output_path) + f"ts_decompose_{col}.csv", index=False)
+    adf = adf_test(daily["count"].to_numpy())
+    if adf is not None:
+        pd.DataFrame([{"attribute": col, **adf}]).to_csv(
+            ends_with(output_path) + f"ts_stationarity_{col}.csv", index=False
+        )
     hourly = feats.groupby("hour").size().reset_index(name="count")
     hourly.to_csv(ends_with(output_path) + f"ts_hourly_{col}.csv", index=False)
     weekly = feats.groupby("dayofweek").size().reset_index(name="count")
     weekly.to_csv(ends_with(output_path) + f"ts_weekly_{col}.csv", index=False)
     dayparts = feats.groupby("daypart").size().reset_index(name="count")
     dayparts.to_csv(ends_with(output_path) + f"ts_daypart_{col}.csv", index=False)
+
+
+def seasonal_decompose_ma(series: np.ndarray, period: int = 7):
+    """Additive moving-average decomposition (the statsmodels
+    seasonal_decompose recipe the reference's report uses — statsmodels
+    itself is optional here): centered-MA trend, mean-by-phase seasonal,
+    residual."""
+    y = np.asarray(series, float)
+    n = len(y)
+    if n < 2 * period:
+        return None
+    kernel = np.ones(period) / period
+    if period % 2 == 0:  # centered MA for even periods
+        kernel = np.concatenate([[0.5], np.ones(period - 1), [0.5]]) / period
+    trend = np.convolve(y, kernel, mode="same")
+    half = len(kernel) // 2
+    trend[:half] = np.nan
+    trend[n - half :] = np.nan
+    detr = y - trend
+    seasonal = np.array([np.nanmean(detr[p::period]) for p in range(period)])
+    seasonal = seasonal - np.nanmean(seasonal)
+    seas_full = np.tile(seasonal, n // period + 1)[:n]
+    resid = y - trend - seas_full
+    return trend, seas_full, resid
+
+
+def adf_test(series: np.ndarray, max_lag: int = None):
+    """Augmented Dickey-Fuller t-statistic (constant-only regression) with
+    MacKinnon critical values — the stationarity check the reference's
+    report runs via statsmodels.adfuller."""
+    y = np.asarray(series, float)
+    y = y[~np.isnan(y)]
+    n = len(y)
+    if n < 10:
+        return None
+    if np.allclose(y, y[0]):
+        # constant series: the level/intercept regressors are collinear and
+        # the degenerate t-stat would misreport maximal stationarity as
+        # non-stationary (statsmodels raises here); report stationary
+        return {"adf_stat": float("-inf"), "stationary_1%": 1, "stationary_5%": 1, "stationary_10%": 1}
+    if max_lag is None:
+        max_lag = min(int(np.ceil(12 * (n / 100) ** 0.25)), n // 2 - 2)
+    dy = np.diff(y)
+    best = None
+    lag = max_lag
+    while lag >= 0:
+        rows = len(dy) - lag
+        if rows < 5 + lag:
+            lag -= 1
+            continue
+        Xcols = [y[lag : lag + rows], np.ones(rows)]
+        for i in range(1, lag + 1):
+            Xcols.append(dy[lag - i : lag - i + rows])
+        Xm = np.column_stack(Xcols)
+        target = dy[lag : lag + rows]
+        beta, res, rank, _ = np.linalg.lstsq(Xm, target, rcond=None)
+        resid = target - Xm @ beta
+        dof = rows - Xm.shape[1]
+        if dof <= 0:
+            lag -= 1
+            continue
+        sigma2 = resid @ resid / dof
+        cov = sigma2 * np.linalg.pinv(Xm.T @ Xm)
+        se = np.sqrt(max(cov[0, 0], 1e-300))
+        best = float(beta[0] / se)
+        break
+    if best is None:
+        return None
+    crit = {"1%": -3.43, "5%": -2.86, "10%": -2.57}
+    return {"adf_stat": round(best, 4), **{f"stationary_{k}": int(best < v) for k, v in crit.items()}}
 
 
 def ts_analyzer(

@@ -64,15 +64,22 @@ def _emit_ts(idf: Table, name: str, s: pd.Series, output_mode: str, postfix: str
 # conversions (:126-549)
 # ----------------------------------------------------------------------
 def timestamp_to_unix(idf: Table, list_of_cols, precision: str = "s", tz: str = "local", output_mode: str = "replace") -> Table:
+    """Seconds precision stays exact int32 (float32 storage would quantize
+    2023-era epochs by ~2 minutes); millisecond precision is float with
+    documented sub-second loss."""
     argument_checker("timestamp_to_unix", {"output_mode": output_mode})
     odf = idf
     for c in _cols(list_of_cols):
         col = idf.columns[c]
-        secs = np.asarray(col.data)[: idf.nrows].astype("int64")
-        mask = np.asarray(col.mask)[: idf.nrows]
-        vals = (secs * (1000 if precision == "ms" else 1)).astype("float64")
-        vals[~mask] = np.nan
-        odf = _emit_host(odf, c, vals, output_mode, "_unix")
+        if precision == "s":
+            new = Column("num", col.data, col.mask, dtype_name="int")
+            odf = odf.with_column(c if output_mode == "replace" else c + "_unix", new)
+        else:
+            secs = np.asarray(col.data)[: idf.nrows].astype("int64")
+            mask = np.asarray(col.mask)[: idf.nrows]
+            vals = (secs * 1000).astype("float64")
+            vals[~mask] = np.nan
+            odf = _emit_host(odf, c, vals, output_mode, "_unix")
     return odf
 
 
@@ -422,6 +429,9 @@ def lagged_ts(
             odf = _emit_host(odf, name, lagged, "append", "")
         else:  # ts_diff
             div = _UNITS_SECONDS.get(tsdiff_unit.rstrip("s") if tsdiff_unit not in _UNITS_SECONDS else tsdiff_unit, 86400)
-            diff = (s.to_numpy().astype("datetime64[s]") - lagged).astype("timedelta64[s]").astype(float) / div
+            cur = s.to_numpy().astype("datetime64[s]")
+            delta = (cur - lagged).astype("timedelta64[s]")
+            diff = delta.astype(float) / div
+            diff[np.isnat(cur) | np.isnat(lagged)] = np.nan  # NaT casts to int64-min, not NaN
             odf = _emit_host(odf, name + "_diff", diff, "append", "")
     return odf

@@ -53,6 +53,10 @@ def test_ts_analyzer(ts_table, tmp_path):
     assert stats.set_index("attribute").loc["ts", "eligible"] == 1
     hourly = pd.read_csv(tmp_path / "ts_hourly_ts.csv")
     assert hourly["count"].sum() == 1000
+    dec = pd.read_csv(tmp_path / "ts_decompose_ts.csv")
+    assert {"observed", "trend", "seasonal", "residual"} <= set(dec.columns)
+    stat = pd.read_csv(tmp_path / "ts_stationarity_ts.csv")
+    assert "adf_stat" in stat.columns and len(stat) == 1
 
 
 def test_datetime_transforms(ts_table):

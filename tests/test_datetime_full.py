@@ -1,0 +1,81 @@
+"""Calendar predicate / aggregation coverage for the datetime surface."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from anovos_tpu.data_transformer import datetime as dtm
+from anovos_tpu.shared.table import Table
+
+
+@pytest.fixture(scope="module")
+def cal_t():
+    ts = pd.to_datetime(
+        [
+            "2023-01-01 00:00:00",  # year+month+quarter start, weekend
+            "2023-03-31 23:00:00",  # month+quarter end
+            "2024-02-29 12:30:00",  # leap year
+            "2023-06-15 03:00:00",  # first half, late_hours
+            "2023-12-31 18:00:00",  # year end, weekend
+        ]
+    )
+    return Table.from_pandas(pd.DataFrame({"ts": ts, "v": [1.0, 2.0, 3.0, 4.0, 5.0]}))
+
+
+def _col(t, name):
+    return t.to_pandas()[name].tolist()
+
+
+def test_month_year_quarter_predicates(cal_t):
+    assert _col(dtm.is_monthStart(cal_t, ["ts"]), "ts_ismonthStart") == [1, 0, 0, 0, 0]
+    assert _col(dtm.is_monthEnd(cal_t, ["ts"]), "ts_ismonthEnd") == [0, 1, 1, 0, 1]
+    assert _col(dtm.is_yearStart(cal_t, ["ts"]), "ts_isyearStart") == [1, 0, 0, 0, 0]
+    assert _col(dtm.is_yearEnd(cal_t, ["ts"]), "ts_isyearEnd") == [0, 0, 0, 0, 1]
+    assert _col(dtm.is_quarterStart(cal_t, ["ts"]), "ts_isquarterStart") == [1, 0, 0, 0, 0]
+    assert _col(dtm.is_quarterEnd(cal_t, ["ts"]), "ts_isquarterEnd") == [0, 1, 0, 0, 1]
+    assert _col(dtm.is_leapYear(cal_t, ["ts"]), "ts_isleapYear") == [0, 0, 1, 0, 0]
+    assert _col(dtm.is_weekend(cal_t, ["ts"]), "ts_isweekend") == [1, 0, 0, 0, 1]
+    assert _col(dtm.is_yearFirstHalf(cal_t, ["ts"]), "ts_isFirstHalf") == [1, 1, 1, 1, 0]
+    assert _col(dtm.is_selectedHour(cal_t, ["ts"], 22, 4), "ts_isselectedHour") == [1, 1, 0, 1, 0]
+
+
+def test_boundary_snapping(cal_t):
+    ms = dtm.start_of_month(cal_t, ["ts"], output_mode="append").to_pandas()["ts_monthStart"]
+    assert ms.dt.day.eq(1).all() and ms.dt.hour.eq(0).all()
+    me = dtm.end_of_month(cal_t, ["ts"], output_mode="append").to_pandas()["ts_monthEnd"]
+    assert me.iloc[0] == pd.Timestamp("2023-01-31")
+    ye = dtm.end_of_year(cal_t, ["ts"], output_mode="append").to_pandas()["ts_yearEnd"]
+    assert (ye.dt.month.eq(12) & ye.dt.day.eq(31)).all()
+    qs = dtm.start_of_quarter(cal_t, ["ts"], output_mode="append").to_pandas()["ts_quarterStart"]
+    assert qs.iloc[3] == pd.Timestamp("2023-04-01")
+
+
+def test_unix_roundtrip_and_comparison(cal_t):
+    u = dtm.timestamp_to_unix(cal_t, ["ts"], output_mode="append").to_pandas()["ts_unix"]
+    assert u.iloc[0] == pd.Timestamp("2023-01-01").timestamp()
+    t2 = dtm.unix_to_timestamp(Table.from_pandas(pd.DataFrame({"u": u})), ["u"]).to_pandas()["u"]
+    assert t2.iloc[2] == pd.Timestamp("2024-02-29 12:30:00")
+    cmp = dtm.timestamp_comparison(
+        cal_t, ["ts"], comparison_type="greater_than", comparison_value="2023-07-01"
+    ).to_pandas()["ts_comparison"]
+    assert cmp.tolist() == [0, 0, 1, 0, 1]
+
+
+def test_string_conversions():
+    t = Table.from_pandas(pd.DataFrame({"d": ["2023-01-05", "2023-02-10", None]}))
+    out = dtm.string_to_timestamp(t, ["d"], input_format="%Y-%m-%d").to_pandas()["d"]
+    assert out.iloc[0] == pd.Timestamp("2023-01-05") and pd.isna(out.iloc[2])
+    t2 = Table.from_pandas(pd.DataFrame({"d": ["2023-01-05", "2023-02-10"]}))
+    fmt = dtm.dateformat_conversion(t2, ["d"], "%Y-%m-%d", "%d/%m/%Y").to_pandas()["d"]
+    assert fmt.tolist() == ["05/01/2023", "10/02/2023"]
+
+
+def test_window_and_lag():
+    ts = pd.date_range("2023-01-01", periods=8, freq="D")
+    t = Table.from_pandas(pd.DataFrame({"ts": ts, "v": np.arange(8.0)}))
+    w = dtm.window_aggregator(t, ["v"], ["mean"], "ts", window_type="rolling", window_size=2)
+    roll = w.to_pandas()["v_mean_rolling"]
+    np.testing.assert_allclose(roll.iloc[1:].to_numpy(), np.arange(8.0)[1:] - 0.5)
+    lg = dtm.lagged_ts(t, ["ts"], lag=1, output_type="ts_diff", tsdiff_unit="days").to_pandas()
+    np.testing.assert_allclose(lg["ts_lag1_diff"].iloc[1:].to_numpy(), 1.0)
+    assert np.isnan(lg["ts_lag1_diff"].iloc[0])
