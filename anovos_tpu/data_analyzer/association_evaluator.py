@@ -255,7 +255,23 @@ def variable_clustering(
     sub = imputation_MMM(sub, list_of_cols="missing", method_type="mean")
     Xn, Mn = sub.numeric_block(cols)
     row_ok = Mn.all(axis=1, keepdims=True)
-    C = np.asarray(masked_corr(Xn, Mn & row_ok))
+    C = np.asarray(masked_corr(Xn, Mn & row_ok), dtype=np.float64)
+    # harden for eigendecomposition: f32 device numerics can leave NaNs for
+    # near-constant columns (zero-variance denominators) and tiny asymmetry;
+    # either makes eigh fail to converge.  masked_corr pins the diagonal to
+    # 1.0, so degeneracy shows as all-NaN OFF-diagonal rows.
+    offdiag_nan = (~np.isfinite(C)).sum(axis=1) >= max(len(cols) - 1, 1)
+    if offdiag_nan.any() and len(cols) > 1:
+        warnings.warn(
+            "variable_clustering: dropping degenerate column(s) "
+            + ",".join(c for c, bad in zip(cols, offdiag_nan) if bad)
+        )
+        keepm = ~offdiag_nan
+        cols = [c for c, k in zip(cols, keepm) if k]
+        C = C[np.ix_(keepm, keepm)]
+    C = np.where(np.isfinite(C), C, 0.0)
+    C = (C + C.T) / 2.0
+    np.fill_diagonal(C, 1.0)
     corr_df = pd.DataFrame(C, columns=cols, index=cols)
     vc = VarClusJax(corr_df, maxeigval2=1.0, maxclus=None).fit()
     rs = vc.rsquare_table()

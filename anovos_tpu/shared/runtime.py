@@ -86,6 +86,13 @@ def init_runtime(
     (multi-host over DCN; env-driven coordinator discovery).
     """
     global _RUNTIME
+    cache_dir = os.environ.get("ANOVOS_COMPILE_CACHE", "")
+    if cache_dir:
+        # persistent XLA compilation cache: pipeline stages produce many
+        # distinct table shapes, and on remote backends compilation dominates
+        # cold-run wall time
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     if distributed and jax.process_count() == 1 and "JAX_COORDINATOR_ADDRESS" in os.environ:
         jax.distributed.initialize()
     devs = list(devices if devices is not None else jax.devices())
