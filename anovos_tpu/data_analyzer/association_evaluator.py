@@ -269,6 +269,9 @@ def variable_clustering(
         keepm = ~offdiag_nan
         cols = [c for c, k in zip(cols, keepm) if k]
         C = C[np.ix_(keepm, keepm)]
+    if not cols:
+        warnings.warn("variable_clustering: no usable columns after degeneracy drop")
+        return pd.DataFrame(columns=["Cluster", "Attribute", "RS_Ratio"])
     C = np.where(np.isfinite(C), C, 0.0)
     C = (C + C.T) / 2.0
     np.fill_diagonal(C, 1.0)

@@ -1,0 +1,98 @@
+"""Report-layer contract tests: file naming, chart JSON schema, HTML assembly."""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from anovos_tpu.data_report.basic_report_generation import anovos_basic_report
+from anovos_tpu.data_report.report_generation import anovos_report
+from anovos_tpu.data_report.report_preprocessing import charts_to_objects, save_stats
+from anovos_tpu.shared.table import Table
+
+
+@pytest.fixture(scope="module")
+def rep_table():
+    g = np.random.default_rng(5)
+    n = 3000
+    return Table.from_pandas(
+        pd.DataFrame(
+            {
+                "num1": g.normal(50, 10, n),
+                "num2": g.exponential(5, n),
+                "cat1": g.choice(["a", "b", "c"], n, p=[0.5, 0.3, 0.2]),
+                "label": g.choice(["yes", "no"], n, p=[0.3, 0.7]),
+            }
+        )
+    )
+
+
+def test_save_stats_contract(tmp_path):
+    df = pd.DataFrame({"attribute": ["x"], "missing_count": [0]})
+    out = save_stats(df, str(tmp_path), "missingCount_computation", reread=True)
+    assert (tmp_path / "missingCount_computation.csv").exists()
+    pd.testing.assert_frame_equal(out, df)
+
+
+def test_charts_to_objects_contract(rep_table, tmp_path):
+    charts_to_objects(
+        rep_table, label_col="label", event_label="yes", master_path=str(tmp_path), bin_size=10
+    )
+    # file naming contract (reference report_preprocessing.py:634-710)
+    for prefix, col in [("freqDist_", "num1"), ("freqDist_", "cat1"), ("eventDist_", "num1")]:
+        path = tmp_path / f"{prefix}{col}"
+        assert path.exists(), f"{prefix}{col} missing"
+        fig = json.loads(path.read_text())
+        assert fig["data"][0]["type"] == "bar"
+        assert len(fig["data"][0]["x"]) == len(fig["data"][0]["y"])
+    dt = pd.read_csv(tmp_path / "data_type.csv")
+    assert list(dt.columns) == ["attribute", "data_type"]
+    assert set(dt["attribute"]) == {"num1", "num2", "cat1", "label"}
+    # numeric freq counts must total the row count
+    fig = json.loads((tmp_path / "freqDist_num1").read_text())
+    assert sum(fig["data"][0]["y"]) == rep_table.nrows
+    # event rates are probabilities
+    ev = json.loads((tmp_path / "eventDist_cat1").read_text())
+    assert all(0 <= v <= 1 for v in ev["data"][0]["y"])
+
+
+def test_full_report_html(rep_table, tmp_path):
+    from anovos_tpu.data_analyzer import stats_generator as sg
+
+    save_stats(sg.global_summary(rep_table), str(tmp_path), "global_summary")
+    save_stats(sg.measures_of_counts(rep_table), str(tmp_path), "measures_of_counts")
+    charts_to_objects(rep_table, master_path=str(tmp_path))
+    out = anovos_report(master_path=str(tmp_path), final_report_path=str(tmp_path), label_col="label")
+    html = open(out).read()
+    assert "Executive Summary" in html and "Descriptive Statistics" in html
+    assert html.count("<section") >= 6
+    assert "Plotly.newPlot" in html
+    # XSS guard: no raw </script> can appear inside embedded chart JSON
+    assert "</script><script>alert" not in html
+
+
+def test_hostile_category_values_cannot_break_report(tmp_path):
+    """Data values containing '</script>' must not terminate the embedding
+    script tag (stored-XSS guard in report_generation)."""
+    t = Table.from_pandas(
+        pd.DataFrame({"c": ["</script><script>alert(1)</script>", "ok", "ok"], "v": [1.0, 2.0, 3.0]})
+    )
+    charts_to_objects(t, master_path=str(tmp_path))
+    out = anovos_report(master_path=str(tmp_path), final_report_path=str(tmp_path))
+    html = open(out).read()
+    assert "</script><script>alert" not in html
+    assert "<\\/script>" in html  # escaped form present instead
+
+
+def test_basic_report_end_to_end(rep_table, tmp_path):
+    out = anovos_basic_report(
+        rep_table, label_col="label", event_label="yes", output_path=str(tmp_path / "rs")
+    )
+    assert os.path.exists(out)
+    rs = tmp_path / "rs"
+    for f in ("global_summary.csv", "measures_of_counts.csv", "IV_calculation.csv", "duplicate_detection.csv"):
+        assert (rs / f).exists(), f
+    iv = pd.read_csv(rs / "IV_calculation.csv")
+    assert "label" not in set(iv["attribute"])  # label itself excluded
