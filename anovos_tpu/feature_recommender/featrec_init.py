@@ -18,7 +18,7 @@ import pandas as pd
 # feature_recommender/data); FR_CORPUS_PATH overrides for custom corpora
 _DEFAULT_CORPUS_PATHS = [
     os.environ.get("FR_CORPUS_PATH", ""),
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), "data", "flatten_fr_db.csv"),
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "data", "corpus.jsonl"),
 ]
 
 _MODEL = None
@@ -78,11 +78,11 @@ def load_corpus(corpus_path: Optional[str] = None) -> pd.DataFrame:
     paths = [corpus_path] if corpus_path else _DEFAULT_CORPUS_PATHS
     for p in paths:
         if p and os.path.exists(p):
-            df = pd.read_csv(p)
+            df = pd.read_json(p, lines=True) if p.endswith(".jsonl") else pd.read_csv(p)
             df.columns = [c.strip() for c in df.columns]
             return df
     raise FileNotFoundError(
-        "feature recommender corpus not found; pass corpus_path or place flatten_fr_db.csv under feature_recommender/data/"
+        "feature recommender corpus not found; pass corpus_path (csv or jsonl) or place corpus.jsonl under feature_recommender/data/"
     )
 
 
