@@ -23,6 +23,34 @@ import jax
 import jax.numpy as jnp
 
 
+def finalize_moments(n, s1, m2, m3, m4, cmin, cmax, nonzero) -> Dict[str, jax.Array]:
+    """Shared finalizer: globally-reduced power sums → the moments dict.
+    Used by both the GSPMD kernel below and the explicit shard_map variant
+    (parallel/collectives.py) so their statistical policies cannot drift."""
+    safe_n = jnp.maximum(n, 1.0)
+    mean = s1 / safe_n
+    var_samp = m2 / jnp.maximum(n - 1.0, 1.0)
+    std = jnp.sqrt(var_samp)
+    # population central moments for shape stats (Spark F.skewness/F.kurtosis)
+    m2p = m2 / safe_n
+    skew = jnp.where(m2p > 0, (m3 / safe_n) / jnp.power(jnp.maximum(m2p, 1e-38), 1.5), jnp.nan)
+    kurt = jnp.where(m2p > 0, (m4 / safe_n) / jnp.maximum(m2p * m2p, 1e-38) - 3.0, jnp.nan)
+    empty = n == 0
+    nanv = jnp.asarray(jnp.nan, s1.dtype)
+    return {
+        "count": n,
+        "sum": s1,
+        "mean": jnp.where(empty, nanv, mean),
+        "variance": jnp.where(n > 1, var_samp, nanv),
+        "stddev": jnp.where(n > 1, std, nanv),
+        "skewness": jnp.where(empty, nanv, skew),
+        "kurtosis": jnp.where(empty, nanv, kurt),
+        "min": jnp.where(empty, nanv, cmin),
+        "max": jnp.where(empty, nanv, cmax),
+        "nonzero": nonzero,
+    }
+
+
 @jax.jit
 def masked_moments(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
     """All central moments per column of a masked block.
@@ -36,38 +64,18 @@ def masked_moments(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
     Xf = X.astype(dt)
     Mf = M.astype(dt)
     n = Mf.sum(axis=0)
-    safe_n = jnp.maximum(n, 1.0)
     s1 = jnp.where(M, Xf, 0).sum(axis=0)
-    mean = s1 / safe_n
+    mean = s1 / jnp.maximum(n, 1.0)
     d = jnp.where(M, Xf - mean, 0)
     d2 = d * d
     m2 = d2.sum(axis=0)
     m3 = (d2 * d).sum(axis=0)
     m4 = (d2 * d2).sum(axis=0)
-    var_samp = m2 / jnp.maximum(n - 1.0, 1.0)
-    std = jnp.sqrt(var_samp)
-    # population central moments for shape stats
-    m2p = m2 / safe_n
-    skew = jnp.where(m2p > 0, (m3 / safe_n) / jnp.power(jnp.maximum(m2p, 1e-38), 1.5), jnp.nan)
-    kurt = jnp.where(m2p > 0, (m4 / safe_n) / jnp.maximum(m2p * m2p, 1e-38) - 3.0, jnp.nan)
     big = jnp.asarray(jnp.finfo(dt).max, dt)
     cmin = jnp.where(M, Xf, big).min(axis=0)
     cmax = jnp.where(M, Xf, -big).max(axis=0)
     nonzero = (M & (Xf != 0)).sum(axis=0).astype(dt)
-    empty = n == 0
-    nanv = jnp.asarray(jnp.nan, dt)
-    return {
-        "count": n,
-        "sum": s1,
-        "mean": jnp.where(empty, nanv, mean),
-        "variance": jnp.where(n > 1, var_samp, nanv),
-        "stddev": jnp.where(n > 1, std, nanv),
-        "skewness": jnp.where(empty, nanv, skew),
-        "kurtosis": jnp.where(empty, nanv, kurt),
-        "min": jnp.where(empty, nanv, cmin),
-        "max": jnp.where(empty, nanv, cmax),
-        "nonzero": nonzero,
-    }
+    return finalize_moments(n, s1, m2, m3, m4, cmin, cmax, nonzero)
 
 
 @jax.jit

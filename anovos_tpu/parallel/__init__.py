@@ -9,3 +9,4 @@ the few ops that want manual collectives.
 """
 
 from anovos_tpu.parallel.mesh import make_mesh, data_sharding, replicated_sharding  # noqa: F401
+from anovos_tpu.parallel.collectives import masked_moments_shmap  # noqa: F401
