@@ -59,6 +59,18 @@ def _fill_count(idf: Table, col: str, num_out, cat_out, ni, ci) -> int:
     return int(np.asarray(c.mask).sum())  # ts/other columns: direct mask sum
 
 
+def _stacked_valid_mask(idf: Table, cols: List[str]) -> "jnp.ndarray":
+    """(rows, k) validity with categorical null-code semantics — THE null
+    rule, shared by every consumer so it lives in exactly one place."""
+    return jnp.stack(
+        [
+            idf.columns[c].mask & ((idf.columns[c].data >= 0) if idf.columns[c].kind == "cat" else True)
+            for c in cols
+        ],
+        axis=1,
+    )
+
+
 def _fill_counts_light(idf: Table, cols: List[str]) -> np.ndarray:
     """Count-only path: ONE stacked mask reduction.  Used by the count
     metrics so a standalone missingCount call doesn't pay the full fused
@@ -71,13 +83,7 @@ def _fill_counts_light(idf: Table, cols: List[str]) -> np.ndarray:
         ci = {c: i for i, c in enumerate(cat_all)}
         if all(c in ni or c in ci for c in cols):
             return np.array([_fill_count(idf, c, num_out, cat_out, ni, ci) for c in cols])
-    M = jnp.stack(
-        [
-            idf.columns[c].mask & ((idf.columns[c].data >= 0) if idf.columns[c].kind == "cat" else True)
-            for c in cols
-        ],
-        axis=1,
-    )
+    M = _stacked_valid_mask(idf, cols)
     return np.asarray(M.sum(axis=0, dtype=jnp.int32)).astype(np.int64)
 
 
@@ -258,10 +264,18 @@ def measures_of_centralTendency(
 
 
 def uniqueCount_computation(
-    idf: Table, list_of_cols="all", drop_cols=[], print_impact=False, **_ignored
+    idf: Table,
+    list_of_cols="all",
+    drop_cols=[],
+    compute_approx_unique_count: bool = False,
+    rsd: float = 0.05,
+    print_impact=False,
+    **_ignored,
 ) -> pd.DataFrame:
     """[attribute, unique_values] (reference :529-620).  Exact distinct via
-    the shared device sort; the HLL approx path is unnecessary."""
+    the shared device sort by default; ``compute_approx_unique_count=True``
+    uses the HLL sketch (ops/hll.py) at the requested ``rsd`` — O(k·2^p)
+    memory regardless of rows, the approx_count_distinct parity path."""
     num_all, cat_all, _ = idf.attribute_type_segregation()
     cols = parse_cols(
         list_of_cols if list_of_cols != "all" else num_all + cat_all, idf.col_names, drop_cols
@@ -272,10 +286,31 @@ def uniqueCount_computation(
 
         warnings.warn("No Unique Count Computation - No discrete column(s) to analyze")
         return pd.DataFrame(columns=["attribute", "unique_values"])
-    num_out, cat_out, ni, ci = _desc(idf)
-    nu = np.array(
-        [num_out["nunique"][ni[c]] if c in ni else cat_out["nunique"][ci[c]] for c in cols]
-    ).astype(np.int64)
+    if rsd is None:
+        rsd = 0.05
+    if rsd <= 0:
+        raise ValueError("rsd value can not be less than 0 (default value is 0.05)")
+    if compute_approx_unique_count:
+        from anovos_tpu.ops.hll import approx_nunique
+
+        # stack as exact int32 bit patterns — casting int columns (e.g. 1e9
+        # ids) to float32 would collapse ~64 consecutive values into one
+        X = jnp.stack(
+            [
+                (idf.columns[c].data + 0.0).view(jnp.int32)
+                if idf.columns[c].data.dtype == jnp.float32
+                else idf.columns[c].data.astype(jnp.int32)
+                for c in cols
+            ],
+            1,
+        )
+        M = _stacked_valid_mask(idf, cols)
+        nu = np.round(approx_nunique(X, M, rsd)).astype(np.int64)
+    else:
+        num_out, cat_out, ni, ci = _desc(idf)
+        nu = np.array(
+            [num_out["nunique"][ni[c]] if c in ni else cat_out["nunique"][ci[c]] for c in cols]
+        ).astype(np.int64)
     odf = pd.DataFrame({"attribute": cols, "unique_values": nu})
     if print_impact:
         print(odf.to_string(index=False))
@@ -283,11 +318,20 @@ def uniqueCount_computation(
 
 
 def measures_of_cardinality(
-    idf: Table, list_of_cols="all", drop_cols=[], print_impact=False, **_ignored
+    idf: Table,
+    list_of_cols="all",
+    drop_cols=[],
+    use_approx_unique_count: bool = False,
+    rsd: float = 0.05,
+    print_impact=False,
+    **_ignored,
 ) -> pd.DataFrame:
     """[attribute, unique_values, IDness]; IDness = unique/(rows − missing)
-    (reference :623-733)."""
-    uc = uniqueCount_computation(idf, list_of_cols, drop_cols)
+    (reference :623-733; the approx knobs forward to the HLL path)."""
+    uc = uniqueCount_computation(
+        idf, list_of_cols, drop_cols,
+        compute_approx_unique_count=use_approx_unique_count, rsd=rsd,
+    )
     if uc.empty:
         return pd.DataFrame(columns=["attribute", "unique_values", "IDness"])
     mc = missingCount_computation(idf, list(uc["attribute"]))

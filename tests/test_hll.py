@@ -1,0 +1,85 @@
+"""HyperLogLog sketch accuracy vs exact distinct counts."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from anovos_tpu.ops.hll import approx_nunique, hll_registers, precision_for_rsd
+from anovos_tpu.shared.table import Table
+
+
+def test_precision_for_rsd():
+    assert precision_for_rsd(0.05) == 9  # 1.04/sqrt(512) ≈ 0.046
+    assert precision_for_rsd(0.01) >= 14
+    assert precision_for_rsd(0.3) == 4
+
+
+@pytest.mark.parametrize("true_n", [50, 1000, 20000])
+def test_hll_accuracy(true_n):
+    g = np.random.default_rng(true_n)
+    rows = 60000
+    vals = g.integers(0, true_n, rows).astype(np.float32)  # ~true_n distinct
+    X = jnp.asarray(vals[:, None])
+    M = jnp.ones((rows, 1), bool)
+    est = approx_nunique(X, M, rsd=0.05)[0]
+    exact = len(np.unique(vals))
+    assert abs(est - exact) / exact < 0.15, (est, exact)
+
+
+def test_hll_mergeable():
+    """Registers merge by elementwise max (multi-host combine property)."""
+    g = np.random.default_rng(0)
+    a = jnp.asarray(g.integers(0, 5000, (30000, 1)).astype(np.float32))
+    b = jnp.asarray(g.integers(2500, 7500, (30000, 1)).astype(np.float32))
+    m = jnp.ones((30000, 1), bool)
+    p = 9
+    ra = np.asarray(hll_registers(a, m, p))
+    rb = np.asarray(hll_registers(b, m, p))
+    from anovos_tpu.ops.hll import hll_estimate
+
+    merged = hll_estimate(np.maximum(ra, rb))[0]
+    exact = len(np.unique(np.concatenate([np.asarray(a), np.asarray(b)])))
+    assert abs(merged - exact) / exact < 0.15, (merged, exact)
+
+
+def test_hll_large_integer_ids():
+    """1e9-scale int ids must not collapse (float32 spacing there is 64)."""
+    g = np.random.default_rng(4)
+    ids = g.integers(1_000_000_000, 1_000_020_000, 40000).astype(np.int32)
+    X = jnp.asarray(ids[:, None])
+    M = jnp.ones((40000, 1), bool)
+    est = approx_nunique(X, M, rsd=0.05)[0]
+    exact = len(np.unique(ids))
+    assert abs(est - exact) / exact < 0.15, (est, exact)
+
+
+def test_rsd_clamp_warns():
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert precision_for_rsd(0.001) == 16
+    assert any("clamped" in str(x.message) for x in w)
+
+
+def test_unique_count_approx_path():
+    from anovos_tpu.data_analyzer.stats_generator import uniqueCount_computation
+
+    g = np.random.default_rng(1)
+    df = pd.DataFrame(
+        {
+            "lowcard": g.choice(["a", "b", "c", "d"], 20000),
+            "highcard": g.integers(0, 8000, 20000).astype(float),
+        }
+    )
+    t = Table.from_pandas(df)
+    exact = uniqueCount_computation(t).set_index("attribute")["unique_values"]
+    approx = uniqueCount_computation(t, compute_approx_unique_count=True, rsd=0.05).set_index(
+        "attribute"
+    )["unique_values"]
+    assert approx["lowcard"] == exact["lowcard"] == 4  # tiny counts are exact
+    assert abs(approx["highcard"] - exact["highcard"]) / exact["highcard"] < 0.1
+    with pytest.raises(ValueError):
+        uniqueCount_computation(t, compute_approx_unique_count=True, rsd=-1)
