@@ -919,11 +919,41 @@ _EXPR_FUNCS = {
 }
 
 
+def _validate_expr_ast(src: str, allowed_names) -> None:
+    """AST whitelist for expression_parser: arithmetic, comparisons, calls of
+    whitelisted function names, numeric constants, and known identifiers.
+    Attribute access is rejected outright — with empty builtins an eval can
+    still escape through ``().__class__`` chains; an AST gate cannot."""
+    import ast
+
+    tree = ast.parse(src, mode="eval")
+    ok_nodes = (
+        ast.Expression, ast.BinOp, ast.UnaryOp, ast.Compare, ast.BoolOp, ast.IfExp,
+        ast.Call, ast.Name, ast.Constant, ast.Load,
+        ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod, ast.Pow,
+        ast.USub, ast.UAdd, ast.Lt, ast.LtE, ast.Gt, ast.GtE, ast.Eq, ast.NotEq,
+        ast.And, ast.Or, ast.Not, ast.Tuple,
+    )
+    for node in ast.walk(tree):
+        if not isinstance(node, ok_nodes):
+            raise ValueError(f"disallowed syntax: {type(node).__name__}")
+        if isinstance(node, ast.Call):
+            if not isinstance(node.func, ast.Name) or node.func.id not in _EXPR_FUNCS:
+                raise ValueError("only whitelisted functions may be called")
+            if node.keywords:
+                raise ValueError("keyword arguments are not allowed")
+        if isinstance(node, ast.Name) and node.id not in allowed_names:
+            raise ValueError(f"unknown identifier: {node.id}")
+        if isinstance(node, ast.Constant) and not isinstance(node.value, (int, float, bool)):
+            raise ValueError("only numeric constants are allowed")
+
+
 def expression_parser(idf: Table, list_of_expr, postfix: str = "", print_impact: bool = False) -> Table:
     """SQL-ish expression features (reference :3674-3766).  Column names (incl.
     special-char names, handled by longest-match substitution — the
     reference's rename round-trip) become device arrays; the restricted
-    function namespace maps to jnp.  New column is named after the expression."""
+    function namespace maps to jnp and an AST whitelist guards evaluation.
+    New column is named after the expression."""
     if isinstance(list_of_expr, str):
         list_of_expr = [e.strip() for e in list_of_expr.split("|")]
     odf = idf
@@ -942,7 +972,8 @@ def expression_parser(idf: Table, list_of_expr, postfix: str = "", print_impact:
                 namespace[san] = col.data.astype(jnp.float32)
                 maskspace.append(col.mask)
         try:
-            val = eval(sub, {"__builtins__": {}}, {**_EXPR_FUNCS, **namespace})  # noqa: S307 — restricted namespace
+            _validate_expr_ast(sub, set(_EXPR_FUNCS) | set(namespace))
+            val = eval(sub, {"__builtins__": {}}, {**_EXPR_FUNCS, **namespace})  # noqa: S307 — AST-validated
         except Exception as e:
             raise ValueError(f"expression_parser: cannot evaluate {expr!r}: {e}")
         val = jnp.asarray(val, jnp.float32)

@@ -1,0 +1,40 @@
+"""expression_parser sandbox: the AST whitelist must block eval escapes."""
+
+import pandas as pd
+import pytest
+
+from anovos_tpu.data_transformer.transformers import expression_parser
+from anovos_tpu.shared.table import Table
+
+
+@pytest.fixture()
+def t():
+    return Table.from_pandas(pd.DataFrame({"x": [1.0, 2.0, 3.0]}))
+
+
+@pytest.mark.parametrize(
+    "expr",
+    [
+        "__import__('os').system('id')",
+        "().__class__.__mro__",           # dunder chain escape
+        "x.__class__",
+        "(lambda: 1)()",
+        "[y for y in [1]]",
+        "open('/etc/passwd')",
+        "exec('pwn=1')",
+        "getattr(x, 'shape')",
+        "'a' + 'b'",                      # non-numeric constants
+        "log(x, base=2)",                 # keyword smuggling
+    ],
+)
+def test_escapes_blocked(t, expr):
+    with pytest.raises(ValueError):
+        expression_parser(t, [expr])
+
+
+def test_legitimate_expressions_work(t):
+    # pipe-delimited STRING input splits into separate expressions
+    out = expression_parser(t, "log(x) + 1.5|sqrt(x) * 2").to_pandas()
+    assert "log(x) + 1.5" in out.columns and "sqrt(x) * 2" in out.columns
+    out2 = expression_parser(t, ["x > 1.5"]).to_pandas()
+    assert out2["x > 1.5"].tolist() == [0.0, 1.0, 1.0]
