@@ -927,13 +927,21 @@ def _validate_expr_ast(src: str, allowed_names) -> None:
     import ast
 
     tree = ast.parse(src, mode="eval")
+    # elementwise & | ^ ~ are the array conjunctions jax supports; Python's
+    # `and`/`or` would bool() a multi-element array, so they're excluded
     ok_nodes = (
-        ast.Expression, ast.BinOp, ast.UnaryOp, ast.Compare, ast.BoolOp, ast.IfExp,
+        ast.Expression, ast.BinOp, ast.UnaryOp, ast.Compare, ast.IfExp,
         ast.Call, ast.Name, ast.Constant, ast.Load,
         ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod, ast.Pow,
+        ast.BitAnd, ast.BitOr, ast.BitXor, ast.Invert,
         ast.USub, ast.UAdd, ast.Lt, ast.LtE, ast.Gt, ast.GtE, ast.Eq, ast.NotEq,
-        ast.And, ast.Or, ast.Not, ast.Tuple,
     )
+
+    def _fully_constant(n) -> bool:
+        # no column/function reference anywhere → Python evaluates it as
+        # pure scalar arithmetic (bignum-capable) before jnp is involved
+        return not any(isinstance(x, ast.Name) for x in ast.walk(n))
+
     for node in ast.walk(tree):
         if not isinstance(node, ok_nodes):
             raise ValueError(f"disallowed syntax: {type(node).__name__}")
@@ -944,8 +952,16 @@ def _validate_expr_ast(src: str, allowed_names) -> None:
                 raise ValueError("keyword arguments are not allowed")
         if isinstance(node, ast.Name) and node.id not in allowed_names:
             raise ValueError(f"unknown identifier: {node.id}")
-        if isinstance(node, ast.Constant) and not isinstance(node.value, (int, float, bool)):
-            raise ValueError("only numeric constants are allowed")
+        if isinstance(node, ast.Constant):
+            if not isinstance(node.value, (int, float, bool)):
+                raise ValueError("only numeric constants are allowed")
+            if abs(float(node.value)) > 1e12:
+                raise ValueError("constant magnitude too large")
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Pow):
+            # a fully-constant power tower (9**9**9…) is a bignum CPU/memory
+            # bomb evaluated by Python before any jnp code runs
+            if _fully_constant(node):
+                raise ValueError("constant-only exponentiation is not allowed")
 
 
 def expression_parser(idf: Table, list_of_expr, postfix: str = "", print_impact: bool = False) -> Table:

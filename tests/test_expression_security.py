@@ -25,6 +25,10 @@ def t():
         "getattr(x, 'shape')",
         "'a' + 'b'",                      # non-numeric constants
         "log(x, base=2)",                 # keyword smuggling
+        "x + 9**9**9**9",                 # constant bignum bomb
+        "x + 1e300",                      # oversized constant
+        "(x, x)",                         # tuple → shape-corrupt column
+        "x and x",                        # array truthiness is ambiguous
     ],
 )
 def test_escapes_blocked(t, expr):
@@ -38,3 +42,9 @@ def test_legitimate_expressions_work(t):
     assert "log(x) + 1.5" in out.columns and "sqrt(x) * 2" in out.columns
     out2 = expression_parser(t, ["x > 1.5"]).to_pandas()
     assert out2["x > 1.5"].tolist() == [0.0, 1.0, 1.0]
+    # elementwise boolean combinators work (list input keeps | literal)
+    out3 = expression_parser(t, ["(x > 1.5) & (x < 2.5)"]).to_pandas()
+    assert out3["(x > 1.5) & (x < 2.5)"].tolist() == [0.0, 1.0, 0.0]
+    # data-dependent exponent is fine; only constant towers are banned
+    out4 = expression_parser(t, ["2 ** x"]).to_pandas()
+    assert out4["2 ** x"].tolist() == [2.0, 4.0, 8.0]
