@@ -10,6 +10,7 @@ here).
 
 from __future__ import annotations
 
+import dataclasses
 import re
 import warnings
 from typing import Dict, List, Tuple, Union
@@ -59,13 +60,20 @@ def duplicate_detection(
     sub = idf.select(cols)
     def _hashable(c):
         col = sub.columns[c]
+        if col.is_wide_int:
+            return [col.wide_hi, col.wide_lo]  # exact pair, no f32 collisions
         if col.kind == "cat" or col.data.dtype != jnp.float32:
-            return col.data.astype(jnp.int32)
+            return [col.data.astype(jnp.int32)]
         # +0.0 canonicalizes -0.0 → +0.0 so equal floats hash equally
-        return (col.data + 0.0).view(jnp.int32)
+        return [(col.data + 0.0).view(jnp.int32)]
 
-    X = jnp.stack([_hashable(c) for c in cols], 1)
-    M = jnp.stack([sub.columns[c].mask for c in cols], 1)
+    hash_arrays, hash_masks = [], []
+    for c in cols:
+        arrs = _hashable(c)
+        hash_arrays.extend(arrs)
+        hash_masks.extend([sub.columns[c].mask] * len(arrs))
+    X = jnp.stack(hash_arrays, 1)
+    M = jnp.stack(hash_masks, 1)
     sig = np.asarray(row_signature(X, M))[: idf.nrows]
     df_sig = pd.DataFrame({"h1": sig[:, 0], "h2": sig[:, 1]})
     # only rows in colliding hash buckets need exact host verification —
@@ -599,7 +607,7 @@ def invalidEntries_detection(
             for c in target_cols:
                 col = idf.columns[c]
                 ok = col.mask & ~invalid_masks[c]
-                new_cols[c] = Column(col.kind, col.data, ok, vocab=col.vocab, dtype_name=col.dtype_name)
+                new_cols[c] = dataclasses.replace(col, mask=ok)
             for name, ncol in new_cols.items():
                 odf = odf.with_column(name if output_mode == "replace" else name + "_invalid", ncol)
             if treatment_method == "MMM":

@@ -295,15 +295,17 @@ def uniqueCount_computation(
 
         # stack as exact int32 bit patterns — casting int columns (e.g. 1e9
         # ids) to float32 would collapse ~64 consecutive values into one
-        X = jnp.stack(
-            [
-                (idf.columns[c].data + 0.0).view(jnp.int32)
-                if idf.columns[c].data.dtype == jnp.float32
-                else idf.columns[c].data.astype(jnp.int32)
-                for c in cols
-            ],
-            1,
-        )
+        def _exact_bits(c):
+            col = idf.columns[c]
+            if col.is_wide_int:
+                # mix the exact (hi, lo) pair into one int32 lane (golden-ratio
+                # multiply; collision rate 2^-32 ≪ rsd)
+                return col.wide_hi ^ (col.wide_lo * jnp.int32(-1640531527))
+            if col.data.dtype == jnp.float32:
+                return (col.data + 0.0).view(jnp.int32)
+            return col.data.astype(jnp.int32)
+
+        X = jnp.stack([_exact_bits(c) for c in cols], 1)
         M = _stacked_valid_mask(idf, cols)
         nu = np.round(approx_nunique(X, M, rsd)).astype(np.int64)
     else:

@@ -231,6 +231,43 @@ def _concat_columns(cols: List[Column], nrows: List[int], name: str) -> Column:
         for c, n, cm in zip(cols, nrows, lookups):
             h = np.asarray(jax.device_get(c.data))[:n]
             hosts.append(np.where(h >= 0, cm[np.clip(h, 0, len(cm) - 1)], -1).astype(np.int32))
+    elif any(c.is_wide_int for c in cols):
+        # wide int64 in any slice: keep the exact (hi, lo) pair — nulls ride
+        # the mask, so nullable slices must NOT degrade ids to f32 silently
+        from anovos_tpu.shared.table import wide_int_parts
+
+        total = sum(nrows)
+        npad = rt.pad_rows(max(total, 1))
+        mask_h = np.concatenate(
+            [np.asarray(jax.device_get(c.mask))[:n] for c, n in zip(cols, nrows)]
+        )
+        int_ok = all(c.is_wide_int or c.data.dtype == jnp.int32 for c in cols)
+        if not int_ok:  # genuinely mixed with float slices: float64 semantics
+            parts = [
+                c.exact_host(n).astype(np.float64) if c.is_wide_int
+                else np.asarray(jax.device_get(c.data))[:n].astype(np.float64)
+                for c, n in zip(cols, nrows)
+            ]
+            data_h = np.concatenate(parts)
+            data_h[~mask_h] = np.nan
+            return _host_to_column(data_h, total, npad, rt)
+        v64 = np.concatenate(
+            [
+                c.exact_host(n).astype(np.int64) if c.is_wide_int
+                else np.asarray(jax.device_get(c.data))[:n].astype(np.int64)
+                for c, n in zip(cols, nrows)
+            ]
+        )
+        v64[~mask_h] = 0  # masked lanes: any value, mask gates all consumers
+        whi, wlo = wide_int_parts(v64)
+        return Column(
+            "num",
+            rt.shard_rows(_pad_to(v64.astype(np.float32), npad, np.float32(0))),
+            rt.shard_rows(_pad_to(mask_h, npad, False)),
+            dtype_name="bigint",
+            wide_hi=rt.shard_rows(_pad_to(whi, npad, np.int32(0))),
+            wide_lo=rt.shard_rows(_pad_to(wlo, npad, np.int32(-(1 << 31)))),
+        )
     else:
         new_vocab = None
         np_dtypes = {np.asarray(jax.device_get(c.data[:1])).dtype for c in cols}
@@ -290,6 +327,9 @@ def _host_keys(t: Table, join_cols: List[str]) -> pd.DataFrame:
             vals[valid] = col.vocab[data[valid]]
             vals[~valid] = None
             out[c] = vals
+        elif col.is_wide_int:
+            # id-like int64 keys must match exactly — the f32 view collides
+            out[c] = pd.arrays.IntegerArray(col.exact_host(t.nrows), ~mask)
         else:
             vals = data.astype(np.float64)
             vals[~mask] = np.nan
@@ -338,8 +378,16 @@ def join_dataset(*idfs: Table, join_cols: Union[str, List[str]], join_type: str)
         out = OrderedDict()
         for name in left.col_names:
             if name in join_cols:
+                s = key_frame[name]
+                if str(s.dtype) == "Int64":  # wide-int keys from _host_keys
+                    if not s.isna().any():
+                        key_arr = s.to_numpy(dtype=np.int64)
+                    else:  # null int keys (rare): degrade to float64
+                        key_arr = s.astype("float64").to_numpy()
+                else:
+                    key_arr = np.asarray(s.to_numpy())
                 out[name] = _host_to_column(
-                    np.asarray(key_frame[name].to_numpy()), len(merged),
+                    key_arr, len(merged),
                     get_runtime().pad_rows(max(len(merged), 1)), get_runtime(),
                 )
             else:
@@ -415,13 +463,24 @@ def recast_column(idf: Table, list_of_cols, list_of_dtypes, print_impact: bool =
                 ok = col.mask & (col.data >= 0) & ~jnp.isnan(vals)
                 data = jnp.where(ok, vals, 0.0).astype(tgt)
                 new = Column("num", data, ok, dtype_name=dt if dt != "integer" else "int")
+            elif col.is_wide_int:
+                if dt in ("bigint", "long"):
+                    new = col  # already exact int64: no-op recast keeps the pair
+                elif tgt == jnp.float32:
+                    new = Column("num", col.data, col.mask, dtype_name=dt)
+                else:  # narrowing to int32 genuinely truncates: go via exact host
+                    v = col.exact_host(idf.nrows)
+                    new = _host_to_column(
+                        np.clip(v, np.iinfo(np.int32).min, np.iinfo(np.int32).max).astype(np.int64),
+                        idf.nrows, rt.pad_rows(max(idf.nrows, 1)), rt,
+                    )
             else:
                 new = Column("num", col.data.astype(tgt), col.mask, dtype_name=dt if dt != "integer" else "int")
         elif dt == "string":
             if col.kind == "cat":
                 new = col
             else:
-                host = np.asarray(col.data)[: idf.nrows]
+                host = col.exact_host(idf.nrows)  # wide ints render exactly
                 mask = np.asarray(col.mask)[: idf.nrows]
                 vals = np.empty(idf.nrows, dtype=object)
                 if np.issubdtype(host.dtype, np.integer):

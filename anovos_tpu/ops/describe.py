@@ -97,6 +97,55 @@ def describe_numeric(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
     }
 
 
+@jax.jit
+def describe_wide_int(hi: jax.Array, lo: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
+    """Exact order statistics for wide-int64 columns stored as (hi, lo) int32
+    pairs (Table docstring encoding: signed lexicographic pair order == int64
+    numeric order).  One program: lexicographic sort via two stable argsorts,
+    then distinct count, percentile grid, and mode — all int32 ops, no f32
+    precision loss (TPUs have no native int64)."""
+    rows, k = hi.shape
+    n_int = M.sum(axis=0, dtype=jnp.int32)
+    big = jnp.iinfo(jnp.int32).max
+    hi_s = jnp.where(M, hi, big)
+    lo_s = jnp.where(M, lo, big)
+    perm1 = jnp.argsort(lo_s, axis=0, stable=True)
+    hi1 = jnp.take_along_axis(hi_s, perm1, axis=0)
+    lo1 = jnp.take_along_axis(lo_s, perm1, axis=0)
+    perm2 = jnp.argsort(hi1, axis=0, stable=True)
+    hi2 = jnp.take_along_axis(hi1, perm2, axis=0)
+    lo2 = jnp.take_along_axis(lo1, perm2, axis=0)
+    pos = jnp.arange(rows, dtype=jnp.int32)[:, None]
+    valid_sorted = pos < n_int[None, :]
+    trans = jnp.concatenate(
+        [jnp.ones((1, k), bool), (hi2[1:] != hi2[:-1]) | (lo2[1:] != lo2[:-1])], axis=0
+    )
+    nunique = (trans & valid_sorted).sum(axis=0, dtype=jnp.int32)
+    qs = jnp.asarray(PCTL_QS, jnp.float32)
+    n = n_int.astype(jnp.float32)
+    pos_q = qs[:, None] * jnp.maximum(n[None, :] - 1, 0)
+    lo_i = jnp.minimum(jnp.floor(pos_q).astype(jnp.int32), jnp.maximum(n_int[None, :] - 1, 0))
+    run_start = jax.lax.cummax(jnp.where(trans, pos, -1), axis=0)
+    runlen = jnp.where(valid_sorted, pos - run_start + 1, 0)
+    best = jnp.argmax(runlen, axis=0)
+    return {
+        "count": n_int,
+        "nunique": nunique,
+        "pctl_hi": jnp.take_along_axis(hi2, lo_i, axis=0),
+        "pctl_lo": jnp.take_along_axis(lo2, lo_i, axis=0),
+        "mode_hi": jnp.take_along_axis(hi2, best[None, :], axis=0)[0],
+        "mode_lo": jnp.take_along_axis(lo2, best[None, :], axis=0)[0],
+        "mode_count": jnp.take_along_axis(runlen, best[None, :], axis=0)[0],
+    }
+
+
+def _wide_pair_to_f64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """Host reconstruction of the exact int64 value as float64 (exact up to
+    2^53, i.e. every realistic id)."""
+    v = (hi.astype(np.int64) << 32) + (lo.astype(np.int64) + (1 << 31))
+    return v.astype(np.float64)
+
+
 @functools.partial(jax.jit, static_argnames=("max_vocab",))
 def describe_cat(C: jax.Array, M: jax.Array, max_vocab: int) -> Dict[str, jax.Array]:
     """One program: per-column code histograms for (rows, k_cat) codes.
@@ -138,6 +187,29 @@ def table_describe(idf: Table, num_cols: List[str], cat_cols: List[str]) -> Tupl
     if num_cols:
         X, M = idf.numeric_block(num_cols)
         num_out = {k: np.asarray(v) for k, v in describe_numeric(X, M).items()}
+        wide = [c for c in num_cols if idf.columns[c].is_wide_int]
+        if wide:
+            # overwrite the f32-approximate order stats with exact values
+            # from the (hi, lo) int32-pair kernel (moments stay f32-approx)
+            Hi = jnp.stack([idf.columns[c].wide_hi for c in wide], axis=1)
+            Lo = jnp.stack([idf.columns[c].wide_lo for c in wide], axis=1)
+            Mw = jnp.stack([idf.columns[c].mask for c in wide], axis=1)
+            w = {kk: np.asarray(v) for kk, v in describe_wide_int(Hi, Lo, Mw).items()}
+            pctl = _wide_pair_to_f64(w["pctl_hi"], w["pctl_lo"])  # (nq, kw)
+            mode = _wide_pair_to_f64(w["mode_hi"], w["mode_lo"])
+            num_out = {kk: v.copy() for kk, v in num_out.items()}
+            for kk in ("percentiles", "min", "max", "mode_value"):
+                num_out[kk] = num_out[kk].astype(np.float64)
+            for j, c in enumerate(wide):
+                if w["count"][j] == 0:
+                    continue  # all-null: keep describe_numeric's NaNs, not the sort sentinel
+                i = num_cols.index(c)
+                num_out["nunique"][i] = w["nunique"][j]
+                num_out["percentiles"][:, i] = pctl[:, j]
+                num_out["min"][i] = pctl[0, j]
+                num_out["max"][i] = pctl[-1, j]
+                num_out["mode_value"][i] = mode[j]
+                num_out["mode_count"][i] = w["mode_count"][j]
     cat_out: dict = {}
     if cat_cols:
         k = len(cat_cols)

