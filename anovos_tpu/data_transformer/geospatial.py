@@ -2,11 +2,13 @@
 
 Format conversion (dd/dms/radian/cartesian/geohash), distances, geohash
 precision control, country containment, centroids and radius of gyration.
-Numeric math runs vectorized (host numpy over decoded columns or device
-where natural); geohash strings ride the dictionary like every other
-categorical.  Cites: geo_format_latlon :39, geo_format_cartesian :190,
-geo_format_geohash :333, location_distance :460, geohash_precision_control
-:653, location_in_country :814, centroid :975, weighted_centroid :1099,
+
+Device-native (round 2): per-row trig/bit math runs as jitted kernels
+(ops/geo_kernels.py); the host touches only string vocabularies (dms and
+geohash text), geojson polygon loading, and the small per-id result frames.
+Cites: geo_format_latlon :39, geo_format_cartesian :190, geo_format_geohash
+:333, location_distance :460, geohash_precision_control :653,
+location_in_country :814, centroid :975, weighted_centroid :1099,
 rog_calculation :1223, reverse_geocoding :1335.
 """
 
@@ -15,28 +17,41 @@ from __future__ import annotations
 import warnings
 from typing import List, Optional, Union
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
 from anovos_tpu.data_transformer import geo_utils
+from anovos_tpu.ops import geo_kernels as gk
 from anovos_tpu.shared.runtime import get_runtime
 from anovos_tpu.shared.table import Column, Table, _host_to_column
 
 EARTH_RADIUS_M = geo_utils.EARTH_RADIUS_M
 
 
+def _dev_num(idf: Table, col: str):
+    """(f32 data, mask) device pair for a numeric column."""
+    c = idf.columns[col]
+    return c.data.astype(jnp.float32), c.mask
+
+
+def _add_dev(idf: Table, name: str, vals: jax.Array, mask: jax.Array) -> Table:
+    return idf.with_column(name, Column("num", vals.astype(jnp.float32), mask, dtype_name="double"))
+
+
 def _host_num(idf: Table, col: str) -> tuple:
     c = idf.columns[col]
-    vals = np.asarray(c.data)[: idf.nrows].astype(float)
-    mask = np.asarray(c.mask)[: idf.nrows]
+    vals = np.asarray(jax.device_get(c.data))[: idf.nrows].astype(float)
+    mask = np.asarray(jax.device_get(c.mask))[: idf.nrows]
     vals = np.where(mask, vals, np.nan)
     return vals, mask
 
 
 def _host_cat(idf: Table, col: str) -> np.ndarray:
     c = idf.columns[col]
-    codes = np.asarray(c.data)[: idf.nrows]
-    mask = np.asarray(c.mask)[: idf.nrows] & (codes >= 0)
+    codes = np.asarray(jax.device_get(c.data))[: idf.nrows]
+    mask = np.asarray(jax.device_get(c.mask))[: idf.nrows] & (codes >= 0)
     out = np.full(idf.nrows, None, dtype=object)
     out[mask] = c.vocab[codes[mask]]
     return out
@@ -93,6 +108,55 @@ def _dms_str_to_dd(vals: np.ndarray) -> np.ndarray:
     return out
 
 
+_BASE32 = np.array(list("0123456789bcdefghjkmnpqrstuvwxyz"))
+
+
+def _geohash_column(idf: Table, lat_d, lon_d, mask, name: str, precision: int = 9) -> Table:
+    """lat/lon → geohash string column: bit interleaving on device, base32
+    mapping of the small digit matrix on host (strings are inherently
+    host-resident vocab)."""
+    digits = np.asarray(jax.device_get(gk.geohash_digits(lat_d, lon_d, precision)))[: idf.nrows]
+    m = np.asarray(jax.device_get(mask))[: idf.nrows]
+    chars = _BASE32[digits]  # (rows, p)
+    strs = np.array(["".join(row) for row in chars], dtype=object)
+    vals = np.where(m, strs, None)
+    return _add_cat(idf, name, vals)
+
+
+def _latlon_dev_from_input(idf: Table, lat_c: str, lon_c: str, fmt: str):
+    """Input decode → (lat_dd device, lon_dd device, mask)."""
+    if fmt == "dd":
+        lat, ml = _dev_num(idf, lat_c)
+        lon, mo = _dev_num(idf, lon_c)
+        return lat, lon, ml & mo
+    if fmt == "radian":
+        lat, ml = _dev_num(idf, lat_c)
+        lon, mo = _dev_num(idf, lon_c)
+        return _rad2deg(lat), _rad2deg(lon), ml & mo
+    if fmt == "dms":  # strings: host parse, one upload
+        rt = get_runtime()
+        lat_h = _dms_str_to_dd(_host_cat(idf, lat_c))
+        lon_h = _dms_str_to_dd(_host_cat(idf, lon_c))
+        ok = np.isfinite(lat_h) & np.isfinite(lon_h)
+        npad = rt.pad_rows(max(idf.nrows, 1))
+        pad = np.zeros(npad - idf.nrows)
+        lat_d = rt.shard_rows(np.concatenate([np.where(ok, lat_h, 0.0), pad]).astype(np.float32))
+        lon_d = rt.shard_rows(np.concatenate([np.where(ok, lon_h, 0.0), pad]).astype(np.float32))
+        m_d = rt.shard_rows(np.concatenate([ok, pad.astype(bool)]))
+        return lat_d, lon_d, m_d
+    raise ValueError(f"unsupported loc_input_format {fmt}")
+
+
+@jax.jit
+def _rad2deg(x):
+    return x * (180.0 / jnp.pi)
+
+
+@jax.jit
+def _deg2rad(x):
+    return x * (jnp.pi / 180.0)
+
+
 def geo_format_latlon(
     idf: Table,
     list_of_lat: Union[str, List[str]],
@@ -110,42 +174,29 @@ def geo_format_latlon(
         list_of_lon = [x.strip() for x in list_of_lon.split("|")]
     odf = idf
     for lat_c, lon_c in zip(list_of_lat, list_of_lon):
-        if loc_input_format == "dd":
-            lat, _ = _host_num(idf, lat_c)
-            lon, _ = _host_num(idf, lon_c)
-        elif loc_input_format == "radian":
-            lat, _ = _host_num(idf, lat_c)
-            lon, _ = _host_num(idf, lon_c)
-            lat, lon = np.degrees(lat), np.degrees(lon)
-        elif loc_input_format == "dms":
-            lat = _dms_str_to_dd(_host_cat(idf, lat_c))
-            lon = _dms_str_to_dd(_host_cat(idf, lon_c))
-        else:
-            raise ValueError(f"unsupported loc_input_format {loc_input_format}")
+        lat, lon, mask = _latlon_dev_from_input(idf, lat_c, lon_c, loc_input_format)
         pre = (result_prefix + "_") if result_prefix else ""
         if loc_output_format == "dd":
-            odf = _add_num(odf, f"{pre}{lat_c}_dd", lat)
-            odf = _add_num(odf, f"{pre}{lon_c}_dd", lon)
+            odf = _add_dev(odf, f"{pre}{lat_c}_dd", lat, mask)
+            odf = _add_dev(odf, f"{pre}{lon_c}_dd", lon, mask)
         elif loc_output_format == "radian":
-            odf = _add_num(odf, f"{pre}{lat_c}_radian", np.radians(lat))
-            odf = _add_num(odf, f"{pre}{lon_c}_radian", np.radians(lon))
-        elif loc_output_format == "dms":
-            odf = _add_cat(odf, f"{pre}{lat_c}_dms", _dd_to_dms_str(lat))
-            odf = _add_cat(odf, f"{pre}{lon_c}_dms", _dd_to_dms_str(lon))
+            odf = _add_dev(odf, f"{pre}{lat_c}_radian", _deg2rad(lat), mask)
+            odf = _add_dev(odf, f"{pre}{lon_c}_radian", _deg2rad(lon), mask)
+        elif loc_output_format == "dms":  # string output: host format
+            lat_h = np.asarray(jax.device_get(lat))[: idf.nrows].astype(float)
+            lon_h = np.asarray(jax.device_get(lon))[: idf.nrows].astype(float)
+            m = np.asarray(jax.device_get(mask))[: idf.nrows]
+            lat_h[~m] = np.nan
+            lon_h[~m] = np.nan
+            odf = _add_cat(odf, f"{pre}{lat_c}_dms", _dd_to_dms_str(lat_h))
+            odf = _add_cat(odf, f"{pre}{lon_c}_dms", _dd_to_dms_str(lon_h))
         elif loc_output_format == "cartesian":
-            latr, lonr = np.radians(lat), np.radians(lon)
-            odf = _add_num(odf, f"{pre}{lat_c}_{lon_c}_x", EARTH_RADIUS_M * np.cos(latr) * np.cos(lonr))
-            odf = _add_num(odf, f"{pre}{lat_c}_{lon_c}_y", EARTH_RADIUS_M * np.cos(latr) * np.sin(lonr))
-            odf = _add_num(odf, f"{pre}{lat_c}_{lon_c}_z", EARTH_RADIUS_M * np.sin(latr))
+            x, y, z = gk.latlon_to_cartesian(lat, lon)
+            odf = _add_dev(odf, f"{pre}{lat_c}_{lon_c}_x", x, mask)
+            odf = _add_dev(odf, f"{pre}{lat_c}_{lon_c}_y", y, mask)
+            odf = _add_dev(odf, f"{pre}{lat_c}_{lon_c}_z", z, mask)
         elif loc_output_format == "geohash":
-            gh = np.array(
-                [
-                    None if not (np.isfinite(a) and np.isfinite(o)) else geo_utils.geohash_encode(a, o, 9)
-                    for a, o in zip(lat, lon)
-                ],
-                dtype=object,
-            )
-            odf = _add_cat(odf, f"{pre}{lat_c}_{lon_c}_geohash", gh)
+            odf = _geohash_column(odf, lat, lon, mask, f"{pre}{lat_c}_{lon_c}_geohash")
         else:
             raise ValueError(f"unsupported loc_output_format {loc_output_format}")
         if output_mode == "replace":
@@ -156,7 +207,7 @@ def geo_format_latlon(
 def geo_format_cartesian(
     idf: Table, list_of_x, list_of_y, list_of_z, loc_output_format: str = "dd", result_prefix: str = "", **_ignored
 ) -> Table:
-    """Cartesian → dd/radian/geohash (reference :190-331)."""
+    """Cartesian → dd/radian/geohash (reference :190-331), device trig."""
     if isinstance(list_of_x, str):
         list_of_x = [v.strip() for v in list_of_x.split("|")]
     if isinstance(list_of_y, str):
@@ -165,27 +216,20 @@ def geo_format_cartesian(
         list_of_z = [v.strip() for v in list_of_z.split("|")]
     odf = idf
     for xc, yc, zc in zip(list_of_x, list_of_y, list_of_z):
-        x, _ = _host_num(idf, xc)
-        y, _ = _host_num(idf, yc)
-        z, _ = _host_num(idf, zc)
-        lat = np.degrees(np.arcsin(np.clip(z / EARTH_RADIUS_M, -1, 1)))
-        lon = np.degrees(np.arctan2(y, x))
+        x, mx = _dev_num(idf, xc)
+        y, my = _dev_num(idf, yc)
+        z, mz = _dev_num(idf, zc)
+        mask = mx & my & mz
+        lat, lon = gk.cartesian_to_latlon(x, y, z)
         pre = (result_prefix + "_") if result_prefix else ""
         if loc_output_format == "dd":
-            odf = _add_num(odf, f"{pre}{xc}_{yc}_{zc}_lat", lat)
-            odf = _add_num(odf, f"{pre}{xc}_{yc}_{zc}_lon", lon)
+            odf = _add_dev(odf, f"{pre}{xc}_{yc}_{zc}_lat", lat, mask)
+            odf = _add_dev(odf, f"{pre}{xc}_{yc}_{zc}_lon", lon, mask)
         elif loc_output_format == "radian":
-            odf = _add_num(odf, f"{pre}{xc}_{yc}_{zc}_lat_radian", np.radians(lat))
-            odf = _add_num(odf, f"{pre}{xc}_{yc}_{zc}_lon_radian", np.radians(lon))
+            odf = _add_dev(odf, f"{pre}{xc}_{yc}_{zc}_lat_radian", _deg2rad(lat), mask)
+            odf = _add_dev(odf, f"{pre}{xc}_{yc}_{zc}_lon_radian", _deg2rad(lon), mask)
         elif loc_output_format == "geohash":
-            gh = np.array(
-                [
-                    None if not (np.isfinite(a) and np.isfinite(o)) else geo_utils.geohash_encode(a, o, 9)
-                    for a, o in zip(lat, lon)
-                ],
-                dtype=object,
-            )
-            odf = _add_cat(odf, f"{pre}{xc}_{yc}_{zc}_geohash", gh)
+            odf = _geohash_column(odf, lat, lon, mask, f"{pre}{xc}_{yc}_{zc}_geohash")
         else:
             raise ValueError(f"unsupported loc_output_format {loc_output_format}")
     return odf
@@ -194,8 +238,9 @@ def geo_format_cartesian(
 def geo_format_geohash(
     idf: Table, list_of_geohash, loc_output_format: str = "dd", result_prefix: str = "", **_ignored
 ) -> Table:
-    """Geohash → lat/lon (decode once per distinct hash via the dictionary;
-    reference :333-458)."""
+    """Geohash → lat/lon: decode once per DISTINCT hash on host (dictionary
+    discipline), then a device gather maps codes → coordinates
+    (reference :333-458)."""
     if isinstance(list_of_geohash, str):
         list_of_geohash = [v.strip() for v in list_of_geohash.split("|")]
     odf = idf
@@ -204,19 +249,26 @@ def geo_format_geohash(
         decoded = np.array(
             [geo_utils.geohash_decode(str(v)) if v else (np.nan, np.nan) for v in col.vocab]
         )
-        codes = np.asarray(col.data)[: idf.nrows]
-        mask = np.asarray(col.mask)[: idf.nrows] & (codes >= 0)
-        lat = np.full(idf.nrows, np.nan)
-        lon = np.full(idf.nrows, np.nan)
-        if len(decoded):
-            lat[mask] = decoded[codes[mask], 0]
-            lon[mask] = decoded[codes[mask], 1]
+        if len(decoded) == 0:
+            decoded = np.full((1, 2), np.nan)
+        ok_v = np.isfinite(decoded).all(axis=1)
+        lat_v = jnp.asarray(np.where(ok_v, decoded[:, 0], 0.0), jnp.float32)
+        lon_v = jnp.asarray(np.where(ok_v, decoded[:, 1], 0.0), jnp.float32)
+        lat_d, lon_d, mask = _gather_decoded(col.data, col.mask, lat_v, lon_v, jnp.asarray(ok_v))
         pre = (result_prefix + "_") if result_prefix else ""
         if loc_output_format == "radian":
-            lat, lon = np.radians(lat), np.radians(lon)
-        odf = _add_num(odf, f"{pre}{c}_latitude", lat)
-        odf = _add_num(odf, f"{pre}{c}_longitude", lon)
+            lat_d, lon_d = _deg2rad(lat_d), _deg2rad(lon_d)
+        odf = _add_dev(odf, f"{pre}{c}_latitude", lat_d, mask)
+        odf = _add_dev(odf, f"{pre}{c}_longitude", lon_d, mask)
     return odf
+
+
+@jax.jit
+def _gather_decoded(codes, mask, lat_v, lon_v, ok_v):
+    nv = lat_v.shape[0]
+    safe = jnp.clip(codes, 0, nv - 1)
+    ok = mask & (codes >= 0) & ok_v[safe]
+    return lat_v[safe], lon_v[safe], ok
 
 
 def location_distance(
@@ -228,46 +280,61 @@ def location_distance(
     result_prefix: str = "",
     **_ignored,
 ) -> Table:
-    """Pairwise distance between two lat/lon column pairs
-    (reference :460-651; haversine/vincenty/euclidean in geo_utils)."""
+    """Pairwise distance between two lat/lon column pairs — one device
+    program (reference :460-651)."""
     if isinstance(list_of_lat, str):
         list_of_lat = [v.strip() for v in list_of_lat.split("|")]
     if isinstance(list_of_lon, str):
         list_of_lon = [v.strip() for v in list_of_lon.split("|")]
     if len(list_of_lat) != 2 or len(list_of_lon) != 2:
         raise ValueError("location_distance expects exactly two lat and two lon columns")
-    lat1, _ = _host_num(idf, list_of_lat[0])
-    lat2, _ = _host_num(idf, list_of_lat[1])
-    lon1, _ = _host_num(idf, list_of_lon[0])
-    lon2, _ = _host_num(idf, list_of_lon[1])
-    fn = {
-        "haversine": geo_utils.haversine_distance,
-        "vincenty": geo_utils.vincenty_distance,
-        "euclidean": geo_utils.euclidean_distance,
-    }.get(distance_type)
+    lat1, m1 = _dev_num(idf, list_of_lat[0])
+    lat2, m2 = _dev_num(idf, list_of_lat[1])
+    lon1, m3 = _dev_num(idf, list_of_lon[0])
+    lon2, m4 = _dev_num(idf, list_of_lon[1])
+    fn = {"haversine": gk.haversine, "vincenty": gk.vincenty, "euclidean": gk.equirectangular}.get(
+        distance_type
+    )
     if fn is None:
         raise ValueError(f"unsupported distance_type {distance_type}")
-    d = fn(lat1, lon1, lat2, lon2, unit=unit)
+    d = fn(lat1, lon1, lat2, lon2)
+    if unit == "km":
+        d = d / 1000.0
     pre = (result_prefix + "_") if result_prefix else ""
-    return _add_num(idf, f"{pre}distance_{distance_type}", d)
+    return _add_dev(idf, f"{pre}distance_{distance_type}", d, m1 & m2 & m3 & m4)
 
 
 def geohash_precision_control(
     idf: Table, list_of_geohash, km_max_error: float = 10.0, output_mode: str = "replace", **_ignored
 ) -> Table:
-    """Truncate geohashes to the precision bounding the error radius
-    (reference :653-812; the standard precision→error table)."""
+    """Truncate geohashes to the precision bounding the error radius —
+    pure VOCAB operation: distinct strings truncate on host, codes remap on
+    device via a small LUT (reference :653-812)."""
     if isinstance(list_of_geohash, str):
         list_of_geohash = [v.strip() for v in list_of_geohash.split("|")]
     err_km = [2500, 630, 78, 20, 2.4, 0.61, 0.076, 0.019, 0.0024, 0.0006, 0.000074]
     precision = next((i + 1 for i, e in enumerate(err_km) if e <= km_max_error), len(err_km))
     odf = idf
     for c in list_of_geohash:
-        vals = _host_cat(idf, c)
-        trunc = np.array([None if v is None else str(v)[:precision] for v in vals], dtype=object)
+        col = idf.columns[c]
+        if col.kind != "cat" or len(col.vocab) == 0:
+            continue
+        trunc = np.array([str(v)[:precision] for v in col.vocab], dtype=object)
+        new_vocab, inv = np.unique(trunc, return_inverse=True)
+        lut = jnp.asarray(inv.astype(np.int32))
+        data = _remap_codes(col.data, lut)
         name = c if output_mode == "replace" else c + "_precision"
-        odf = _add_cat(odf, name, trunc)
+        odf = odf.with_column(
+            name, Column("cat", data, col.mask, vocab=new_vocab.astype(object), dtype_name="string")
+        )
     return odf
+
+
+@jax.jit
+def _remap_codes(codes, lut):
+    nv = lut.shape[0]
+    safe = jnp.clip(codes, 0, nv - 1)
+    return jnp.where(codes >= 0, lut[safe], -1)
 
 
 def location_in_country(
@@ -280,87 +347,168 @@ def location_in_country(
     result_prefix: str = "",
     **_ignored,
 ) -> Table:
-    """Flag rows inside a country (reference :814-973): "approx" uses the
-    bounding-box table; "exact" ray-casts against a geojson polygon file."""
+    """Flag rows inside a country (reference :814-973): "approx" compares
+    against the bounding-box table on device; "exact" ray-casts against the
+    geojson polygons on device (edges padded into one kernel; country
+    polygons are disjoint so whole-set parity equals per-polygon OR)."""
     if isinstance(list_of_lat, str):
         list_of_lat = [v.strip() for v in list_of_lat.split("|")]
     if isinstance(list_of_lon, str):
         list_of_lon = [v.strip() for v in list_of_lon.split("|")]
     odf = idf
     for lat_c, lon_c in zip(list_of_lat, list_of_lon):
-        lat, _ = _host_num(idf, lat_c)
-        lon, _ = _host_num(idf, lon_c)
+        lat, ml = _dev_num(idf, lat_c)
+        lon, mo = _dev_num(idf, lon_c)
+        mask = ml & mo
         if method_type == "approx" or not country_shapefile_path:
-            inside = geo_utils.point_in_country_approx(lat, lon, country)
+            key = country.upper()
+            bbox = None
+            for code, (name, bb) in geo_utils.COUNTRY_BOUNDING_BOXES.items():
+                if key == code or key == name.upper():
+                    bbox = bb
+                    break
+            if bbox is None:
+                raise ValueError(f"unknown country for approx containment: {country}")
+            inside = _bbox_program(lat, lon, *map(float, bbox))
         else:
-            inside = geo_utils.point_in_geojson(lat, lon, country_shapefile_path)
+            ex1, ey1, ex2, ey2 = _geojson_edges(country_shapefile_path)
+            inside = gk.point_in_polygons(lat, lon, ex1, ey1, ex2, ey2)
         pre = (result_prefix + "_") if result_prefix else ""
-        odf = _add_num(odf, f"{pre}{lat_c}_{lon_c}_in_{country}", inside.astype(float))
+        odf = _add_dev(odf, f"{pre}{lat_c}_{lon_c}_in_{country}", inside.astype(jnp.float32), mask)
     return odf
 
 
+@jax.jit
+def _bbox_program(lat, lon, lo_lon, lo_lat, hi_lon, hi_lat):
+    return (lat >= lo_lat) & (lat <= hi_lat) & (lon >= lo_lon) & (lon <= hi_lon)
+
+
+def _geojson_edges(path: str):
+    """Host: flatten all rings of a geojson file into padded edge arrays."""
+    import json
+
+    with open(path) as f:
+        gj = json.load(f)
+    feats = gj["features"] if gj.get("type") == "FeatureCollection" else [gj]
+    x1s, y1s, x2s, y2s = [], [], [], []
+    for feat in feats:
+        geom = feat.get("geometry", feat)
+        polys = geom["coordinates"] if geom["type"] == "MultiPolygon" else [geom["coordinates"]]
+        for poly in polys:
+            for ring in poly:  # outer + holes: even-odd parity handles both
+                pts = np.asarray(ring, float)
+                nxt = np.roll(pts, -1, axis=0)
+                x1s.append(pts[:, 0])
+                y1s.append(pts[:, 1])
+                x2s.append(nxt[:, 0])
+                y2s.append(nxt[:, 1])
+    return (
+        jnp.asarray(np.concatenate(x1s), jnp.float32),
+        jnp.asarray(np.concatenate(y1s), jnp.float32),
+        jnp.asarray(np.concatenate(x2s), jnp.float32),
+        jnp.asarray(np.concatenate(y2s), jnp.float32),
+    )
+
+
+def _id_codes(idf: Table, id_col: str):
+    """(codes device, valid device, labels host) for a grouping column."""
+    col = idf.columns[id_col]
+    if col.kind == "cat":
+        return col.data, col.mask & (col.data >= 0), col.vocab
+    # numeric ids: device unique-compaction → searchsorted codes
+    from anovos_tpu.data_analyzer.quality_checker import _member_mask, _unique_compact  # noqa: F401
+
+    buf, nu_d = _unique_compact(col.data, col.mask)
+    nu = int(nu_d)
+    uniq = buf[:nu]
+    codes = _codes_via_search(col.data, uniq)
+    return codes, col.mask, np.asarray(jax.device_get(uniq))
+
+
+@jax.jit
+def _codes_via_search(data, sorted_uniq):
+    x = data.astype(sorted_uniq.dtype)
+    nv = sorted_uniq.shape[0]
+    idx = jnp.clip(jnp.searchsorted(sorted_uniq, x), 0, max(nv - 1, 0))
+    return idx.astype(jnp.int32)
+
+
 def centroid(idf: Table, lat_col: str, long_col: str, id_col: Optional[str] = None) -> pd.DataFrame:
-    """Per-id (or global) centroid via cartesian mean (reference :975-1097).
-    Returns a small host frame [id?, <lat>_centroid, <long>_centroid]."""
-    lat, _ = _host_num(idf, lat_col)
-    lon, _ = _host_num(idf, long_col)
-    latr, lonr = np.radians(lat), np.radians(lon)
-    x, y, z = np.cos(latr) * np.cos(lonr), np.cos(latr) * np.sin(lonr), np.sin(latr)
-    df = pd.DataFrame({"x": x, "y": y, "z": z})
+    """Per-id (or global) centroid via cartesian mean on device
+    (reference :975-1097).  Returns [id?, <lat>_centroid, <long>_centroid]."""
+    lat, ml = _dev_num(idf, lat_col)
+    lon, mo = _dev_num(idf, long_col)
+    x, y, z = gk.latlon_to_cartesian(lat, lon)
     if id_col:
-        df[id_col] = _host_cat(idf, id_col) if idf.columns[id_col].kind == "cat" else _host_num(idf, id_col)[0]
-        g = df.groupby(id_col, dropna=True)[["x", "y", "z"]].mean()
-    else:
-        g = df[["x", "y", "z"]].mean().to_frame().T
-    clat = np.degrees(np.arctan2(g["z"], np.hypot(g["x"], g["y"])))
-    clon = np.degrees(np.arctan2(g["y"], g["x"]))
-    out = pd.DataFrame({lat_col + "_centroid": clat.round(6), long_col + "_centroid": clon.round(6)})
-    if id_col:
-        out.insert(0, id_col, g.index)
-    return out.reset_index(drop=True)
+        seg, valid, labels = _id_codes(idf, id_col)
+        if len(labels) == 0:  # all-null id column: empty result frame
+            return pd.DataFrame(columns=[id_col, lat_col + "_centroid", long_col + "_centroid"])
+        nseg = len(labels)
+        clat, clon, cnt = jax.device_get(
+            gk.segment_centroid(x, y, z, seg, valid & ml & mo, nseg)
+        )
+        keep = cnt > 0
+        out = pd.DataFrame(
+            {
+                id_col: np.asarray(labels)[keep],
+                lat_col + "_centroid": np.round(clat[keep].astype(float), 6),
+                long_col + "_centroid": np.round(clon[keep].astype(float), 6),
+            }
+        )
+        return out.reset_index(drop=True)
+    seg = jnp.zeros(idf.padded_rows, jnp.int32)
+    clat, clon, cnt = jax.device_get(gk.segment_centroid(x, y, z, seg, ml & mo, 1))
+    return pd.DataFrame(
+        {
+            lat_col + "_centroid": np.round(clat.astype(float), 6),
+            long_col + "_centroid": np.round(clon.astype(float), 6),
+        }
+    )
 
 
 def weighted_centroid(
     idf: Table, lat_col: str, long_col: str, id_col: str, weight_col: str
 ) -> pd.DataFrame:
-    """Weight-averaged centroid (reference :1099-1221)."""
-    lat, _ = _host_num(idf, lat_col)
-    lon, _ = _host_num(idf, long_col)
-    w, _ = _host_num(idf, weight_col)
-    latr, lonr = np.radians(lat), np.radians(lon)
-    df = pd.DataFrame(
-        {
-            "x": np.cos(latr) * np.cos(lonr) * w,
-            "y": np.cos(latr) * np.sin(lonr) * w,
-            "z": np.sin(latr) * w,
-            "w": w,
-            id_col: _host_cat(idf, id_col) if idf.columns[id_col].kind == "cat" else _host_num(idf, id_col)[0],
-        }
+    """Weight-averaged centroid per id on device (reference :1099-1221)."""
+    lat, ml = _dev_num(idf, lat_col)
+    lon, mo = _dev_num(idf, long_col)
+    w, mw = _dev_num(idf, weight_col)
+    x, y, z = gk.latlon_to_cartesian(lat, lon)
+    seg, valid, labels = _id_codes(idf, id_col)
+    if len(labels) == 0:
+        return pd.DataFrame(
+            columns=[id_col, lat_col + "_weighted_centroid", long_col + "_weighted_centroid"]
+        )
+    nseg = len(labels)
+    clat, clon, sw = jax.device_get(
+        gk.segment_weighted_centroid(x, y, z, w, seg, valid & ml & mo & mw, nseg)
     )
-    g = df.groupby(id_col, dropna=True)[["x", "y", "z", "w"]].sum()
-    clat = np.degrees(np.arctan2(g["z"] / g["w"], np.hypot(g["x"] / g["w"], g["y"] / g["w"])))
-    clon = np.degrees(np.arctan2(g["y"] / g["w"], g["x"] / g["w"]))
+    keep = sw != 0
     out = pd.DataFrame(
-        {id_col: g.index, lat_col + "_weighted_centroid": clat.round(6), long_col + "_weighted_centroid": clon.round(6)}
+        {
+            id_col: np.asarray(labels)[keep],
+            lat_col + "_weighted_centroid": np.round(clat[keep].astype(float), 6),
+            long_col + "_weighted_centroid": np.round(clon[keep].astype(float), 6),
+        }
     )
     return out.reset_index(drop=True)
 
 
 def rog_calculation(idf: Table, lat_col: str, long_col: str, id_col: str) -> pd.DataFrame:
-    """Radius of gyration per id: RMS haversine distance to the centroid
+    """Radius of gyration per id: RMS haversine distance to the centroid —
+    centroid, distances and per-id mean in ONE device program
     (reference :1223-1333)."""
-    cent = centroid(idf, lat_col, long_col, id_col).set_index(id_col)
-    lat, _ = _host_num(idf, lat_col)
-    lon, _ = _host_num(idf, long_col)
-    ids = _host_cat(idf, id_col) if idf.columns[id_col].kind == "cat" else _host_num(idf, id_col)[0]
-    df = pd.DataFrame({"lat": lat, "lon": lon, id_col: ids}).dropna()
-    rows = []
-    for gid, sub in df.groupby(id_col):
-        clat = cent.loc[gid, lat_col + "_centroid"]
-        clon = cent.loc[gid, long_col + "_centroid"]
-        d = geo_utils.haversine_distance(sub["lat"], sub["lon"], clat, clon)
-        rows.append({id_col: gid, "rog": float(np.sqrt(np.mean(d**2)))})
-    return pd.DataFrame(rows)
+    lat, ml = _dev_num(idf, lat_col)
+    lon, mo = _dev_num(idf, long_col)
+    seg, valid, labels = _id_codes(idf, id_col)
+    if len(labels) == 0:
+        return pd.DataFrame(columns=[id_col, "rog"])
+    nseg = len(labels)
+    rog, cnt = jax.device_get(gk.segment_rog(lat, lon, seg, valid & ml & mo, nseg))
+    keep = cnt > 0
+    return pd.DataFrame(
+        {id_col: np.asarray(labels)[keep], "rog": rog[keep].astype(float)}
+    ).reset_index(drop=True)
 
 
 def reverse_geocoding(idf: Table, lat_col: str, long_col: str, **_ignored) -> pd.DataFrame:

@@ -1,0 +1,171 @@
+"""Device-kernel parity: calendar math, tz tables, aggregation windows, and
+geospatial kernels vs pandas / host-codec oracles (round-2 rewrite of the
+datetime + geospatial modules from host pandas to device int32/f32 kernels;
+reference datetime.py:126-2012, geospatial.py:39-1333)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from anovos_tpu.ops import datetime_kernels as dk
+from anovos_tpu.ops import geo_kernels as gk
+from anovos_tpu.shared.table import Table
+from anovos_tpu.data_transformer import datetime as dtm
+from anovos_tpu.data_transformer import geospatial as geo, geo_utils
+
+
+@pytest.fixture(scope="module")
+def epochs():
+    rng = np.random.default_rng(7)
+    return rng.integers(-2_000_000_000, 2_000_000_000, size=5000).astype(np.int32)
+
+
+def test_civil_decomposition_matches_pandas(epochs):
+    s = pd.Series(epochs.astype("int64").astype("datetime64[s]"))
+    c = {k: np.asarray(v) for k, v in dk.civil_from_epoch(jnp.asarray(epochs)).items()}
+    for key, exp in [
+        ("year", s.dt.year), ("month", s.dt.month), ("day", s.dt.day),
+        ("hour", s.dt.hour), ("minute", s.dt.minute), ("second", s.dt.second),
+        ("dayofweek", s.dt.dayofweek), ("dayofyear", s.dt.dayofyear),
+        ("quarter", s.dt.quarter), ("weekofyear", s.dt.isocalendar().week),
+        ("leap", s.dt.is_leap_year),
+    ]:
+        np.testing.assert_array_equal(c[key], exp.to_numpy().astype(c[key].dtype), key)
+
+
+def test_period_boundaries_and_add_months(epochs):
+    s = pd.Series(epochs.astype("int64").astype("datetime64[s]"))
+
+    def to_sec(x):
+        return x.astype("datetime64[ns]").astype("int64").to_numpy() // 10**9
+
+    for period, pname in [("month", "M"), ("quarter", "Q"), ("year", "Y")]:
+        st = np.asarray(dk.period_boundary(jnp.asarray(epochs), "start", period)).astype("int64")
+        np.testing.assert_array_equal(st, to_sec(s.dt.to_period(pname).dt.start_time))
+        en = np.asarray(dk.period_boundary(jnp.asarray(epochs), "end", period)).astype("int64")
+        np.testing.assert_array_equal(en, to_sec(s.dt.to_period(pname).dt.end_time.dt.floor("D")))
+    for months in (1, -13, 25):
+        got = np.asarray(dk.add_months(jnp.asarray(epochs), months)).astype("int64")
+        np.testing.assert_array_equal(got, to_sec(s + pd.DateOffset(months=months)))
+
+
+def test_tz_offset_table(epochs):
+    sub = epochs[:500]
+    tr, off = dk.tz_offset_table("America/New_York", "UTC", int(sub.min()), int(sub.max()))
+    got = np.asarray(dk.apply_offset_table(jnp.asarray(sub), jnp.asarray(tr), jnp.asarray(off))).astype("int64")
+    ss = pd.Series(sub.astype("int64").astype("datetime64[s]"))
+    exp = (
+        ss.dt.tz_localize("America/New_York", ambiguous="NaT", nonexistent="NaT")
+        .dt.tz_convert("UTC").dt.tz_localize(None)
+    )
+    ok = exp.notna().to_numpy()
+    np.testing.assert_array_equal(
+        got[ok], (exp.astype("datetime64[ns]").astype("int64").to_numpy() // 10**9)[ok]
+    )
+
+
+def test_device_aggregator_matches_pandas_groupby():
+    rng = np.random.default_rng(0)
+    n = 3000
+    ts = pd.to_datetime("2022-01-01") + pd.to_timedelta(rng.integers(0, 86400 * 200, n), unit="s")
+    df = pd.DataFrame({"ts": ts, "a": rng.normal(size=n)})
+    df.loc[rng.choice(n, 100, replace=False), "a"] = np.nan
+    t = Table.from_pandas(df)
+    got = dtm.aggregator(t, ["a"], ["count", "mean", "median", "stddev"], "ts", "%Y-%m")
+    exp = df.assign(key=df["ts"].dt.strftime("%Y-%m")).groupby("key")["a"].agg(
+        ["count", "mean", "median", "std"]
+    ).sort_index()
+    got = got.sort_values("ts").reset_index(drop=True)
+    assert list(got["ts"]) == list(exp.index)
+    np.testing.assert_allclose(got["a_count"], exp["count"], rtol=1e-6)
+    np.testing.assert_allclose(got["a_mean"], exp["mean"], rtol=2e-3)
+    np.testing.assert_allclose(got["a_median"], exp["median"], rtol=2e-3, atol=1e-4)
+    np.testing.assert_allclose(got["a_stddev"], exp["std"], rtol=2e-3)
+
+
+def test_device_window_matches_pandas_rolling():
+    rng = np.random.default_rng(1)
+    n = 400
+    df = pd.DataFrame({
+        "ts": pd.date_range("2023-01-01", periods=n, freq="h"),
+        "v": rng.normal(size=n),
+    })
+    df.loc[rng.choice(n, 30, replace=False), "v"] = np.nan
+    t = Table.from_pandas(df)
+    for wt, w in [("expanding", 1), ("rolling", 5)]:
+        gp = dtm.window_aggregator(
+            t, ["v"], ["sum", "mean", "min", "max", "stddev", "count"], "ts",
+            window_type=wt, window_size=w,
+        ).to_pandas()
+        sr = df["v"]
+        for agg, pagg in [("sum", "sum"), ("mean", "mean"), ("min", "min"),
+                          ("max", "max"), ("stddev", "std"), ("count", "count")]:
+            win = sr.expanding() if wt == "expanding" else sr.rolling(w)
+            exp = getattr(win, pagg)().to_numpy()
+            gv = gp[f"v_{agg}_{wt}"].to_numpy()
+            ok = ~(np.isnan(gv) & np.isnan(exp))
+            np.testing.assert_allclose(gv[ok], exp[ok], rtol=2e-3, atol=1e-4, err_msg=f"{wt}/{agg}")
+
+
+def test_geohash_device_exact_vs_host_codec():
+    rng = np.random.default_rng(2)
+    lat = rng.uniform(-90, 90, 2000).astype(np.float32)
+    lon = rng.uniform(-180, 180, 2000).astype(np.float32)
+    digits = np.asarray(gk.geohash_digits(jnp.asarray(lat), jnp.asarray(lon), 9))
+    base32 = np.array(list("0123456789bcdefghjkmnpqrstuvwxyz"))
+    got = ["".join(row) for row in base32[digits]]
+    exp = [geo_utils.geohash_encode(float(a), float(o), 9) for a, o in zip(lat, lon)]
+    assert got == exp
+
+
+def test_device_distances_match_host():
+    rng = np.random.default_rng(3)
+    lat1 = rng.uniform(-85, 85, 1000); lon1 = rng.uniform(-179, 179, 1000)
+    lat2 = rng.uniform(-85, 85, 1000); lon2 = rng.uniform(-179, 179, 1000)
+    args = tuple(jnp.asarray(v, jnp.float32) for v in (lat1, lon1, lat2, lon2))
+    np.testing.assert_allclose(
+        np.asarray(gk.haversine(*args)),
+        geo_utils.haversine_distance(lat1, lon1, lat2, lon2), rtol=2e-3)
+    np.testing.assert_allclose(
+        np.asarray(gk.vincenty(*args)),
+        geo_utils.vincenty_distance(lat1, lon1, lat2, lon2), rtol=5e-3)
+    np.testing.assert_allclose(
+        np.asarray(gk.equirectangular(*args)),
+        geo_utils.euclidean_distance(lat1, lon1, lat2, lon2), rtol=2e-3)
+
+
+def test_segment_centroid_and_rog():
+    rng = np.random.default_rng(4)
+    n = 600
+    df = pd.DataFrame({
+        "lat": rng.uniform(-60, 60, n), "lon": rng.uniform(-170, 170, n),
+        "id": rng.choice(["x", "y"], n),
+    })
+    t = Table.from_pandas(df)
+    c = geo.centroid(t, "lat", "lon", "id").set_index("id")
+    latr, lonr = np.radians(df["lat"]), np.radians(df["lon"])
+    g = pd.DataFrame({
+        "x": np.cos(latr) * np.cos(lonr), "y": np.cos(latr) * np.sin(lonr),
+        "z": np.sin(latr), "id": df["id"],
+    }).groupby("id").mean()
+    exp_lat = np.degrees(np.arctan2(g["z"], np.hypot(g["x"], g["y"])))
+    np.testing.assert_allclose(c["lat_centroid"], exp_lat, atol=1e-3)
+    r = geo.rog_calculation(t, "lat", "lon", "id").set_index("id")
+    for gid, sub in df.groupby("id"):
+        d = geo_utils.haversine_distance(
+            sub["lat"], sub["lon"], c.loc[gid, "lat_centroid"], c.loc[gid, "lon_centroid"]
+        )
+        assert abs(float(r.loc[gid, "rog"]) - float(np.sqrt(np.mean(d**2)))) < 2e-3 * float(r.loc[gid, "rog"])
+
+
+def test_invalid_entries_device_uniques():
+    from anovos_tpu.data_analyzer.quality_checker import invalidEntries_detection
+
+    df = pd.DataFrame({"n": [1.0, 2.0, 9999.0, 9999.0, 3.0, np.nan]})
+    t = Table.from_pandas(df)
+    odf, stats = invalidEntries_detection(t, ["n"], detection_type="auto")
+    row = stats.set_index("attribute").loc["n"]
+    assert row["invalid_count"] == 2  # both 9999 rows
+    assert "9999" in row["invalid_entries"]
