@@ -53,33 +53,117 @@ def _silhouette(X: np.ndarray, labels: np.ndarray, sample: int = 2000) -> float:
             (Xs**2).sum(1)[:, None] - 2 * Xs @ Xs.T + (Xs**2).sum(1)[None, :], 0
         )
     )
-    sil = []
-    for i in range(len(Xs)):
-        same = ls == ls[i]
-        same[i] = False
-        a = D[i][same].mean() if same.any() else 0.0
-        bs = [D[i][ls == other].mean() for other in np.unique(ls) if other != ls[i]]
-        b = min(bs) if bs else 0.0
-        sil.append((b - a) / max(a, b, 1e-30))
+    # fully vectorized: per-cluster distance sums via one matmul
+    uniq, inv = np.unique(ls, return_inverse=True)
+    k = len(uniq)
+    C = np.zeros((len(Xs), k))
+    C[np.arange(len(Xs)), inv] = 1.0
+    sums = D @ C  # (n, k) total distance to each cluster
+    cnt = C.sum(axis=0)  # (k,)
+    own = cnt[inv]
+    a = np.where(own > 1, sums[np.arange(len(Xs)), inv] / np.maximum(own - 1, 1), 0.0)
+    means = sums / np.maximum(cnt[None, :], 1)
+    means[np.arange(len(Xs)), inv] = np.inf  # exclude own cluster from b
+    b = means.min(axis=1)
+    b = np.where(np.isfinite(b), b, 0.0)
+    sil = (b - a) / np.maximum(np.maximum(a, b), 1e-30)
     return float(np.mean(sil))
 
 
 def descriptive_stats_geospatial(idf: Table, lat_col: str, lon_col: str, max_records: int = 100000) -> dict:
     """Per lat-lon pair summary (reference :64-312)."""
     pts = _latlon_points(idf, lat_col, lon_col, max_records)
+    stats, _ = _pair_profile(idf, lat_col, lon_col, pts)
+    return stats
+
+
+def _pair_profile(idf: Table, lat_col: str, lon_col: str, pts: np.ndarray):
+    """(stats dict, rounded-grid pair counts) for one lat-lon pair — shared
+    by the stats row and the top-locations dump so the grid count runs once.
+    Range/center/quartile stats plus distinct-value and most-common-pair
+    measures."""
     if len(pts) == 0:
-        return {"lat_col": lat_col, "lon_col": lon_col, "records": 0}
+        return {"lat_col": lat_col, "lon_col": lon_col, "records": 0}, None
+    grid = pd.DataFrame({"lat": pts[:, 0].round(4), "lon": pts[:, 1].round(4)})
+    pair_counts = grid.value_counts()
+    most_pair = pair_counts.index[0]
+    null_pct = 1.0 - len(pts) / max(idf.nrows, 1)
+    q = np.percentile(pts, [25, 50, 75], axis=0)
     return {
         "lat_col": lat_col,
         "lon_col": lon_col,
         "records": len(pts),
+        "null_pct": round(null_pct, 4),
+        "distinct_lat": int(pd.Series(pts[:, 0]).nunique()),
+        "distinct_lon": int(pd.Series(pts[:, 1]).nunique()),
+        "distinct_pairs": int(len(pair_counts)),
+        "most_common_pair": f"[{most_pair[0]},{most_pair[1]}]",
+        "most_common_pair_count": int(pair_counts.iloc[0]),
         "lat_min": round(float(pts[:, 0].min()), 6),
         "lat_max": round(float(pts[:, 0].max()), 6),
         "lon_min": round(float(pts[:, 1].min()), 6),
         "lon_max": round(float(pts[:, 1].max()), 6),
         "lat_mean": round(float(pts[:, 0].mean()), 6),
         "lon_mean": round(float(pts[:, 1].mean()), 6),
+        "lat_q1": round(float(q[0, 0]), 6),
+        "lat_median": round(float(q[1, 0]), 6),
+        "lat_q3": round(float(q[2, 0]), 6),
+        "lon_q1": round(float(q[0, 1]), 6),
+        "lon_median": round(float(q[1, 1]), 6),
+        "lon_q3": round(float(q[2, 1]), 6),
+    }, pair_counts
+
+
+def _write_geo_charts(master_path: str, name: str, top: pd.DataFrame) -> None:
+    """Plotly JSON chart dumps for the report's geospatial tab (reference
+    :851-1117 mapbox scatter/heatmap — rendered token-free as scattergeo +
+    density contour over the top location grid)."""
+    if top.empty:
+        return
+    scatter = {
+        "data": [
+            {
+                "type": "scattergeo",
+                "lat": top["lat"].tolist(),
+                "lon": top["lon"].tolist(),
+                "mode": "markers",
+                "marker": {
+                    "size": np.clip(4 + 16 * top["count"] / max(top["count"].max(), 1), 4, 20).tolist(),
+                    "color": top["count"].tolist(),
+                    "colorscale": "Viridis",
+                    "showscale": True,
+                },
+                "text": [f"({a},{o}) n={c}" for a, o, c in zip(top["lat"], top["lon"], top["count"])],
+            }
+        ],
+        "layout": {
+            "title": {"text": f"top locations — {name}"},
+            "geo": {"showland": True, "landcolor": "#eee", "fitbounds": "locations"},
+            "template": "plotly_white",
+        },
     }
+    heat = {
+        "data": [
+            {
+                "type": "histogram2dcontour",
+                "x": top["lon"].tolist(),
+                "y": top["lat"].tolist(),
+                "z": top["count"].tolist(),
+                "histfunc": "sum",
+                "colorscale": "Hot",
+                "reversescale": True,
+            }
+        ],
+        "layout": {
+            "title": {"text": f"location density — {name}"},
+            "xaxis": {"title": {"text": "longitude"}},
+            "yaxis": {"title": {"text": "latitude"}},
+            "template": "plotly_white",
+        },
+    }
+    for kind, fig in [("scatter", scatter), ("heat", heat)]:
+        with open(ends_with(master_path) + f"geo_{kind}_{name}", "w") as f:
+            json.dump(fig, f)
 
 
 def cluster_analysis(
@@ -106,11 +190,19 @@ def cluster_analysis(
     m0, m1, mstep = (int(float(x)) for x in str(min_samples).split(","))
     rows = []
     sub = pts
-    if len(sub) > 20000:  # DBSCAN grid is O(n²) — reference caps records too
-        sub = sub[np.random.default_rng(2).choice(len(sub), 20000, replace=False)]
+    grid_cap = int(os.environ.get("ANOVOS_DBSCAN_GRID_SAMPLE", 8000))
+    if len(sub) > grid_cap:
+        # the grid scan is a hyperparameter search: O(n²) propagation per
+        # combo, so it runs on a subsample with min_samples SCALED by the
+        # sample fraction (an absolute density threshold on a subsample
+        # would mean a different density than the reference's full-data
+        # sklearn scan — and unscaled was both wrong and 6× slower)
+        sub = sub[np.random.default_rng(2).choice(len(sub), grid_cap, replace=False)]
+    frac = len(sub) / max(len(pts), 1)
     for e in np.arange(e0, e1 + 1e-9, estep):
         for m in range(m0, m1 + 1, mstep):
-            labels = dbscan_fit(sub, float(e), int(m))
+            m_eff = max(2, int(round(m * frac)))
+            labels = dbscan_fit(sub, float(e), m_eff)
             n_clusters = len(set(labels[labels >= 0]))
             score = _silhouette(sub, labels) if n_clusters >= 2 else -1.0
             rows.append(
@@ -146,16 +238,38 @@ def geospatial_autodetection(
     lat_cols, lon_cols, gh_cols = ll_gh_cols(idf, max_analysis_records)
     stats_rows = []
     for lat_c, lon_c in zip(lat_cols, lon_cols):
-        stats_rows.append(descriptive_stats_geospatial(idf, lat_c, lon_c, max_analysis_records))
         pts = _latlon_points(idf, lat_c, lon_c, max_analysis_records)
+        stats, pair_counts = _pair_profile(idf, lat_c, lon_c, pts)
+        stats_rows.append(stats)
         if len(pts) >= 50:
             km, db = cluster_analysis(pts, max_cluster or 20, eps, min_samples)
             km.to_csv(ends_with(master_path) + f"geospatial_kmeans_{lat_c}_{lon_c}.csv", index=False)
             db.to_csv(ends_with(master_path) + f"geospatial_dbscan_{lat_c}_{lon_c}.csv", index=False)
-        # top locations (rounded 4dp grid)
-        grid = pd.DataFrame({"lat": pts[:, 0].round(4), "lon": pts[:, 1].round(4)})
-        top = grid.value_counts().head(top_geo_records).reset_index(name="count")
+        # top locations (rounded 4dp grid, counted once in _pair_profile)
+        top = (
+            pair_counts.head(top_geo_records).reset_index(name="count")
+            if pair_counts is not None
+            else pd.DataFrame(columns=["lat", "lon", "count"])
+        )
         top.to_csv(ends_with(master_path) + f"geospatial_top_{lat_c}_{lon_c}.csv", index=False)
+        _write_geo_charts(master_path, f"{lat_c}_{lon_c}", top)
+        # reference-style two-column overall summary table per pair
+        s = stats_rows[-1]
+        if s.get("records"):
+            pd.DataFrame(
+                {
+                    "stats": [
+                        "Distinct {Lat, Long} Pair", "Distinct Latitude", "Distinct Longitude",
+                        "Most Common {Lat, Long} Pair", "Most Common Pair Occurrence",
+                    ],
+                    "count": [
+                        s["distinct_pairs"], s["distinct_lat"], s["distinct_lon"],
+                        s["most_common_pair"], s["most_common_pair_count"],
+                    ],
+                }
+            ).to_csv(
+                ends_with(master_path) + f"geospatial_overall_{lat_c}_{lon_c}.csv", index=False
+            )
     for gh_c in gh_cols:
         col = idf.columns[gh_c]
         from anovos_tpu.ops.segment import code_counts
@@ -163,15 +277,37 @@ def geospatial_autodetection(
         cnts = np.asarray(code_counts(col.data, col.mask, max(len(col.vocab), 1)))
         order = np.argsort(-cnts)[:top_geo_records]
         decoded = [geohash_decode(str(col.vocab[j])) for j in order]
-        pd.DataFrame(
+        top_gh = pd.DataFrame(
             {
                 "geohash": [str(col.vocab[j]) for j in order],
                 "count": cnts[order].astype(int),
                 "lat": [round(d[0], 6) for d in decoded],
                 "lon": [round(d[1], 6) for d in decoded],
             }
-        ).to_csv(ends_with(master_path) + f"geospatial_top_{gh_c}.csv", index=False)
-        stats_rows.append({"lat_col": gh_c, "lon_col": "", "records": int(cnts.sum())})
+        )
+        top_gh.to_csv(ends_with(master_path) + f"geospatial_top_{gh_c}.csv", index=False)
+        _write_geo_charts(master_path, gh_c, top_gh)
+        precisions = {len(str(v)) for v in col.vocab[:1000]}
+        pd.DataFrame(
+            {
+                "stats": ["Distinct Geohash", "Geohash Precision Level", "Most Common Geohash"],
+                "count": [
+                    int((cnts > 0).sum()),
+                    ",".join(str(p) for p in sorted(precisions)),
+                    str(col.vocab[order[0]]) if len(order) else "",
+                ],
+            }
+        ).to_csv(ends_with(master_path) + f"geospatial_overall_{gh_c}.csv", index=False)
+        stats_rows.append(
+            {
+                "lat_col": gh_c,
+                "lon_col": "",
+                "records": int(cnts.sum()),
+                "distinct_pairs": int((cnts > 0).sum()),
+                "most_common_pair": str(col.vocab[order[0]]) if len(order) else "",
+                "most_common_pair_count": int(cnts[order[0]]) if len(order) else 0,
+            }
+        )
     if stats_rows:
         pd.DataFrame(stats_rows).to_csv(
             ends_with(master_path) + "geospatial_stats.csv", index=False

@@ -106,6 +106,12 @@ def read_dataset(file_path: str, file_type: str, file_configs: Optional[dict] = 
     concatenated host-side before upload.
     """
     cfg = dict(file_configs or {})
+    if jax.process_count() > 1:
+        # multi-host runtime: each host reads its file slice and columns are
+        # assembled into global arrays (distributed_ingest module)
+        from anovos_tpu.data_ingest.distributed_ingest import read_dataset_distributed
+
+        return read_dataset_distributed(file_path, file_type, file_configs)
     files = _resolve_files(file_path, file_type)
     if file_type == "avro":
         # native-friendly path: per-file decode straight to Tables (string
@@ -121,6 +127,13 @@ def read_dataset(file_path: str, file_type: str, file_configs: Optional[dict] = 
             tables.append(Table.from_numpy(_coerce_numeric_strings(decoded), nrows=n))
         if tables:
             return tables[0] if len(tables) == 1 else concatenate_dataset(*tables, method_type="name")
+    df = read_host_frame(files, file_type, cfg)
+    return Table.from_pandas(df)
+
+
+def read_host_frame(files: List[str], file_type: str, cfg: dict) -> pd.DataFrame:
+    """Host pandas frame from part files (shared by the single-process and
+    multi-host loaders)."""
     frames = []
     for f in files:
         if file_type == "csv":
@@ -162,7 +175,7 @@ def read_dataset(file_path: str, file_type: str, file_configs: Optional[dict] = 
                     df[c] = coerced
                 elif not nonnull.any():
                     df[c] = coerced  # all-null column → numeric NaN column
-    return Table.from_pandas(df)
+    return df
 
 
 def write_dataset(

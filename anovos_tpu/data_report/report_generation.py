@@ -1,11 +1,20 @@
 """Full report generation (reference: data_report/report_generation.py:3984).
 
 Consumes the master_path CSV/JSON contract (files named after analyzer
-functions + ``freqDist_``/``eventDist_``/``drift_`` chart JSONs) and emits a
-single self-contained ``ml_anovos_report.html``.  The reference renders via
-datapane; here the report is a dependency-free HTML document with tabbed
-sections, inline tables, and plotly.js (CDN) hydrating the same chart JSON
-objects the preprocessing step wrote.
+functions + ``freqDist_``/``eventDist_``/``drift_``/``outlier_``/``geo_``
+chart JSONs) and emits a single self-contained ``ml_anovos_report.html``.
+The reference renders via datapane; here the report is a dependency-free
+HTML document with tabbed sections, client-paged tables, and plotly.js
+(CDN) hydrating the same chart JSON objects the preprocessing step wrote.
+
+Tab parity with the reference (:4111-4136 lists + tab builders):
+executive summary with the 10-flag diagnosis matrix and drift/stability
+big numbers (:524-906), wiki (:909), descriptive statistics (:994),
+quality check (:1154), attribute associations (:1291), drift & stability
+with per-attribute SI gauges and metric line charts (:99, :1434), the
+time-series viz suite at daily/hourly/weekly grain with seasonal
+decomposition and ADF/KPSS stationarity (:1942-3208), and the geospatial
+tab with location scatter/density charts and cluster tables (:3210-3982).
 """
 
 from __future__ import annotations
@@ -42,9 +51,29 @@ _QC_FILES = [
     "invalidEntries_detection",
 ]
 _AE_FILES = ["correlation_matrix", "IV_calculation", "IG_calculation", "variable_clustering"]
-_DRIFT_FILES = ["drift_statistics", "stability_index", "stabilityIndex_metrics"]
 
 _PLOTLY_CDN = "https://cdn.plot.ly/plotly-2.35.2.min.js"
+
+_STABILITY_INTERPRETATION = pd.DataFrame(
+    {
+        "StabilityIndex": ["3.5 - 4.0", "3.0 - 3.5", "2.0 - 3.0", "1.0 - 2.0", "0.0 - 1.0"],
+        "Order": ["Very Stable", "Stable", "Marginally Stable", "Unstable", "Very Unstable"],
+    }
+)
+
+
+def _si_category(v: float) -> str:
+    if v >= 3.5:
+        return "Very Stable"
+    if v >= 3:
+        return "Stable"
+    if v >= 2:
+        return "Marginally Stable"
+    if v >= 1:
+        return "Unstable"
+    if v >= 0:
+        return "Very Unstable"
+    return "Out of Range"
 
 
 def _json_for_script(obj) -> str:
@@ -63,14 +92,40 @@ def _read_csv(master_path: str, name: str) -> Optional[pd.DataFrame]:
     return None
 
 
-def _table_html(df: pd.DataFrame, title: str) -> str:
+_table_seq = [0]
+
+
+def _table_html(df: pd.DataFrame, title: str, page: int = 200) -> str:
+    """Client-paged table: the FULL frame ships in the page (no silent
+    head() truncation — round-1 Weak #7); rows beyond ``page`` hide behind
+    a pager."""
+    _table_seq[0] += 1
+    tid = f"tbl{_table_seq[0]}"
+    n = len(df)
+    body = df.to_html(index=False, classes="stats", border=0, na_rep="", table_id=tid)
+    pager = ""
+    if n > page:
+        pager = (
+            f"<div class='pager' data-t='{tid}' data-n='{n}' data-p='{page}'>"
+            f"<button onclick=\"pgStep('{tid}',-1)\">&laquo; prev</button>"
+            f"<span id='{tid}_lbl'></span>"
+            f"<button onclick=\"pgStep('{tid}',1)\">next &raquo;</button>"
+            f"<button onclick=\"pgAll('{tid}')\">show all {n}</button></div>"
+        )
+    return f"<h3>{escape(title)}</h3>" + body + pager
+
+
+def _fig_div(fig: dict, div_id: str, height: int = 320) -> str:
+    # anPlot uses plotly.js when the CDN loaded, else the inline SVG
+    # fallback renderer — the report stays readable with zero egress
     return (
-        f"<h3>{escape(title)}</h3>"
-        + df.head(200).to_html(index=False, classes="stats", border=0, na_rep="")
+        f"<div class='chart' id='{div_id}' style='height:{height}px'></div>"
+        f"<script>anPlot('{div_id}', {_json_for_script(fig.get('data', []))}, "
+        f"{_json_for_script(fig.get('layout', {}))});</script>"
     )
 
 
-def _charts_html(master_path: str, prefix: str, title: str, limit: int = 60) -> str:
+def _charts_html(master_path: str, prefix: str, title: str, limit: int = 60, height: int = 320) -> str:
     files = sorted(glob.glob(ends_with(master_path) + prefix + "*"))
     files = [f for f in files if not f.endswith(".csv")]
     if not files:
@@ -82,14 +137,356 @@ def _charts_html(master_path: str, prefix: str, title: str, limit: int = 60) -> 
                 fig = json.load(fh)
         except Exception:
             continue
-        div_id = f"{prefix}{i}"
-        out.append(
-            f"<div class='chart' id='{div_id}'></div>"
-            f"<script>Plotly.newPlot('{div_id}', {_json_for_script(fig['data'])}, "
-            f"{_json_for_script(fig.get('layout', {}))}, {{displayModeBar: false}});</script>"
-        )
+        out.append(_fig_div(fig, f"{prefix.rstrip('_')}{i}", height))
     out.append("</div>")
     return "".join(out)
+
+
+def _line_fig(x, series: Dict[str, list], title: str, ytitle: str = "") -> dict:
+    return {
+        "data": [
+            {"type": "scatter", "mode": "lines+markers", "x": list(x), "y": list(y), "name": name}
+            for name, y in series.items()
+        ],
+        "layout": {
+            "title": {"text": title},
+            "template": "plotly_white",
+            "yaxis": {"title": {"text": ytitle}},
+            "margin": {"t": 40, "b": 30},
+        },
+    }
+
+
+def _bar_fig(x, y, title: str) -> dict:
+    return {
+        "data": [{"type": "bar", "x": list(x), "y": list(y), "marker": {"color": "#45526c"}}],
+        "layout": {"title": {"text": title}, "template": "plotly_white", "margin": {"t": 40, "b": 30}},
+    }
+
+
+# ----------------------------------------------------------------------
+# executive summary (reference :524-906)
+# ----------------------------------------------------------------------
+def _flag_list(df: Optional[pd.DataFrame], query: str, metric: str) -> tuple:
+    if df is None:
+        return (metric, None)
+    try:
+        vals = list(df.query(query)["attribute"].values)
+        return (metric, vals or None)
+    except Exception:
+        return (metric, None)
+
+
+def _executive_summary(
+    master_path: str, id_col: str, label_col: str, corr_threshold: float, iv_threshold: float
+) -> str:
+    gs = _read_csv(master_path, "global_summary")
+    if gs is None:
+        return ""  # let the caller's "no global summary found" fallback show
+    html = ["<h3>Key Report Highlights</h3>"]
+    kv: Dict[str, str] = dict(zip(gs["metric"].astype(str), gs["value"].astype(str)))
+    rows_count = int(float(kv.get("rows_count", 0) or 0))
+    num_n = int(float(kv.get("numcols_count", 0) or 0))
+    cat_n = int(float(kv.get("catcols_count", 0) or 0))
+    html.append(
+        f"<p>The dataset contains <b>{rows_count:,}</b> records and "
+        f"<b>{num_n + cat_n}</b> attributes (<b>{num_n}</b> numerical + "
+        f"<b>{cat_n}</b> categorical).</p>"
+    )
+    if label_col:
+        html.append(f"<p>Target variable is <b>{escape(label_col)}</b>.</p>")
+        # label distribution pie from the freqDist chart json (reference :560)
+        fd = ends_with(master_path) + "freqDist_" + str(label_col)
+        if os.path.exists(fd):
+            try:
+                with open(fd) as fh:
+                    fig = json.load(fh)
+                trace = fig["data"][0]
+                pie = {
+                    "data": [
+                        {
+                            "type": "pie",
+                            "labels": trace.get("x", []),
+                            "values": trace.get("y", []),
+                            "textinfo": "label+percent",
+                            "pull": [0, 0.1],
+                        }
+                    ],
+                    "layout": {"title": {"text": f"{label_col} distribution"}, "template": "plotly_white"},
+                }
+                html.append(_fig_div(pie, "label_pie", 300))
+            except Exception:
+                pass
+    else:
+        html.append("<p>There is <b>no</b> target variable in the dataset.</p>")
+
+    # --- the 10 diagnosis flags (reference :613-760) ---
+    disp = _read_csv(master_path, "measures_of_dispersion")
+    shape = _read_csv(master_path, "measures_of_shape")
+    counts = _read_csv(master_path, "measures_of_counts")
+    bias = _read_csv(master_path, "biasedness_detection")
+    outl = _read_csv(master_path, "outlier_detection")
+    iv = _read_csv(master_path, "IV_calculation")
+    corr = _read_csv(master_path, "correlation_matrix")
+    flags = [
+        _flag_list(disp, "cov > 1", "High Variance"),
+        _flag_list(shape, "skewness > 0", "Positive Skewness"),
+        _flag_list(shape, "skewness < 0", "Negative Skewness"),
+        _flag_list(shape, "kurtosis > 0", "High Kurtosis"),
+        _flag_list(shape, "kurtosis < 0", "Low Kurtosis"),
+        _flag_list(counts, "fill_pct < 0.7", "Low Fill Rates"),
+        _flag_list(bias, ("treated > 0" if bias is not None and "treated" in bias else "flagged > 0"), "High Biasedness"),
+        ("Outliers", list(outl["attribute"].values) if outl is not None and len(outl) else None),
+        ("High Correlation", _correlated_cols(corr, corr_threshold)),
+        _flag_list(iv, f"iv > {iv_threshold}", "Significant Attributes"),
+    ]
+    pairs = []
+    for metric, attrs in flags:
+        for a in attrs or []:
+            pairs.append((metric, a))
+    all_attrs = sorted({a for _, a in pairs})
+    metrics_order = [
+        "Outliers", "Significant Attributes", "Positive Skewness", "Negative Skewness",
+        "High Variance", "High Correlation", "High Kurtosis", "Low Kurtosis",
+        "Low Fill Rates", "High Biasedness",
+    ]
+    if all_attrs:
+        piv = pd.DataFrame("✘", index=all_attrs, columns=metrics_order)
+        for metric, a in pairs:
+            if metric in piv.columns:
+                piv.loc[a, metric] = "✔"
+        piv.index.name = "Attribute"
+        html.append("<p>Data Diagnosis:</p>")
+        html.append(_table_html(piv.reset_index(), "attribute diagnosis matrix"))
+
+    # --- drift / stability big numbers (reference :793-886) ---
+    drift = _read_csv(master_path, "drift_statistics")
+    stab = _read_csv(master_path, "stability_index")
+    cards = []
+    if drift is not None and len(drift) and "flagged" in drift:
+        drifted = int((drift["flagged"] > 0).sum())
+        total = len(drift)
+        cards += [
+            ("# Drifted Attributes", f"{drifted} out of {total}"),
+            ("% Drifted Attributes", f"{100 * drifted / max(total, 1):.2f}%"),
+        ]
+    if stab is not None and len(stab) and "flagged" in stab:
+        unstable = int((stab["flagged"] > 0).sum())
+        total = len(stab)
+        cards += [
+            ("# Unstable Attributes", f"{unstable} out of {total}"),
+            ("% Unstable Attributes", f"{100 * unstable / max(total, 1):.2f}%"),
+        ]
+    if cards:
+        html.append("<p>Data Health based on Drift Metrics &amp; Stability Index:</p>")
+        html.append(
+            "".join(
+                f"<div class='card'><div class='cardval'>{escape(v)}</div>"
+                f"<div class='cardlbl'>{escape(k)}</div></div>"
+                for k, v in cards
+            )
+        )
+    if gs is not None:
+        html.append(_table_html(gs, "global summary"))
+    if id_col:
+        html.append(f"<p>id column: <b>{escape(id_col)}</b></p>")
+    return "".join(html)
+
+
+def _correlated_cols(corr: Optional[pd.DataFrame], threshold: float) -> Optional[list]:
+    """Upper-triangle scan for attributes correlated beyond the threshold
+    (reference :711-728)."""
+    if corr is None or "attribute" not in corr:
+        return None
+    attrs = [a for a in corr["attribute"].values if a in corr.columns]
+    if not attrs:
+        return None
+    m = corr.set_index("attribute")[attrs]
+    tri = m.where(np.triu(np.ones(m.shape), k=1).astype(bool))
+    out = [c for c in tri.columns if (tri[c] > threshold).any()]
+    return out or None
+
+
+# ----------------------------------------------------------------------
+# drift & stability tab (reference :99-231, :1434-1936)
+# ----------------------------------------------------------------------
+def _stability_charts(master_path: str, limit: int = 12) -> str:
+    stab = _read_csv(master_path, "stability_index")
+    hist = _read_csv(master_path, "stabilityIndex_metrics")
+    if stab is None or not len(stab):
+        return ""
+    html = ["<h3>stability deep-dive</h3>"]
+    html.append(_table_html(_STABILITY_INTERPRETATION, "stability index interpretation"))
+    # most interesting first: flagged, then lowest SI
+    stab = stab.sort_values(["flagged", "stability_index"], ascending=[False, True])
+    shown = 0
+    for _, row in stab.iterrows():
+        if shown >= limit:
+            break
+        col = row["attribute"]
+        si = float(row["stability_index"]) if row["stability_index"] == row["stability_index"] else 0.0
+        gauge = {
+            "data": [
+                {
+                    "type": "indicator",
+                    "mode": "gauge+number",
+                    "value": si,
+                    "gauge": {
+                        "axis": {"range": [None, 4]},
+                        "steps": [
+                            {"range": [0, 1], "color": "#b2182b"},
+                            {"range": [1, 2], "color": "#ef8a62"},
+                            {"range": [2, 3], "color": "#fddbc7"},
+                            {"range": [3, 3.5], "color": "#a1d99b"},
+                            {"range": [3.5, 4], "color": "#41ab5d"},
+                        ],
+                        "bar": {"color": "#16213e"},
+                    },
+                    "title": {"text": f"{col}: {_si_category(si)}"},
+                }
+            ],
+            "layout": {"template": "plotly_white", "margin": {"t": 60, "b": 10}},
+        }
+        html.append(f"<h4>Stability Index for {escape(str(col).upper())}</h4><div class='chartgrid'>")
+        html.append(_fig_div(gauge, f"sig_{shown}", 280))
+        if hist is not None and "attribute" in hist:
+            sub = hist[hist["attribute"] == col].sort_values("idx")
+            if len(sub):
+                for metric in ("mean", "stddev", "kurtosis"):
+                    if metric in sub:
+                        cv = row.get(f"{metric}_cv")
+                        html.append(
+                            _fig_div(
+                                _line_fig(
+                                    sub["idx"], {metric: sub[metric].tolist()},
+                                    f"CV of {metric} is {cv}", metric,
+                                ),
+                                f"sil_{shown}_{metric}", 280,
+                            )
+                        )
+        html.append("</div>")
+        shown += 1
+    return "".join(html)
+
+
+# ----------------------------------------------------------------------
+# time-series tab (reference :1942-3208)
+# ----------------------------------------------------------------------
+def _ts_tab(master_path: str) -> str:
+    mp = ends_with(master_path)
+    stats = _read_csv(master_path, "ts_stats")
+    if stats is None or not len(stats):
+        return ""
+    html = [_table_html(stats, "timestamp column eligibility")]
+    land = _read_csv(master_path, "ts_landscape")
+    if land is not None and len(land):
+        html.append(_table_html(land, "time-series landscape"))
+    ts_cols = [str(a) for a in stats.loc[stats.get("eligible", 0) == 1, "attribute"]]
+    for i, c in enumerate(ts_cols):
+        html.append(f"<h3>‣ {escape(c)}</h3><div class='chartgrid'>")
+        daily = _read_csv(master_path, f"ts_daily_{c}")
+        if daily is not None and len(daily):
+            html.append(
+                _fig_div(
+                    _line_fig(daily.iloc[:, 0], {"records": daily["count"].tolist()},
+                              f"daily volume — {c}", "count"),
+                    f"tsd_{i}",
+                )
+            )
+        hourly = _read_csv(master_path, f"ts_daypart_{c}")
+        if hourly is not None and len(hourly):
+            html.append(_fig_div(_bar_fig(hourly.iloc[:, 0], hourly["count"], f"daypart volume — {c}"), f"tsh_{i}"))
+        weekly = _read_csv(master_path, f"ts_weekly_{c}")
+        if weekly is not None and len(weekly):
+            dows = ["Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun"]
+            x = [dows[int(v)] if str(v).isdigit() and int(v) < 7 else v for v in weekly.iloc[:, 0]]
+            html.append(_fig_div(_bar_fig(x, weekly["count"], f"weekday volume — {c}"), f"tsw_{i}"))
+        html.append("</div>")
+        # numeric attribute trends per grain
+        numd = _read_csv(master_path, f"ts_num_daily_{c}")
+        if numd is not None and len(numd):
+            html.append("<h4>attribute trends (daily)</h4><div class='chartgrid'>")
+            for j, (attr, sub) in enumerate(numd.groupby("attribute")):
+                html.append(
+                    _fig_div(
+                        _line_fig(
+                            sub["date"],
+                            {"mean": sub["mean"].tolist(), "median": sub["median"].tolist()},
+                            f"{attr} over time", attr,
+                        ),
+                        f"tsnd_{i}_{j}", 280,
+                    )
+                )
+            html.append("</div>")
+        for grain, gname in [("hourly", "daypart"), ("weekly", "weekday")]:
+            numg = _read_csv(master_path, f"ts_num_{grain}_{c}")
+            if numg is not None and len(numg):
+                html.append(f"<h4>attribute means by {gname}</h4><div class='chartgrid'>")
+                for j, (attr, sub) in enumerate(numg.groupby("attribute")):
+                    html.append(
+                        _fig_div(_bar_fig(sub["bucket"], sub["mean"], f"{attr} mean by {gname}"),
+                                 f"tsn{grain[0]}_{i}_{j}", 260)
+                    )
+                html.append("</div>")
+        catd = _read_csv(master_path, f"ts_cat_daily_{c}")
+        if catd is not None and len(catd):
+            html.append("<h4>categorical mix over time</h4><div class='chartgrid'>")
+            for j, (attr, sub) in enumerate(catd.groupby("attribute")):
+                piv = sub.pivot_table(index="date", columns="category", values="count", fill_value=0)
+                fig = {
+                    "data": [
+                        {"type": "scatter", "mode": "lines", "stackgroup": "one",
+                         "x": list(piv.index), "y": piv[cat].tolist(), "name": str(cat)}
+                        for cat in piv.columns
+                    ],
+                    "layout": {"title": {"text": f"{attr} mix"}, "template": "plotly_white",
+                               "margin": {"t": 40, "b": 30}},
+                }
+                html.append(_fig_div(fig, f"tscat_{i}_{j}", 280))
+            html.append("</div>")
+        dec = _read_csv(master_path, f"ts_decompose_{c}")
+        if dec is not None and len(dec):
+            html.append("<h4>seasonal decomposition (daily volume)</h4><div class='chartgrid'>")
+            for j, part in enumerate(["observed", "trend", "seasonal", "residual"]):
+                if part in dec:
+                    html.append(
+                        _fig_div(_line_fig(dec["date"], {part: dec[part].tolist()}, part),
+                                 f"tsdec_{i}_{j}", 240)
+                    )
+            html.append("</div>")
+        stat = _read_csv(master_path, f"ts_stationarity_{c}")
+        if stat is not None and len(stat):
+            html.append(_table_html(stat, f"stationarity tests (ADF + KPSS) — {c}"))
+    return "".join(html)
+
+
+# ----------------------------------------------------------------------
+# geospatial tab (reference :3210-3982)
+# ----------------------------------------------------------------------
+def _geo_tab(master_path: str) -> str:
+    stats = _read_csv(master_path, "geospatial_stats")
+    if stats is None or not len(stats):
+        return ""
+    html = [_table_html(stats, "geospatial field summary")]
+    mp = ends_with(master_path)
+    for f in sorted(glob.glob(mp + "geospatial_overall_*.csv")):
+        name = os.path.basename(f)[:-4]
+        df = _read_csv(master_path, name)
+        if df is not None and len(df):
+            html.append(_table_html(df, name.replace("geospatial_overall_", "overall stats — ")))
+    html.append(_charts_html(master_path, "geo_scatter_", "location scatter maps", height=420))
+    html.append(_charts_html(master_path, "geo_heat_", "location density", height=420))
+    for prefix, title in [
+        ("geospatial_top_", "top locations — "),
+        ("geospatial_kmeans_", "kmeans clusters — "),
+        ("geospatial_dbscan_", "dbscan grid — "),
+    ]:
+        for f in sorted(glob.glob(mp + prefix + "*.csv")):
+            name = os.path.basename(f)[:-4]
+            df = _read_csv(master_path, name)
+            if df is not None and len(df):
+                html.append(_table_html(df, title + name.replace(prefix, "")))
+    return "".join(html)
 
 
 _CSS = """
@@ -100,11 +497,16 @@ nav button { background: none; border: none; color: #bbb; padding: 12px 18px; cu
 nav button.active { color: white; border-bottom: 3px solid #e94560; }
 section { display: none; padding: 24px 32px; }
 section.active { display: block; }
-table.stats { border-collapse: collapse; font-size: 13px; margin-bottom: 18px; background: white; }
+table.stats { border-collapse: collapse; font-size: 13px; margin-bottom: 6px; background: white; }
 table.stats th { background: #16213e; color: white; padding: 6px 10px; text-align: left; }
 table.stats td { padding: 5px 10px; border-bottom: 1px solid #eee; }
 .chartgrid { display: grid; grid-template-columns: repeat(auto-fill, minmax(420px, 1fr)); gap: 14px; }
-.chart { height: 320px; background: white; border: 1px solid #eee; }
+.chart { background: white; border: 1px solid #eee; }
+.card { display: inline-block; background: white; border: 1px solid #eee; padding: 14px 22px; margin: 6px; border-radius: 6px; }
+.cardval { font-size: 22px; font-weight: 600; }
+.cardlbl { color: #777; }
+.pager { margin: 4px 0 16px; }
+.pager button { margin-right: 6px; padding: 3px 10px; }
 """
 
 _JS = """
@@ -112,6 +514,132 @@ function showTab(i) {
   document.querySelectorAll('nav button').forEach((b, j) => b.classList.toggle('active', i === j));
   document.querySelectorAll('main section').forEach((s, j) => s.classList.toggle('active', i === j));
 }
+// ---- chart dispatch: plotly.js when the CDN loaded, SVG fallback when not
+var _anQueue = [];
+function anPlot(id, data, layout) { _anQueue.push([id, data, layout]); }
+window.addEventListener('load', () => {
+  _anQueue.forEach(([id, data, layout]) => {
+    var el = document.getElementById(id);
+    if (!el) return;
+    if (window.Plotly) { Plotly.newPlot(id, data, layout, {displayModeBar: false}); return; }
+    try { anFallback(el, data, layout); } catch (e) { el.textContent = 'chart unavailable offline'; }
+  });
+});
+var _anPal = ['#45526c','#e94560','#0f9b8e','#f2a154','#5c7aea','#9b5de5','#00bbf9','#fee440'];
+function anFallback(el, data, layout) {
+  var W = el.clientWidth || 420, H = el.clientHeight || 320, P = 44;
+  var ns = 'http://www.w3.org/2000/svg';
+  var svg = document.createElementNS(ns, 'svg');
+  svg.setAttribute('width', W); svg.setAttribute('height', H);
+  function add(tag, attrs, text) {
+    var n = document.createElementNS(ns, tag);
+    for (var k in attrs) n.setAttribute(k, attrs[k]);
+    if (text !== undefined) n.textContent = text;
+    svg.appendChild(n); return n;
+  }
+  var title = (layout && layout.title && (layout.title.text || layout.title)) || '';
+  if (title) add('text', {x: W/2, y: 16, 'text-anchor': 'middle', 'font-size': 13, 'font-weight': 600}, title);
+  var t0 = data && data[0] ? data[0] : {};
+  if (t0.type === 'pie') {
+    var vals = t0.values || [], labels = t0.labels || [];
+    var tot = vals.reduce((a,b)=>a+(+b||0), 0) || 1, ang = -Math.PI/2;
+    var cx = W/2, cy = H/2 + 8, r = Math.min(W, H)/2 - 40;
+    vals.forEach((v, i) => {
+      var a2 = ang + 2*Math.PI*(+v||0)/tot;
+      var x1 = cx+r*Math.cos(ang), y1 = cy+r*Math.sin(ang), x2 = cx+r*Math.cos(a2), y2 = cy+r*Math.sin(a2);
+      add('path', {d: 'M'+cx+','+cy+' L'+x1+','+y1+' A'+r+','+r+' 0 '+((a2-ang)>Math.PI?1:0)+',1 '+x2+','+y2+' Z',
+                   fill: _anPal[i % _anPal.length]});
+      var mid = (ang+a2)/2;
+      add('text', {x: cx+(r+14)*Math.cos(mid), y: cy+(r+14)*Math.sin(mid), 'font-size': 10,
+                   'text-anchor': 'middle'}, labels[i] + ' ' + Math.round(100*(+v||0)/tot) + '%');
+      ang = a2;
+    });
+    el.appendChild(svg); return;
+  }
+  if (t0.type === 'indicator') {
+    add('text', {x: W/2, y: H/2, 'text-anchor': 'middle', 'font-size': 34, 'font-weight': 700},
+        (+t0.value).toFixed(2));
+    if (t0.title) add('text', {x: W/2, y: H/2 + 26, 'text-anchor': 'middle', 'font-size': 12},
+        t0.title.text || '');
+    el.appendChild(svg); return;
+  }
+  if (t0.type === 'heatmap' && t0.z) {
+    var z = t0.z, nr = z.length, nc = (z[0]||[]).length;
+    var zmin = Infinity, zmax = -Infinity;
+    z.forEach(row => row.forEach(v => { if (v==null) return; zmin = Math.min(zmin,v); zmax = Math.max(zmax,v); }));
+    var cw = (W-2*P)/Math.max(nc,1), ch = (H-2*P)/Math.max(nr,1);
+    z.forEach((row, i) => row.forEach((v, j) => {
+      var t = (v - zmin)/Math.max(zmax - zmin, 1e-9);
+      add('rect', {x: P+j*cw, y: P+i*ch, width: cw, height: ch,
+                   fill: 'rgb('+Math.round(255*t)+','+Math.round(80+80*(1-Math.abs(t-0.5)*2))+','+Math.round(255*(1-t))+')'});
+    }));
+    el.appendChild(svg); return;
+  }
+  // bar / scatter / line traces on shared axes
+  var xs = [], ys = [];
+  data.forEach(tr => {
+    (tr.x || tr.lon || []).forEach(v => xs.push(v));
+    (tr.y || tr.lat || []).forEach(v => { if (v != null && isFinite(v)) ys.push(+v); });
+  });
+  if (!ys.length) { el.textContent = 'chart unavailable offline'; return; }
+  var numericX = xs.every(v => v != null && isFinite(v));
+  var cats = null, xmin, xmax;
+  if (numericX) { xmin = Math.min(...xs.map(Number)); xmax = Math.max(...xs.map(Number)); }
+  else { cats = [...new Set(xs.map(String))]; xmin = 0; xmax = Math.max(cats.length - 1, 1); }
+  var ymin = Math.min(0, Math.min(...ys)), ymax = Math.max(...ys);
+  if (ymax === ymin) ymax = ymin + 1;
+  function X(v) { var t = numericX ? (Number(v)-xmin)/Math.max(xmax-xmin,1e-9) : cats.indexOf(String(v))/xmax; return P + t*(W-2*P); }
+  function Y(v) { return H - P - (v-ymin)/(ymax-ymin)*(H-2*P-10); }
+  add('line', {x1: P, y1: H-P, x2: W-P, y2: H-P, stroke: '#999'});
+  add('line', {x1: P, y1: 24, x2: P, y2: H-P, stroke: '#999'});
+  add('text', {x: 4, y: 28, 'font-size': 10}, (+ymax).toPrecision(4));
+  add('text', {x: 4, y: H-P, 'font-size': 10}, (+ymin).toPrecision(3));
+  data.forEach((tr, ti) => {
+    var color = _anPal[ti % _anPal.length];
+    var tx = tr.x || tr.lon || [], ty = tr.y || tr.lat || [];
+    if (tr.type === 'bar') {
+      var bw = Math.max((W-2*P)/Math.max(tx.length,1) - 2, 1);
+      tx.forEach((xv, i) => { if (ty[i] == null) return;
+        add('rect', {x: X(xv)-bw/2, y: Y(+ty[i]), width: bw, height: Math.max(H-P-Y(+ty[i]),0), fill: color}); });
+    } else {
+      var pts = [];
+      tx.forEach((xv, i) => { if (ty[i] != null && isFinite(ty[i])) pts.push(X(xv)+','+Y(+ty[i])); });
+      if ((tr.mode||'lines').includes('lines') && pts.length > 1)
+        add('polyline', {points: pts.join(' '), fill: 'none', stroke: color, 'stroke-width': 1.5});
+      else pts.forEach(p => { var c = p.split(','); add('circle', {cx: c[0], cy: c[1], r: 2.4, fill: color}); });
+    }
+    if (tr.name) add('text', {x: W-P, y: 28+12*ti, 'text-anchor': 'end', 'font-size': 10, fill: color}, tr.name);
+  });
+  if (!numericX && cats.length <= 14) cats.forEach((c, i) =>
+    add('text', {x: X(c), y: H-P+12, 'font-size': 9, 'text-anchor': 'middle'}, String(c).slice(0, 10)));
+  el.appendChild(svg);
+}
+var pgState = {};
+function pgShow(t) {
+  var st = pgState[t];
+  var rows = document.querySelectorAll('#' + t + ' tbody tr');
+  rows.forEach((r, i) => {
+    r.style.display = (st.all || (i >= st.page * st.p && i < (st.page + 1) * st.p)) ? '' : 'none';
+  });
+  var lbl = document.getElementById(t + '_lbl');
+  if (lbl) lbl.textContent = st.all ? 'all ' + rows.length :
+    (st.page * st.p + 1) + '-' + Math.min((st.page + 1) * st.p, rows.length) + ' of ' + rows.length;
+}
+function pgStep(t, d) {
+  var st = pgState[t];
+  st.all = false;
+  var max = Math.ceil(st.n / st.p) - 1;
+  st.page = Math.min(Math.max(st.page + d, 0), max);
+  pgShow(t);
+}
+function pgAll(t) { pgState[t].all = true; pgShow(t); }
+document.addEventListener('DOMContentLoaded', () => {
+  document.querySelectorAll('.pager').forEach(p => {
+    var t = p.dataset.t;
+    pgState[t] = { page: 0, p: parseInt(p.dataset.p), n: parseInt(p.dataset.n), all: false };
+    pgShow(t);
+  });
+});
 """
 
 
@@ -130,28 +658,16 @@ def anovos_report(
 ) -> str:
     """Assemble ``ml_anovos_report.html`` from the master_path contract."""
     Path(final_report_path).mkdir(parents=True, exist_ok=True)
+    _table_seq[0] = 0
     tabs: List[tuple] = []
 
-    # executive summary (reference :524)
-    gs = _read_csv(master_path, "global_summary")
-    exec_html = ""
-    if gs is not None:
-        kv = dict(zip(gs["metric"], gs["value"]))
-        cards = "".join(
-            f"<div style='display:inline-block;background:white;border:1px solid #eee;"
-            f"padding:14px 22px;margin:6px;border-radius:6px'><div style='font-size:22px;"
-            f"font-weight:600'>{escape(str(kv.get(k, '')))}</div><div style='color:#777'>{escape(lbl)}</div></div>"
-            for k, lbl in [
-                ("rows_count", "rows"),
-                ("columns_count", "columns"),
-                ("numcols_count", "numerical"),
-                ("catcols_count", "categorical"),
-            ]
+    tabs.append(
+        (
+            "Executive Summary",
+            _executive_summary(master_path, id_col, label_col, corr_threshold, iv_threshold)
+            or "<p>no global summary found</p>",
         )
-        exec_html = cards + _table_html(gs, "global summary")
-        if id_col:
-            exec_html += f"<p>id column: <b>{escape(id_col)}</b>; label column: <b>{escape(label_col)}</b></p>"
-    tabs.append(("Executive Summary", exec_html or "<p>no global summary found</p>"))
+    )
 
     # wiki: data + metric dictionary (reference :909)
     wiki = ""
@@ -189,44 +705,37 @@ def anovos_report(
             "data": [{"type": "heatmap", "z": z, "x": list(corr.columns[1:]), "y": attrs, "colorscale": "RdBu", "zmid": 0}],
             "layout": {"title": {"text": "correlation matrix"}, "template": "plotly_white"},
         }
-        ae_html += (
-            "<div class='chart' id='corrheat' style='height:480px'></div>"
-            f"<script>Plotly.newPlot('corrheat', {_json_for_script(fig['data'])}, {_json_for_script(fig['layout'])});</script>"
-        )
+        ae_html += _fig_div(fig, "corrheat", 480)
     for name in _AE_FILES[1:]:
         df = _read_csv(master_path, name)
         if df is not None:
             ae_html += _table_html(df, name)
     tabs.append(("Attribute Associations", ae_html or "<p>no association stats found</p>"))
 
-    # drift & stability (reference :1434)
-    dr_html = "".join(
-        _table_html(df, name) for name in _DRIFT_FILES if (df := _read_csv(master_path, name)) is not None
-    )
+    # drift & stability (reference :1434) with SI gauges + metric lines (:99)
+    dr_html = ""
+    drift = _read_csv(master_path, "drift_statistics")
+    if drift is not None:
+        if "flagged" in drift:
+            drifted = int((drift["flagged"] > 0).sum())
+            dr_html += (
+                f"<p><b>{drifted}</b> of <b>{len(drift)}</b> attributes drifted beyond the "
+                f"{drift_threshold_model} threshold.</p>"
+            )
+        dr_html += _table_html(drift, "drift_statistics")
+    stab = _read_csv(master_path, "stability_index")
+    if stab is not None:
+        dr_html += _table_html(stab, "stability_index")
+    dr_html += _stability_charts(master_path)
     dr_html += _charts_html(master_path, "drift_", "source vs target distributions")
     tabs.append(("Drift & Stability", dr_html or "<p>no drift stats found</p>"))
 
-    # time-series + geospatial tabs appear when their stats have content
-    def _safe_tables(files):
-        html = ""
-        for f in files[:12]:
-            name = os.path.basename(f)[:-4]
-            df = _read_csv(master_path, name)
-            if df is None or df.empty:
-                continue
-            html += _table_html(df, name)
-        return html
-
-    ts_files = sorted(glob.glob(ends_with(master_path) + "ts_*.csv"))
-    if ts_files:
-        ts_html = _safe_tables(ts_files)
-        if ts_html:
-            tabs.append(("Time Series", ts_html))
-    geo_files = sorted(glob.glob(ends_with(master_path) + "geospatial_*.csv"))
-    if geo_files:
-        geo_html = _safe_tables(geo_files)
-        if geo_html:
-            tabs.append(("Geospatial", geo_html))
+    ts_html = _ts_tab(master_path)
+    if ts_html:
+        tabs.append(("Time Series", ts_html))
+    geo_html = _geo_tab(master_path)
+    if geo_html:
+        tabs.append(("Geospatial", geo_html))
 
     nav = "".join(
         f"<button class=\"{'active' if i == 0 else ''}\" onclick='showTab({i})'>{escape(t)}</button>"

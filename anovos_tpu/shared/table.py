@@ -113,9 +113,17 @@ def _spark_dtype_name(np_dtype) -> str:
 class Table:
     """Immutable-ish columnar table; transformation methods return new Tables."""
 
-    def __init__(self, columns: "OrderedDict[str, Column]", nrows: int):
+    def __init__(
+        self,
+        columns: "OrderedDict[str, Column]",
+        nrows: int,
+        valid_rows: Optional[jax.Array] = None,
+    ):
         self.columns: "OrderedDict[str, Column]" = columns
         self.nrows = int(nrows)
+        # multi-host tables carry interleaved per-process padding, so row
+        # validity is an explicit device mask instead of arange < nrows
+        self.valid_rows = valid_rows
 
     # ------------------------------------------------------------------
     # construction
@@ -191,25 +199,31 @@ class Table:
         missing = [n for n in names if n not in self.columns]
         if missing:
             raise KeyError(f"columns not in table: {missing}")
-        return Table(OrderedDict((n, self.columns[n]) for n in names), self.nrows)
+        # column ops keep the row layout → valid_rows must survive (multi-
+        # host tables would otherwise silently revert to arange < nrows)
+        return Table(
+            OrderedDict((n, self.columns[n]) for n in names), self.nrows, self.valid_rows
+        )
 
     def drop(self, names: Sequence[str]) -> "Table":
         names = set(names)
         return Table(
             OrderedDict((n, c) for n, c in self.columns.items() if n not in names),
             self.nrows,
+            self.valid_rows,
         )
 
     def rename(self, mapping: Dict[str, str]) -> "Table":
         return Table(
             OrderedDict((mapping.get(n, n), c) for n, c in self.columns.items()),
             self.nrows,
+            self.valid_rows,
         )
 
     def with_column(self, name: str, col: Column) -> "Table":
         cols = OrderedDict(self.columns)
         cols[name] = col
-        return Table(cols, self.nrows)
+        return Table(cols, self.nrows, self.valid_rows)
 
     def __getitem__(self, name: str) -> Column:
         return self.columns[name]
@@ -232,7 +246,10 @@ class Table:
         return _stack_cast(datas, masks, dtype)
 
     def row_mask(self) -> jax.Array:
-        """Validity of the *row* (excludes padding rows)."""
+        """Validity of the *row* (excludes padding rows).  Multi-host tables
+        carry interleaved per-process padding → explicit mask."""
+        if self.valid_rows is not None:
+            return self.valid_rows
         return jnp.arange(self.padded_rows) < self.nrows
 
     # ------------------------------------------------------------------

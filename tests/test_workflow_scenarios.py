@@ -1,0 +1,62 @@
+"""Scenario-config e2e matrix: every shipped YAML under config/ must drive
+the workflow end to end (reference ships feast/mlflow/sales variants in
+config/ and CI runs the demo matrix — SURVEY.md §4, round-1 verdict #8)."""
+
+import os
+
+import pandas as pd
+import pytest
+import yaml
+
+from anovos_tpu import workflow
+
+CONFIG_DIR = "/root/repo/config"
+
+
+def _run(cfg_name, tmp_path, monkeypatch, mutate=None):
+    with open(os.path.join(CONFIG_DIR, cfg_name)) as f:
+        cfg = yaml.safe_load(f)
+    if mutate:
+        mutate(cfg)
+    monkeypatch.chdir(tmp_path)
+    p = tmp_path / "cfg.yaml"
+    p.write_text(yaml.safe_dump(cfg, sort_keys=False))
+    workflow.run(str(p), "local")
+    return tmp_path
+
+
+@pytest.mark.slow
+def test_configs_feast_generates_repo(tmp_path, monkeypatch):
+    out = _run("configs_feast.yaml", tmp_path, monkeypatch)
+    repo = out / "feast_repo"
+    files = list(repo.glob("*.py"))
+    assert files, "feast repo python file not generated"
+    src = files[0].read_text()
+    for expected in ("Entity", "FeatureView", "FeatureService", "income_view", "ifa"):
+        assert expected in src, f"feast definition missing {expected}"
+    # add_timestamp_columns contract: event/create ts columns in the output
+    final = pd.read_parquet(sorted((out / "output" / "final_dataset").glob("*.parquet"))[0])
+    assert "event_time" in final.columns and "create_time_col" in final.columns
+
+
+@pytest.mark.slow
+def test_configs_mlflow_runs_without_mlflow_installed(tmp_path, monkeypatch):
+    out = _run("configs_mlflow.yaml", tmp_path, monkeypatch)
+    assert (out / "report_stats" / "ml_anovos_report.html").exists()
+    gs = pd.read_csv(out / "report_stats" / "global_summary.csv")
+    assert int(float(dict(zip(gs["metric"], gs["value"]))["rows_count"])) == 32561
+
+
+@pytest.mark.slow
+def test_configs_sales_supervised(tmp_path, monkeypatch):
+    out = _run("configs_sales_supervised.yaml", tmp_path, monkeypatch)
+    rs = out / "report_stats"
+    assert (rs / "ml_anovos_report.html").exists()
+    drift = pd.read_csv(rs / "drift_statistics.csv")
+    assert {"PSI", "HD", "JSD", "KS"} <= set(drift.columns)
+    stab = pd.read_csv(rs / "stability_index.csv")
+    assert "stability_index" in stab.columns and len(stab) > 0
+    iv = pd.read_csv(rs / "IV_calculation.csv")
+    assert len(iv) > 3
+    # supervised encoding happened before associations
+    assert (out / "output" / "final_dataset" / "_SUCCESS").exists()
