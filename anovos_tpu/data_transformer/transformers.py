@@ -12,6 +12,7 @@ kernel over the λ grid.
 from __future__ import annotations
 
 import math
+import os
 import warnings
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Union
@@ -125,7 +126,15 @@ def attribute_binning(
         X, M = idf.numeric_block(cols)
         if method_type == "equal_frequency":
             qs = jnp.array([j / bin_size for j in range(1, bin_size)], jnp.float32)
-            cutoffs = np.asarray(masked_quantiles(X, M, qs, interpolation="lower")).T  # (k, B-1)
+            # exact sort quantiles up to ~64M cells; beyond that the sort's
+            # O(rows·k) temp buffers crowd HBM → histogram sketch (O(k·nbins)
+            # state, error ≤ range/2048 — the approxQuantile analogue)
+            if X.size > int(os.environ.get("ANOVOS_EXACT_QUANTILE_CELLS", 64_000_000)):
+                from anovos_tpu.ops.quantiles import histogram_quantiles
+
+                cutoffs = np.asarray(histogram_quantiles(X, M, qs)).T.astype(np.float64)
+            else:
+                cutoffs = np.asarray(masked_quantiles(X, M, qs, interpolation="lower")).T  # (k, B-1)
         else:
             mom = masked_moments(X, M)
             lo = np.asarray(mom["min"], dtype=np.float64)

@@ -16,6 +16,7 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @functools.partial(jax.jit, static_argnames=("interpolation",))
@@ -86,9 +87,16 @@ def histogram_quantiles(
         return acc + h[: k * nbins]
 
     hist = jax.lax.fori_loop(0, n_chunks, body, jnp.zeros(k * nbins, dt)).reshape(k, nbins)
-    cum = jnp.cumsum(hist, axis=1)
+    return quantiles_from_histogram(hist, lo, width / nbins, qs)
+
+
+def quantiles_from_histogram(hist, lo, bin_width, qs):
+    """Quantiles from per-column (k, nbins) counts against fixed-width bins
+    (shared by histogram_quantiles and the streaming describe — keep the
+    bin-selection rule in ONE place).  Accepts jnp or np arrays."""
+    xp = jnp if isinstance(hist, jax.Array) else np
+    cum = xp.cumsum(hist, axis=1)
     n = cum[:, -1:]
-    targets = qs[:, None, None] * n[None]  # (q, k, 1)
-    bin_i = (cum[None] < targets).sum(axis=2)  # (q, k)
-    bin_i = jnp.clip(bin_i, 0, nbins - 1)
-    return lo[None] + (bin_i.astype(dt) + 0.5) * (width / nbins)[None]
+    targets = xp.asarray(qs)[:, None, None] * n[None]  # (q, k, 1)
+    bin_i = xp.clip((cum[None] < targets).sum(axis=2), 0, hist.shape[1] - 1)
+    return lo[None] + (bin_i.astype(xp.float32) + 0.5) * bin_width[None]

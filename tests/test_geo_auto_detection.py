@@ -1,0 +1,67 @@
+"""Geo auto-detection breadth (reference geo_auto_detection.py:177-298):
+named columns, UNNAMED columns via the statistical gate + value regex, the
+geohash codec probe, and the pair-alignment reset."""
+
+import numpy as np
+import pandas as pd
+
+from anovos_tpu.data_ingest.geo_auto_detection import ll_gh_cols, reg_lat_lon
+from anovos_tpu.data_transformer.geo_utils import geohash_encode
+from anovos_tpu.shared.table import Table
+
+
+def _rng():
+    return np.random.default_rng(0)
+
+
+def test_named_columns_detected():
+    rng = _rng()
+    n = 500
+    t = Table.from_pandas(
+        pd.DataFrame({"latitude": rng.uniform(-60, 60, n), "longitude": rng.uniform(-170, 170, n)})
+    )
+    lat, lon, gh = ll_gh_cols(t)
+    assert lat == ["latitude"] and lon == ["longitude"] and gh == []
+
+
+def test_unnamed_columns_via_statistical_gate():
+    rng = _rng()
+    n = 2000
+    df = pd.DataFrame(
+        {
+            "position_a": rng.uniform(25, 49, n),
+            "position_b": rng.uniform(-124, -67, n),
+            "price": rng.uniform(200, 500, n).round(2),  # max > 180 → excluded
+            "qty": rng.integers(0, 50, n),  # integers → excluded
+        }
+    )
+    lat, lon, gh = ll_gh_cols(Table.from_pandas(df))
+    assert lat == ["position_a"] and lon == ["position_b"]
+
+
+def test_pair_mismatch_resets():
+    rng = _rng()
+    n = 500
+    df = pd.DataFrame({"latitude": rng.uniform(-60, 60, n), "x": rng.normal(size=n)})
+    lat, lon, gh = ll_gh_cols(Table.from_pandas(df))
+    assert lat == [] and lon == []  # lone latitude without a longitude
+
+
+def test_geohash_detected_by_codec_probe():
+    rng = _rng()
+    n = 400
+    hashes = [
+        geohash_encode(float(a), float(o), 7)
+        for a, o in zip(rng.uniform(-60, 60, n), rng.uniform(-170, 170, n))
+    ]
+    df = pd.DataFrame({"cell": hashes, "word": rng.choice(["alpha", "beta", "gamma"], n)})
+    lat, lon, gh = ll_gh_cols(Table.from_pandas(df))
+    assert gh == ["cell"]
+
+
+def test_value_regex_matches_reference_format():
+    assert reg_lat_lon("latitude").match("+45.1234")
+    assert reg_lat_lon("latitude").match("-90.0")
+    assert not reg_lat_lon("latitude").match("+95.0")
+    assert reg_lat_lon("longitude").match("+179.99")
+    assert not reg_lat_lon("longitude").match("+181.0")

@@ -1,0 +1,53 @@
+"""Streaming ≫HBM describe: chunked two-pass stats must match the in-memory
+kernels on the same data (SURVEY.md §5 blockwise-aggregation analogue)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from anovos_tpu.ops.streaming import describe_streaming
+
+
+@pytest.fixture(scope="module")
+def part_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("parts")
+    rng = np.random.default_rng(3)
+    frames = []
+    for i in range(5):
+        df = pd.DataFrame(
+            {
+                "a": rng.normal(loc=i, scale=2.0, size=3000),  # drifting mean across parts
+                "b": rng.exponential(5.0, 3000),
+                "c": rng.integers(0, 100, 3000).astype(float),
+            }
+        )
+        df.loc[rng.choice(3000, 150, replace=False), "a"] = np.nan
+        df.to_parquet(d / f"part-{i:05d}.parquet", index=False)
+        frames.append(df)
+    return d, pd.concat(frames, ignore_index=True)
+
+
+def test_streaming_matches_in_memory(part_files):
+    d, full = part_files
+    got = describe_streaming(str(d), "parquet", chunk_rows=2048).set_index("attribute")
+    for c in ["a", "b", "c"]:
+        s = full[c]
+        assert int(got.loc[c, "count"]) == int(s.notna().sum())
+        assert got.loc[c, "mean"] == pytest.approx(s.mean(), rel=1e-3)
+        assert got.loc[c, "stddev"] == pytest.approx(s.std(), rel=1e-3)
+        assert got.loc[c, "skewness"] == pytest.approx(s.skew(), rel=0.05, abs=0.02)
+        assert got.loc[c, "min"] == pytest.approx(s.min(), rel=1e-4)
+        assert got.loc[c, "max"] == pytest.approx(s.max(), rel=1e-4)
+        rng_c = s.max() - s.min()
+        for q in (25, 50, 75):
+            assert abs(got.loc[c, f"{q}%"] - s.quantile(q / 100)) <= rng_c / 2048 * 3 + 1e-6
+
+
+def test_streaming_chunk_count_invariance(part_files):
+    d, _ = part_files
+    a = describe_streaming(str(d), "parquet", chunk_rows=1024).set_index("attribute")
+    b = describe_streaming(str(d), "parquet", chunk_rows=7000).set_index("attribute")
+    for c in ["a", "b", "c"]:
+        assert a.loc[c, "mean"] == pytest.approx(b.loc[c, "mean"], rel=1e-4)
+        assert a.loc[c, "stddev"] == pytest.approx(b.loc[c, "stddev"], rel=1e-3)
+        assert int(a.loc[c, "count"]) == int(b.loc[c, "count"])

@@ -1,0 +1,61 @@
+"""Timestamp auto-detection format matrix (reference ts_auto_detection.py
+:95-260 regex battery, recast as detect-then-parse over distinct values)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from anovos_tpu.data_ingest.ts_auto_detection import _try_parse_values
+from anovos_tpu.shared.table import Table
+from anovos_tpu.data_ingest.ts_auto_detection import ts_preprocess
+
+
+CASES = [
+    (["2023-01-05 10:30:00", "2022-12-31T23:59:59Z"], ["2023-01-05 10:30:00", "2022-12-31 23:59:59"]),
+    (["14/08/1991", "01/12/2020"], ["1991-08-14", "2020-12-01"]),  # day-first
+    (["08/14/1991", "12/25/2020"], ["1991-08-14", "2020-12-25"]),  # month-first
+    (["14 Aug 1991", "1 January 2020"], ["1991-08-14", "2020-01-01"]),
+    (["Aug 14, 1991", "January 1, 2020"], ["1991-08-14", "2020-01-01"]),
+    (["19910814", "20201225"], ["1991-08-14", "2020-12-25"]),
+    (["1680549600", "1577836800"], ["2023-04-03 19:20:00", "2020-01-01"]),
+    (["1680549600000", "1577836800000"], ["2023-04-03 19:20:00", "2020-01-01"]),
+    (["1991", "2020"], ["1991-01-01", "2020-01-01"]),
+    (["14/08/91", "25/12/20"], ["1991-08-14", "2020-12-25"]),
+    (["1991.08.14", "2020.12.25"], ["1991-08-14", "2020-12-25"]),
+    (["14-Aug-91", "25-Dec-20"], ["1991-08-14", "2020-12-25"]),
+]
+
+
+@pytest.mark.parametrize("vals,exp", CASES)
+def test_format_family_parses(vals, exp):
+    parsed, frac, fam = _try_parse_values(np.array(vals, dtype=object))
+    assert parsed is not None and frac >= 0.99, (vals, fam, frac)
+    got = [str(p)[:19] for p in parsed]
+    for e, g in zip(exp, got):
+        assert str(pd.Timestamp(e))[:19] == g, (vals, fam, got)
+
+
+def test_ambiguity_resolved_by_parse_success():
+    # 13/02 style values force day-first: month-first parse fails on 13
+    vals = np.array(["13/02/2020", "25/06/2021", "30/12/2022"], dtype=object)
+    parsed, frac, fam = _try_parse_values(vals)
+    assert frac == 1.0 and fam.startswith("dd_mm")
+    assert str(parsed.iloc[0])[:10] == "2020-02-13"
+
+
+def test_ts_preprocess_detects_and_reports(tmp_path):
+    df = pd.DataFrame(
+        {
+            "order_date": ["14/08/2021", "15/08/2021", "16/08/2021", None] * 25,
+            "note": ["hello", "world", "foo", "bar"] * 25,
+            "epoch": np.repeat(np.int64(1650000000), 100) + np.arange(100),
+        }
+    )
+    t = Table.from_pandas(df)
+    out = ts_preprocess(t, output_path=str(tmp_path))
+    assert out.columns["order_date"].kind == "ts"
+    assert out.columns["epoch"].kind == "ts"
+    assert out.columns["note"].kind == "cat"
+    stats = pd.read_csv(tmp_path / "ts_cols_stats.csv")
+    row = stats.set_index("attribute").loc["order_date"]
+    assert row["status"] == "converted" and row["format_family"].startswith("dd_mm")
