@@ -247,6 +247,17 @@ def write_avro(df, path: str, codec: str = "deflate", block_rows: int = 16384) -
         out.write(v)
     _write_long(out, 0)
     out.write(sync)
+    # native C++ block encoder (write half of the native IO layer); the
+    # Python per-value loop below is the fallback
+    from anovos_tpu.shared.native import native_avro_encode
+
+    body = native_avro_encode(df, sync, codec, block_rows) if len(df) else None
+    if body is not None:
+        out.write(body)
+        with open(path, "wb") as f:
+            f.write(out.getvalue())
+        return
+
     cols = [df[c].tolist() for c in df.columns]
     ftypes = [f["type"] for f in schema["fields"]]
     n = len(df)

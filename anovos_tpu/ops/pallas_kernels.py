@@ -85,5 +85,85 @@ def binned_histograms_pallas(
     )(X.astype(jnp.float32), M, cutoffs.astype(jnp.float32))
 
 
+def _moments_kernel(x_ref, m_ref, out_ref):
+    """One row tile → Chan-merge into the running (8, k) accumulator:
+    rows of the accumulator are [n, mean, M2, M3, M4, min, max, nonzero].
+
+    A naive raw-power-sum single pass cancels catastrophically in f32 for
+    columns with large means; per-tile central moments merged pairwise keep
+    the error O(log tiles) — same policy as ops/streaming."""
+    i = pl.program_id(0)
+    x = x_ref[:].astype(jnp.float32)  # (TILE, k)
+    m = m_ref[:] != 0
+    big = jnp.float32(3.4e38)
+    n_t = m.sum(axis=0).astype(jnp.float32)
+    safe = jnp.maximum(n_t, 1.0)
+    mean_t = jnp.where(m, x, 0).sum(axis=0) / safe
+    d = jnp.where(m, x - mean_t, 0)
+    d2 = d * d
+    M2_t = d2.sum(axis=0)
+    M3_t = (d2 * d).sum(axis=0)
+    M4_t = (d2 * d2).sum(axis=0)
+    min_t = jnp.where(m, x, big).min(axis=0)
+    max_t = jnp.where(m, x, -big).max(axis=0)
+    nz_t = (m & (x != 0)).sum(axis=0).astype(jnp.float32)
+    tile = jnp.stack([n_t, mean_t, M2_t, M3_t, M4_t, min_t, max_t, nz_t])
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = tile
+
+    @pl.when(i > 0)
+    def _merge():
+        acc = out_ref[:]
+        na, nb = acc[0], n_t
+        n = na + nb
+        s = jnp.maximum(n, 1.0)
+        delta = mean_t - acc[1]
+        mean = acc[1] + delta * nb / s
+        M2 = acc[2] + M2_t + delta**2 * na * nb / s
+        M3 = (
+            acc[3] + M3_t
+            + delta**3 * na * nb * (na - nb) / (s * s)
+            + 3 * delta * (na * M2_t - nb * acc[2]) / s
+        )
+        M4 = (
+            acc[4] + M4_t
+            + delta**4 * na * nb * (na * na - na * nb + nb * nb) / (s * s * s)
+            + 6 * delta**2 * (na * na * M2_t + nb * nb * acc[2]) / (s * s)
+            + 4 * delta * (na * M3_t - nb * acc[3]) / s
+        )
+        out_ref[:] = jnp.stack(
+            [n, mean, M2, M3, M4,
+             jnp.minimum(acc[5], min_t), jnp.maximum(acc[6], max_t), acc[7] + nz_t]
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def moments_pallas(X: jax.Array, M: jax.Array, interpret: bool = False) -> jax.Array:
+    """Fused single-pass masked moments: X/M (rows, k) → (8, k) float32
+    accumulator [n, mean, M2, M3, M4, min, max, nonzero].  Finalize with
+    ops/reductions.finalize_moments (s1 = n·mean)."""
+    if not _PALLAS_OK:  # pragma: no cover
+        raise RuntimeError("pallas unavailable")
+    rows, k = X.shape
+    pad = (-rows) % _TILE_ROWS
+    if pad:
+        X = jnp.concatenate([X, jnp.zeros((pad, k), X.dtype)])
+        M = jnp.concatenate([M, jnp.zeros((pad, k), bool)])
+    grid = (X.shape[0] // _TILE_ROWS,)
+    return pl.pallas_call(
+        _moments_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TILE_ROWS, k), lambda i: (i, 0)),
+            pl.BlockSpec((_TILE_ROWS, k), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, k), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, k), jnp.float32),
+        interpret=interpret,
+    )(X.astype(jnp.float32), M)
+
+
 def use_pallas() -> bool:
     return _PALLAS_OK and os.environ.get("ANOVOS_USE_PALLAS", "0") == "1"

@@ -51,15 +51,27 @@ def finalize_moments(n, s1, m2, m3, m4, cmin, cmax, nonzero) -> Dict[str, jax.Ar
     }
 
 
-@jax.jit
 def masked_moments(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
     """All central moments per column of a masked block.
 
     X: (rows, k) numeric; M: (rows, k) bool validity.
     Returns dict of (k,) arrays: count, sum, mean, variance (sample), stddev,
     skewness, kurtosis (excess), min, max, nonzero.
-    Two-pass: global mean via one psum, then centered power sums via another.
-    """
+    XLA path: two-pass (global mean psum, then centered power sums).
+    ``ANOVOS_USE_PALLAS=1``: single-pass hand-scheduled tile kernel with
+    Chan merging (ops/pallas_kernels.moments_pallas) — backend choice sits
+    OUTSIDE jit so the env var is honored per call."""
+    from anovos_tpu.ops.pallas_kernels import moments_pallas, use_pallas
+
+    if use_pallas():
+        acc = moments_pallas(X, M)
+        n, mean = acc[0], acc[1]
+        return finalize_moments(n, mean * n, acc[2], acc[3], acc[4], acc[5], acc[6], acc[7])
+    return _masked_moments_xla(X, M)
+
+
+@jax.jit
+def _masked_moments_xla(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
     dt = X.dtype if X.dtype in (jnp.float32, jnp.float64) else jnp.float32
     Xf = X.astype(dt)
     Mf = M.astype(dt)

@@ -53,10 +53,17 @@ def get_native() -> Optional[ctypes.CDLL]:
         return _LIB
     _TRIED = True
     try:
-        if not os.path.exists(_SO_PATH):
-            src = os.path.join(_NATIVE_DIR, "anovos_native.cpp")
+        src = os.path.join(_NATIVE_DIR, "anovos_native.cpp")
+        stale = (
+            os.path.exists(_SO_PATH)
+            and os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(_SO_PATH)
+        )
+        if not os.path.exists(_SO_PATH) or stale:
             if not os.path.exists(src):
                 return None
+            # rebuild whenever the source is newer — a stale cached .so would
+            # silently lack newer exports and route callers to slow fallbacks
             subprocess.run(
                 ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", src, "-o", _SO_PATH, "-lz"],
                 check=True,
@@ -79,6 +86,12 @@ def get_native() -> Optional[ctypes.CDLL]:
         lib.dict_encode.restype = ctypes.c_int64
         lib.dict_encode.argtypes = [
             u8p, i64p, u8p, ctypes.c_int64, i32p, i64p, u8p, ctypes.c_int64, i64p,
+        ]
+        lib.avro_encode.restype = ctypes.c_int64
+        lib.avro_encode.argtypes = [
+            i32p, ctypes.c_int32, ctypes.c_int64,
+            dpp, i64pp, u8pp, i64pp, u8pp,
+            ctypes.c_int32, u8p, ctypes.c_int64, u8p, ctypes.c_int64,
         ]
         _LIB = lib
     except (OSError, subprocess.CalledProcessError):
@@ -215,3 +228,89 @@ def _dict_encode_buffers(lib, arena: np.ndarray, offsets: np.ndarray, valid: np.
     return NativeEncodedStrings(sorted_codes, vocab0[order])
 
 
+
+
+def native_avro_encode(df, sync: bytes, codec: str, block_rows: int):
+    """Encode a pandas frame's record blocks natively (write half of the IO
+    layer).  Returns the encoded body bytes (blocks + sync markers) or None
+    when the native path is unavailable/unsupported — callers fall back to
+    the per-value Python loop."""
+    import pandas.api.types as pdt
+
+    lib = get_native()
+    if lib is None:
+        return None
+    codec_i = {"null": 0, "deflate": 1}.get(codec)
+    if codec_i is None:
+        return None
+    n = len(df)
+    ftypes, doubles, longs, valids, str_offs, str_bytes_l = [], [], [], [], [], []
+    bound = 0
+    for name in df.columns:
+        s = df[name]
+        dt = s.dtype
+        if pdt.is_bool_dtype(dt):
+            ftypes.append(1)  # FT_BOOL
+            isna = s.isna().to_numpy()
+            doubles.append(s.to_numpy(np.float64, na_value=0.0))
+            longs.append(None)
+            valids.append((~isna).astype(np.uint8))  # nullable 'boolean' NA → null branch
+            str_offs.append(None)
+            str_bytes_l.append(None)
+            bound += n * 2
+        elif pdt.is_integer_dtype(dt):
+            ftypes.append(2)  # FT_INT (zigzag varint long)
+            vals = s.to_numpy()
+            longs.append(vals.astype(np.int64))
+            doubles.append(None)
+            valids.append(np.ones(n, np.uint8))
+            str_offs.append(None)
+            str_bytes_l.append(None)
+            bound += n * 11
+        elif pdt.is_float_dtype(dt):
+            ftypes.append(4)  # FT_DOUBLE
+            vals = s.to_numpy(np.float64)
+            doubles.append(np.nan_to_num(vals, nan=0.0))
+            longs.append(None)
+            valids.append((~np.isnan(vals)).astype(np.uint8))
+            str_offs.append(None)
+            str_bytes_l.append(None)
+            bound += n * 9
+        elif dt == object or str(dt) in ("string", "str", "category"):
+            vals = s.to_numpy(dtype=object)
+            isnull = np.array([v is None or (isinstance(v, float) and np.isnan(v)) for v in vals])
+            encs = [b"" if b else str(v).encode("utf-8") for v, b in zip(vals, isnull)]
+            offs = np.zeros(n + 1, np.int64)
+            np.cumsum([len(e) for e in encs], out=offs[1:])
+            arena = np.frombuffer(b"".join(encs) or b"\0", dtype=np.uint8).copy()
+            ftypes.append(5)  # FT_STRING
+            doubles.append(None)
+            longs.append(None)
+            valids.append((~isnull).astype(np.uint8))
+            str_offs.append(offs)
+            str_bytes_l.append(arena)
+            bound += n * 6 + int(offs[-1])
+        else:
+            return None  # datetimes etc.: python writer handles
+    nblocks = max(1, -(-n // block_rows))
+    bound += nblocks * 40 + 64
+    out = np.zeros(bound, np.uint8)
+    ftypes_a = np.asarray(ftypes, np.int32)
+    sync_a = np.frombuffer(sync, dtype=np.uint8)
+    used = lib.avro_encode(
+        ftypes_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(ftypes), n,
+        _ptr_array(doubles, ctypes.c_double),
+        _ptr_array(longs, ctypes.c_int64),
+        _ptr_array(valids, ctypes.c_uint8),
+        _ptr_array(str_offs, ctypes.c_int64),
+        _ptr_array(str_bytes_l, ctypes.c_uint8),
+        codec_i,
+        sync_a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        block_rows,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(out),
+    )
+    if used < 0:
+        return None
+    return out[:used].tobytes()

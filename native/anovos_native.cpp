@@ -301,4 +301,101 @@ int64_t dict_encode(
   return next;
 }
 
+// ---------------------------------------------------------------------------
+// Avro object-container ENCODE (the write half of the native IO layer):
+// columnar buffers → zigzag-varint record blocks (+ raw-deflate codec) with
+// sync markers.  Mirrors the Python writer's schema shape: every field is a
+// [T, "null"] union (value branch 0).  Replaces the per-value Python loop.
+// ---------------------------------------------------------------------------
+static void write_varlong(std::vector<uint8_t>& out, int64_t v) {
+  uint64_t z = (static_cast<uint64_t>(v) << 1) ^ static_cast<uint64_t>(v >> 63);
+  while (z >= 0x80) {
+    out.push_back(static_cast<uint8_t>(z) | 0x80);
+    z >>= 7;
+  }
+  out.push_back(static_cast<uint8_t>(z));
+}
+
+static bool deflate_raw(const std::vector<uint8_t>& src, std::vector<uint8_t>& dst) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (deflateInit2(&zs, 6, Z_DEFLATED, -15, 8, Z_DEFAULT_STRATEGY) != Z_OK) return false;
+  dst.resize(deflateBound(&zs, static_cast<uLong>(src.size())));
+  zs.next_in = const_cast<Bytef*>(src.data());
+  zs.avail_in = static_cast<uInt>(src.size());
+  zs.next_out = dst.data();
+  zs.avail_out = static_cast<uInt>(dst.size());
+  int rc = deflate(&zs, Z_FINISH);
+  deflateEnd(&zs);
+  if (rc != Z_STREAM_END) return false;
+  dst.resize(zs.total_out);
+  return true;
+}
+
+// Returns bytes written into `out`, or -1 (overflow) / -2 (codec error).
+int64_t avro_encode(
+    const int32_t* field_types, int32_t nfields, int64_t nrows,
+    const double* const* doubles, const int64_t* const* longs,
+    const uint8_t* const* valid,
+    const int64_t* const* str_off, const uint8_t* const* str_bytes,
+    int32_t codec, const uint8_t* sync, int64_t block_rows,
+    uint8_t* out, int64_t out_cap) {
+  try {
+    std::vector<uint8_t> block, comp, framed;
+    int64_t used = 0;
+    for (int64_t start = 0; start < nrows; start += block_rows) {
+      int64_t stop = start + block_rows < nrows ? start + block_rows : nrows;
+      block.clear();
+      for (int64_t i = start; i < stop; i++) {
+        for (int32_t f = 0; f < nfields; f++) {
+          bool ok = valid[f][i] != 0;
+          write_varlong(block, ok ? 0 : 1);  // union branch: value first
+          if (!ok) continue;
+          switch (field_types[f]) {
+            case FT_BOOL:
+              block.push_back(doubles[f][i] != 0.0 ? 1 : 0);
+              break;
+            case FT_INT:
+              write_varlong(block, longs[f][i]);
+              break;
+            case FT_DOUBLE: {
+              double v = doubles[f][i];
+              const uint8_t* b = reinterpret_cast<const uint8_t*>(&v);
+              block.insert(block.end(), b, b + 8);
+              break;
+            }
+            case FT_STRING: {
+              int64_t a = str_off[f][i], b2 = str_off[f][i + 1];
+              write_varlong(block, b2 - a);
+              block.insert(block.end(), str_bytes[f] + a, str_bytes[f] + b2);
+              break;
+            }
+            default:
+              return -2;
+          }
+        }
+      }
+      const std::vector<uint8_t>* payload = &block;
+      if (codec == 1) {
+        if (!deflate_raw(block, comp)) return -2;
+        payload = &comp;
+      }
+      framed.clear();
+      write_varlong(framed, stop - start);
+      write_varlong(framed, static_cast<int64_t>(payload->size()));
+      int64_t need = static_cast<int64_t>(framed.size() + payload->size()) + 16;
+      if (used + need > out_cap) return -1;
+      memcpy(out + used, framed.data(), framed.size());
+      used += static_cast<int64_t>(framed.size());
+      memcpy(out + used, payload->data(), payload->size());
+      used += static_cast<int64_t>(payload->size());
+      memcpy(out + used, sync, 16);
+      used += 16;
+    }
+    return used;
+  } catch (...) {
+    return -2;
+  }
+}
+
 }  // extern "C"

@@ -21,3 +21,26 @@ def test_pallas_histogram_parity():
     out = np.asarray(binned_histograms_pallas(X, M, cuts, nbins, interpret=True))
     np.testing.assert_allclose(out, ref)
     assert out.sum() == np.asarray(M).sum()
+
+
+def test_moments_pallas_matches_xla_interpret():
+    """Single-pass Chan-merge moments kernel == two-pass XLA kernel,
+    including a large-mean column that would cancel under raw power sums."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from anovos_tpu.ops.pallas_kernels import moments_pallas
+    from anovos_tpu.ops.reductions import finalize_moments, masked_moments
+
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(
+        np.stack([rng.normal(1e5, 3.0, 60000), rng.exponential(5, 60000)], 1).astype(np.float32)
+    )
+    M = jnp.asarray(rng.random((60000, 2)) > 0.1)
+    acc = moments_pallas(X, M, interpret=True)
+    got = finalize_moments(acc[0], acc[0] * acc[1], acc[2], acc[3], acc[4], acc[5], acc[6], acc[7])
+    exp = masked_moments(X, M)
+    for k in ("count", "mean", "stddev", "min", "max", "nonzero"):
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(exp[k]), rtol=5e-3, atol=1e-3)
+    for k in ("skewness", "kurtosis"):  # f32 sampling noise scale for shape stats
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(exp[k]), rtol=2e-2, atol=2e-2)
