@@ -308,6 +308,60 @@ def _correlated_cols(corr: Optional[pd.DataFrame], threshold: float) -> Optional
 
 
 # ----------------------------------------------------------------------
+# per-attribute drill-down (reference data_analyzer_output :233-440)
+# ----------------------------------------------------------------------
+def _attribute_profiles(master_path: str, label_col: str, limit: int = 60) -> str:
+    """Collapsible per-attribute panel: every stat the SG files carry for the
+    attribute, its frequency distribution, and (when a label exists) its
+    event-rate chart."""
+    profiles: Dict[str, Dict[str, str]] = {}
+    for name in _SG_FILES[1:]:  # global_summary has no attribute axis
+        df = _read_csv(master_path, name)
+        if df is None or "attribute" not in df:
+            continue
+        for _, row in df.iterrows():
+            d = profiles.setdefault(str(row["attribute"]), {})
+            for col in df.columns:
+                if col != "attribute":
+                    d[col] = row[col]
+    if not profiles:
+        return ""
+    mp = ends_with(master_path)
+    out = ["<h3>attribute profiles</h3>"]
+    for i, (attr, stats) in enumerate(sorted(profiles.items())):
+        if i >= limit:
+            out.append(f"<p>… {len(profiles) - limit} more attributes (see tables above)</p>")
+            break
+        kv = pd.DataFrame(
+            {"metric": list(stats.keys()), "value": [str(v) for v in stats.values()]}
+        )
+        body = [_table_html(kv, "")]
+        charts = []
+        fd = mp + "freqDist_" + attr
+        if os.path.exists(fd):
+            try:
+                with open(fd) as fh:
+                    charts.append(_fig_div(json.load(fh), f"prof_f_{i}", 280))
+            except Exception:
+                pass
+        if label_col:
+            ed = mp + "eventDist_" + attr
+            if os.path.exists(ed):
+                try:
+                    with open(ed) as fh:
+                        charts.append(_fig_div(json.load(fh), f"prof_e_{i}", 280))
+                except Exception:
+                    pass
+        out.append(
+            f"<details><summary><b>{escape(attr)}</b></summary>"
+            f"<div style='display:flex;gap:18px;flex-wrap:wrap;align-items:flex-start'>"
+            f"<div>{''.join(body)}</div><div class='chartgrid' style='flex:1;min-width:440px'>"
+            f"{''.join(charts)}</div></div></details>"
+        )
+    return "".join(out)
+
+
+# ----------------------------------------------------------------------
 # drift & stability tab (reference :99-231, :1434-1936)
 # ----------------------------------------------------------------------
 def _stability_charts(master_path: str, limit: int = 12) -> str:
@@ -512,19 +566,46 @@ table.stats td { padding: 5px 10px; border-bottom: 1px solid #eee; }
 _JS = """
 function showTab(i) {
   document.querySelectorAll('nav button').forEach((b, j) => b.classList.toggle('active', i === j));
-  document.querySelectorAll('main section').forEach((s, j) => s.classList.toggle('active', i === j));
+  document.querySelectorAll('main section').forEach((s, j) => {
+    s.classList.toggle('active', i === j);
+    if (i === j) s.querySelectorAll('.chart').forEach(el => {
+      if (_anPending[el.id] && el.offsetParent !== null) {
+        var [d, l] = _anPending[el.id];
+        delete _anPending[el.id];
+        _anRender(el.id, d, l);
+      }
+    });
+  });
 }
-// ---- chart dispatch: plotly.js when the CDN loaded, SVG fallback when not
+// ---- chart dispatch: plotly.js when the CDN loaded, SVG fallback when not.
+// Charts inside collapsed <details> (attribute profiles) defer until opened
+// — rendering into a zero-size hidden container produces blank plots.
 var _anQueue = [];
+var _anPending = {};
 function anPlot(id, data, layout) { _anQueue.push([id, data, layout]); }
+function _anRender(id, data, layout) {
+  var el = document.getElementById(id);
+  if (!el) return;
+  if (window.Plotly) { Plotly.newPlot(id, data, layout, {displayModeBar: false}); return; }
+  try { anFallback(el, data, layout); } catch (e) { el.textContent = 'chart unavailable offline'; }
+}
 window.addEventListener('load', () => {
   _anQueue.forEach(([id, data, layout]) => {
     var el = document.getElementById(id);
-    if (!el) return;
-    if (window.Plotly) { Plotly.newPlot(id, data, layout, {displayModeBar: false}); return; }
-    try { anFallback(el, data, layout); } catch (e) { el.textContent = 'chart unavailable offline'; }
+    if (el && el.offsetParent === null) { _anPending[id] = [data, layout]; return; }
+    _anRender(id, data, layout);
   });
 });
+document.addEventListener('toggle', (e) => {
+  if (!e.target.open) return;
+  e.target.querySelectorAll('.chart').forEach(el => {
+    if (_anPending[el.id]) {
+      var [d, l] = _anPending[el.id];
+      delete _anPending[el.id];
+      _anRender(el.id, d, l);
+    }
+  });
+}, true);
 var _anPal = ['#45526c','#e94560','#0f9b8e','#f2a154','#5c7aea','#9b5de5','#00bbf9','#fee440'];
 function anFallback(el, data, layout) {
   var W = el.clientWidth || 420, H = el.clientHeight || 320, P = 44;
@@ -679,10 +760,12 @@ def anovos_report(
                 pass
     tabs.append(("Wiki", wiki or "<p>no dictionaries configured</p>"))
 
-    # descriptive stats (reference :994)
+    # descriptive stats (reference :994) + per-attribute drill-down panels
+    # (reference data_analyzer_output :233-440)
     sg_html = "".join(
         _table_html(df, name) for name in _SG_FILES if (df := _read_csv(master_path, name)) is not None
     )
+    sg_html += _attribute_profiles(master_path, label_col)
     sg_html += _charts_html(master_path, "freqDist_", "frequency distributions")
     if label_col:
         sg_html += _charts_html(master_path, "eventDist_", f"event rates vs {label_col}")
