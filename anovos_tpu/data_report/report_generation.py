@@ -92,6 +92,18 @@ def _read_csv(master_path: str, name: str) -> Optional[pd.DataFrame]:
     return None
 
 
+def _load_fig(path: str) -> Optional[dict]:
+    """Chart JSON from disk, None when absent/corrupt (one policy for every
+    chart-loading site)."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except Exception:
+        return None
+
+
 _table_seq = [0]
 
 
@@ -196,27 +208,22 @@ def _executive_summary(
     if label_col:
         html.append(f"<p>Target variable is <b>{escape(label_col)}</b>.</p>")
         # label distribution pie from the freqDist chart json (reference :560)
-        fd = ends_with(master_path) + "freqDist_" + str(label_col)
-        if os.path.exists(fd):
-            try:
-                with open(fd) as fh:
-                    fig = json.load(fh)
-                trace = fig["data"][0]
-                pie = {
-                    "data": [
-                        {
-                            "type": "pie",
-                            "labels": trace.get("x", []),
-                            "values": trace.get("y", []),
-                            "textinfo": "label+percent",
-                            "pull": [0, 0.1],
-                        }
-                    ],
-                    "layout": {"title": {"text": f"{label_col} distribution"}, "template": "plotly_white"},
-                }
-                html.append(_fig_div(pie, "label_pie", 300))
-            except Exception:
-                pass
+        fig = _load_fig(ends_with(master_path) + "freqDist_" + str(label_col))
+        if fig is not None and fig.get("data"):
+            trace = fig["data"][0]
+            pie = {
+                "data": [
+                    {
+                        "type": "pie",
+                        "labels": trace.get("x", []),
+                        "values": trace.get("y", []),
+                        "textinfo": "label+percent",
+                        "pull": [0, 0.1],
+                    }
+                ],
+                "layout": {"title": {"text": f"{label_col} distribution"}, "template": "plotly_white"},
+            }
+            html.append(_fig_div(pie, "label_pie", 300))
     else:
         html.append("<p>There is <b>no</b> target variable in the dataset.</p>")
 
@@ -310,13 +317,15 @@ def _correlated_cols(corr: Optional[pd.DataFrame], threshold: float) -> Optional
 # ----------------------------------------------------------------------
 # per-attribute drill-down (reference data_analyzer_output :233-440)
 # ----------------------------------------------------------------------
-def _attribute_profiles(master_path: str, label_col: str, limit: int = 60) -> str:
+def _attribute_profiles(
+    master_path: str, label_col: str, sg_frames: Dict[str, pd.DataFrame], limit: int = 60
+) -> str:
     """Collapsible per-attribute panel: every stat the SG files carry for the
     attribute, its frequency distribution, and (when a label exists) its
-    event-rate chart."""
+    event-rate chart.  ``sg_frames`` are the already-loaded stats frames."""
     profiles: Dict[str, Dict[str, str]] = {}
     for name in _SG_FILES[1:]:  # global_summary has no attribute axis
-        df = _read_csv(master_path, name)
+        df = sg_frames.get(name)
         if df is None or "attribute" not in df:
             continue
         for _, row in df.iterrows():
@@ -335,27 +344,15 @@ def _attribute_profiles(master_path: str, label_col: str, limit: int = 60) -> st
         kv = pd.DataFrame(
             {"metric": list(stats.keys()), "value": [str(v) for v in stats.values()]}
         )
-        body = [_table_html(kv, "")]
         charts = []
-        fd = mp + "freqDist_" + attr
-        if os.path.exists(fd):
-            try:
-                with open(fd) as fh:
-                    charts.append(_fig_div(json.load(fh), f"prof_f_{i}", 280))
-            except Exception:
-                pass
-        if label_col:
-            ed = mp + "eventDist_" + attr
-            if os.path.exists(ed):
-                try:
-                    with open(ed) as fh:
-                        charts.append(_fig_div(json.load(fh), f"prof_e_{i}", 280))
-                except Exception:
-                    pass
+        if (fig := _load_fig(mp + "freqDist_" + attr)) is not None:
+            charts.append(_fig_div(fig, f"prof_f_{i}", 280))
+        if label_col and (fig := _load_fig(mp + "eventDist_" + attr)) is not None:
+            charts.append(_fig_div(fig, f"prof_e_{i}", 280))
         out.append(
             f"<details><summary><b>{escape(attr)}</b></summary>"
             f"<div style='display:flex;gap:18px;flex-wrap:wrap;align-items:flex-start'>"
-            f"<div>{''.join(body)}</div><div class='chartgrid' style='flex:1;min-width:440px'>"
+            f"<div>{_table_html(kv, '')}</div><div class='chartgrid' style='flex:1;min-width:440px'>"
             f"{''.join(charts)}</div></div></details>"
         )
     return "".join(out)
@@ -568,13 +565,7 @@ function showTab(i) {
   document.querySelectorAll('nav button').forEach((b, j) => b.classList.toggle('active', i === j));
   document.querySelectorAll('main section').forEach((s, j) => {
     s.classList.toggle('active', i === j);
-    if (i === j) s.querySelectorAll('.chart').forEach(el => {
-      if (_anPending[el.id] && el.offsetParent !== null) {
-        var [d, l] = _anPending[el.id];
-        delete _anPending[el.id];
-        _anRender(el.id, d, l);
-      }
-    });
+    if (i === j) _anFlush(s);
   });
 }
 // ---- chart dispatch: plotly.js when the CDN loaded, SVG fallback when not.
@@ -589,6 +580,15 @@ function _anRender(id, data, layout) {
   if (window.Plotly) { Plotly.newPlot(id, data, layout, {displayModeBar: false}); return; }
   try { anFallback(el, data, layout); } catch (e) { el.textContent = 'chart unavailable offline'; }
 }
+function _anFlush(root) {
+  root.querySelectorAll('.chart').forEach(el => {
+    if (_anPending[el.id] && el.offsetParent !== null) {
+      var [d, l] = _anPending[el.id];
+      delete _anPending[el.id];
+      _anRender(el.id, d, l);
+    }
+  });
+}
 window.addEventListener('load', () => {
   _anQueue.forEach(([id, data, layout]) => {
     var el = document.getElementById(id);
@@ -596,16 +596,7 @@ window.addEventListener('load', () => {
     _anRender(id, data, layout);
   });
 });
-document.addEventListener('toggle', (e) => {
-  if (!e.target.open) return;
-  e.target.querySelectorAll('.chart').forEach(el => {
-    if (_anPending[el.id]) {
-      var [d, l] = _anPending[el.id];
-      delete _anPending[el.id];
-      _anRender(el.id, d, l);
-    }
-  });
-}, true);
+document.addEventListener('toggle', (e) => { if (e.target.open) _anFlush(e.target); }, true);
 var _anPal = ['#45526c','#e94560','#0f9b8e','#f2a154','#5c7aea','#9b5de5','#00bbf9','#fee440'];
 function anFallback(el, data, layout) {
   var W = el.clientWidth || 420, H = el.clientHeight || 320, P = 44;
@@ -761,14 +752,17 @@ def anovos_report(
     tabs.append(("Wiki", wiki or "<p>no dictionaries configured</p>"))
 
     # descriptive stats (reference :994) + per-attribute drill-down panels
-    # (reference data_analyzer_output :233-440)
-    sg_html = "".join(
-        _table_html(df, name) for name in _SG_FILES if (df := _read_csv(master_path, name)) is not None
-    )
-    sg_html += _attribute_profiles(master_path, label_col)
-    sg_html += _charts_html(master_path, "freqDist_", "frequency distributions")
-    if label_col:
-        sg_html += _charts_html(master_path, "eventDist_", f"event rates vs {label_col}")
+    # (reference data_analyzer_output :233-440).  The profiles embed each
+    # attribute's freqDist/eventDist chart, so no separate chart grids here
+    # (they would double every chart payload in the page).
+    sg_frames = {name: df for name in _SG_FILES if (df := _read_csv(master_path, name)) is not None}
+    sg_html = "".join(_table_html(df, name) for name, df in sg_frames.items())
+    profiles_html = _attribute_profiles(master_path, label_col, sg_frames)
+    sg_html += profiles_html
+    if not profiles_html:  # charts exist but no per-attribute stats: plain grids
+        sg_html += _charts_html(master_path, "freqDist_", "frequency distributions")
+        if label_col:
+            sg_html += _charts_html(master_path, "eventDist_", f"event rates vs {label_col}")
     tabs.append(("Descriptive Statistics", sg_html or "<p>no stats found</p>"))
 
     # quality (reference :1154)
