@@ -137,19 +137,28 @@ def _fig_div(fig: dict, div_id: str, height: int = 320) -> str:
     )
 
 
-def _charts_html(master_path: str, prefix: str, title: str, limit: int = 60, height: int = 320) -> str:
+def _charts_html(
+    master_path: str,
+    prefix: str,
+    title: str,
+    limit: int = 60,
+    height: int = 320,
+    exclude=frozenset(),
+) -> str:
+    """Chart grid for every ``prefix``-named JSON, minus attributes already
+    rendered elsewhere (``exclude``)."""
     files = sorted(glob.glob(ends_with(master_path) + prefix + "*"))
-    files = [f for f in files if not f.endswith(".csv")]
+    files = [
+        f
+        for f in files
+        if not f.endswith(".csv") and os.path.basename(f)[len(prefix):] not in exclude
+    ]
     if not files:
         return ""
     out = [f"<h3>{escape(title)}</h3><div class='chartgrid'>"]
     for i, f in enumerate(files[:limit]):
-        try:
-            with open(f) as fh:
-                fig = json.load(fh)
-        except Exception:
-            continue
-        out.append(_fig_div(fig, f"{prefix.rstrip('_')}{i}", height))
+        if (fig := _load_fig(f)) is not None:
+            out.append(_fig_div(fig, f"{prefix.rstrip('_')}{i}", height))
     out.append("</div>")
     return "".join(out)
 
@@ -209,7 +218,7 @@ def _executive_summary(
         html.append(f"<p>Target variable is <b>{escape(label_col)}</b>.</p>")
         # label distribution pie from the freqDist chart json (reference :560)
         fig = _load_fig(ends_with(master_path) + "freqDist_" + str(label_col))
-        if fig is not None and fig.get("data"):
+        if fig is not None and isinstance(fig.get("data"), list) and fig["data"] and isinstance(fig["data"][0], dict):
             trace = fig["data"][0]
             pie = {
                 "data": [
@@ -319,10 +328,13 @@ def _correlated_cols(corr: Optional[pd.DataFrame], threshold: float) -> Optional
 # ----------------------------------------------------------------------
 def _attribute_profiles(
     master_path: str, label_col: str, sg_frames: Dict[str, pd.DataFrame], limit: int = 60
-) -> str:
+) -> tuple:
     """Collapsible per-attribute panel: every stat the SG files carry for the
     attribute, its frequency distribution, and (when a label exists) its
-    event-rate chart.  ``sg_frames`` are the already-loaded stats frames."""
+    event-rate chart.  ``sg_frames`` are the already-loaded stats frames.
+    Returns (html, attributes whose charts were embedded) so callers can
+    render plain grids for anything not covered here."""
+    covered: set = set()
     profiles: Dict[str, Dict[str, str]] = {}
     for name in _SG_FILES[1:]:  # global_summary has no attribute axis
         df = sg_frames.get(name)
@@ -334,13 +346,14 @@ def _attribute_profiles(
                 if col != "attribute":
                     d[col] = row[col]
     if not profiles:
-        return ""
+        return "", covered
     mp = ends_with(master_path)
     out = ["<h3>attribute profiles</h3>"]
     for i, (attr, stats) in enumerate(sorted(profiles.items())):
         if i >= limit:
             out.append(f"<p>… {len(profiles) - limit} more attributes (see tables above)</p>")
             break
+        covered.add(attr)
         kv = pd.DataFrame(
             {"metric": list(stats.keys()), "value": [str(v) for v in stats.values()]}
         )
@@ -355,7 +368,7 @@ def _attribute_profiles(
             f"<div>{_table_html(kv, '')}</div><div class='chartgrid' style='flex:1;min-width:440px'>"
             f"{''.join(charts)}</div></div></details>"
         )
-    return "".join(out)
+    return "".join(out), covered
 
 
 # ----------------------------------------------------------------------
@@ -753,16 +766,18 @@ def anovos_report(
 
     # descriptive stats (reference :994) + per-attribute drill-down panels
     # (reference data_analyzer_output :233-440).  The profiles embed each
-    # attribute's freqDist/eventDist chart, so no separate chart grids here
-    # (they would double every chart payload in the page).
+    # attribute's freqDist/eventDist chart; plain grids render only whatever
+    # the profiles did not cover (beyond the cap, or chart with no SG row),
+    # so no chart appears twice but none is lost.
     sg_frames = {name: df for name in _SG_FILES if (df := _read_csv(master_path, name)) is not None}
     sg_html = "".join(_table_html(df, name) for name, df in sg_frames.items())
-    profiles_html = _attribute_profiles(master_path, label_col, sg_frames)
+    profiles_html, covered = _attribute_profiles(master_path, label_col, sg_frames)
     sg_html += profiles_html
-    if not profiles_html:  # charts exist but no per-attribute stats: plain grids
-        sg_html += _charts_html(master_path, "freqDist_", "frequency distributions")
-        if label_col:
-            sg_html += _charts_html(master_path, "eventDist_", f"event rates vs {label_col}")
+    sg_html += _charts_html(master_path, "freqDist_", "frequency distributions", exclude=covered)
+    if label_col:
+        sg_html += _charts_html(
+            master_path, "eventDist_", f"event rates vs {label_col}", exclude=covered
+        )
     tabs.append(("Descriptive Statistics", sg_html or "<p>no stats found</p>"))
 
     # quality (reference :1154)

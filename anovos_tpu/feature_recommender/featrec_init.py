@@ -34,7 +34,10 @@ class _EmbeddingModel:
         try:  # pragma: no cover - requires downloaded weights
             from sentence_transformers import SentenceTransformer
 
-            self.model = SentenceTransformer(detect_model_path())
+            # a bare model name loads cache-only: hub downloads would spend
+            # minutes in connect retries in offline envs before failing
+            path = detect_model_path()
+            self.model = SentenceTransformer(path, local_files_only=not os.path.isdir(path))
             self.backend = "sentence-transformers"
         except Exception:
             from sklearn.feature_extraction.text import TfidfVectorizer
@@ -63,8 +66,14 @@ def detect_model_path() -> str:
 
 
 def model_download() -> None:  # pragma: no cover - network-dependent
-    """Eager model fetch (reference :36-59)."""
-    get_model()
+    """Eager model fetch (reference :36-59) — the one path allowed to hit the hub."""
+    global _MODEL
+    from sentence_transformers import SentenceTransformer
+
+    m = _EmbeddingModel.__new__(_EmbeddingModel)
+    m.model = SentenceTransformer(detect_model_path())
+    m.backend = "sentence-transformers"
+    _MODEL = m
 
 
 def get_model() -> _EmbeddingModel:
@@ -84,6 +93,39 @@ def load_corpus(corpus_path: Optional[str] = None) -> pd.DataFrame:
     raise FileNotFoundError(
         "feature recommender corpus not found; pass corpus_path (csv or jsonl) or place corpus.jsonl under feature_recommender/data/"
     )
+
+
+def init_input_fer(corpus_path: Optional[str] = None) -> pd.DataFrame:
+    """Raw FER corpus frame (reference :62-79)."""
+    return load_corpus(corpus_path)
+
+
+def feature_exploration_prep(corpus_path: Optional[str] = None) -> pd.DataFrame:
+    """Corpus with normalized column names for the explorer (reference :182-192)."""
+    df = load_corpus(corpus_path)
+    return df.rename(columns=lambda c: c.strip().replace(" ", "_"))
+
+
+def group_corpus_features(df: pd.DataFrame, name: str, desc: str, ind: str, uc: str) -> pd.DataFrame:
+    """One row per distinct (name, description) with industry/usecase sets
+    joined — the reference's embedding-corpus dedup (:214-223)."""
+    joinset = lambda x: ", ".join(sorted(set(x.dropna().astype(str))))
+    # NaN descriptions must not drop features from the embedding corpus
+    return (
+        df.assign(**{desc: df[desc].fillna("")})
+        .groupby([name, desc])
+        .agg({ind: joinset, uc: joinset})
+        .reset_index()
+    )
+
+
+def feature_recommendation_prep(corpus_path: Optional[str] = None):
+    """(cleaned corpus texts, deduped corpus frame) for the mapper (reference :195-228)."""
+    df = load_corpus(corpus_path)
+    name, desc, ind, uc = get_column_name(df)
+    grouped = group_corpus_features(df, name, desc, ind, uc)
+    texts = recommendation_data_prep(grouped, name, desc)
+    return texts, grouped
 
 
 def camel_case_split(identifier: str) -> str:

@@ -48,11 +48,13 @@ def list_all_pair(corpus_path=None) -> pd.DataFrame:
     return pd.DataFrame(sorted(pairs), columns=["Industry", "Usecase"])
 
 
-def _semantic_pick(query: str, options: list) -> str:
+def _semantic_pick(query: str, options: list, semantic: bool = True) -> str:
     """Fuzzy + embedding match of a user string to the known values
-    (reference process_usecase/process_industry :61-139)."""
+    (reference process_usecase/process_industry :61-139).  With
+    ``semantic=False`` the reference only cleans the string — an unknown
+    value then simply matches nothing downstream."""
     q = str(query).lower().strip()
-    if q in options:
+    if q in options or not semantic:
         return q
     model = get_model()
     model.fit_corpus(options + [q])
@@ -60,24 +62,24 @@ def _semantic_pick(query: str, options: list) -> str:
     return options[int(np.argmax(sims))]
 
 
-def process_industry(industry: str, corpus_path=None) -> str:
-    return _semantic_pick(industry, list(list_all_industry(corpus_path)["Industry"]))
+def process_industry(industry: str, semantic: bool = True, corpus_path=None) -> str:
+    return _semantic_pick(industry, list(list_all_industry(corpus_path)["Industry"]), semantic)
 
 
-def process_usecase(usecase: str, corpus_path=None) -> str:
-    return _semantic_pick(usecase, list(list_all_usecase(corpus_path)["Usecase"]))
+def process_usecase(usecase: str, semantic: bool = True, corpus_path=None) -> str:
+    return _semantic_pick(usecase, list(list_all_usecase(corpus_path)["Usecase"]), semantic)
 
 
-def list_usecase_by_industry(industry: str, corpus_path=None) -> pd.DataFrame:
+def list_usecase_by_industry(industry: str, semantic: bool = True, corpus_path=None) -> pd.DataFrame:
     df, _, _, ind, uc = _corpus(corpus_path)
-    industry = process_industry(industry, corpus_path)
+    industry = process_industry(industry, semantic, corpus_path)
     sub = df[df[ind].str.lower() == industry]
     return pd.DataFrame({"Usecase": sorted(sub[uc].dropna().str.lower().unique())})
 
 
-def list_industry_by_usecase(usecase: str, corpus_path=None) -> pd.DataFrame:
+def list_industry_by_usecase(usecase: str, semantic: bool = True, corpus_path=None) -> pd.DataFrame:
     df, _, _, ind, uc = _corpus(corpus_path)
-    usecase = process_usecase(usecase, corpus_path)
+    usecase = process_usecase(usecase, semantic, corpus_path)
     sub = df[df[uc].str.lower() == usecase]
     return pd.DataFrame({"Industry": sorted(sub[ind].dropna().str.lower().unique())})
 
@@ -96,21 +98,21 @@ def _feature_frame(sub: pd.DataFrame, name, desc, ind, uc) -> pd.DataFrame:
 def list_feature_by_industry(industry: str, num_of_feat: int = 100, semantic: bool = True, corpus_path=None) -> pd.DataFrame:
     """Top-N features for an industry (reference :181-224)."""
     df, name, desc, ind, uc = _corpus(corpus_path)
-    industry = process_industry(industry, corpus_path)
+    industry = process_industry(industry, semantic=semantic, corpus_path=corpus_path)
     sub = df[df[ind].str.lower() == industry]
     return _feature_frame(sub.head(num_of_feat), name, desc, ind, uc)
 
 
 def list_feature_by_usecase(usecase: str, num_of_feat: int = 100, semantic: bool = True, corpus_path=None) -> pd.DataFrame:
     df, name, desc, ind, uc = _corpus(corpus_path)
-    usecase = process_usecase(usecase, corpus_path)
+    usecase = process_usecase(usecase, semantic=semantic, corpus_path=corpus_path)
     sub = df[df[uc].str.lower() == usecase]
     return _feature_frame(sub.head(num_of_feat), name, desc, ind, uc)
 
 
-def list_feature_by_pair(industry: str, usecase: str, num_of_feat: int = 100, corpus_path=None) -> pd.DataFrame:
+def list_feature_by_pair(industry: str, usecase: str, num_of_feat: int = 100, semantic: bool = True, corpus_path=None) -> pd.DataFrame:
     df, name, desc, ind, uc = _corpus(corpus_path)
-    industry = process_industry(industry, corpus_path)
-    usecase = process_usecase(usecase, corpus_path)
+    industry = process_industry(industry, semantic=semantic, corpus_path=corpus_path)
+    usecase = process_usecase(usecase, semantic=semantic, corpus_path=corpus_path)
     sub = df[(df[ind].str.lower() == industry) & (df[uc].str.lower() == usecase)]
     return _feature_frame(sub.head(num_of_feat), name, desc, ind, uc)

@@ -18,6 +18,7 @@ from anovos_tpu.feature_recommender.featrec_init import (
     cosine_sim_matrix,
     get_column_name,
     get_model,
+    group_corpus_features,
     load_corpus,
     recommendation_data_prep,
 )
@@ -50,7 +51,9 @@ def feature_mapper(
         corpus = corpus[corpus[ind].str.lower() == industry.lower()]
     if usecase:
         corpus = corpus[corpus[uc].str.lower() == usecase.lower()]
-    corpus = corpus.reset_index(drop=True)
+    # dedup features repeated across industries so they can't fill several
+    # top_n slots with identical matches (reference feature_recommendation_prep)
+    corpus = group_corpus_features(corpus, name, desc, ind, uc)
     user = _prep_user_frame(attr_names, attr_descriptions)
     corpus_texts = recommendation_data_prep(corpus, name, desc)
     user_texts = recommendation_data_prep(
@@ -118,24 +121,84 @@ def find_attr_by_relevance(
     )
 
 
-def sankey_visualization(mapping_df: pd.DataFrame) -> dict:
-    """Plotly sankey JSON of attribute→feature links (ref :465-560)."""
-    attrs = list(dict.fromkeys(mapping_df["Attribute Name"]))
-    feats = list(dict.fromkeys(mapping_df["Feature Name"]))
-    labels = attrs + feats
-    src = [attrs.index(a) for a in mapping_df["Attribute Name"]]
-    tgt = [len(attrs) + feats.index(f) for f in mapping_df["Feature Name"]]
+def _split_multi(values) -> List[str]:
+    """Comma-joined industry/usecase strings → individual node labels
+    (reference :548-560 splits on ", ")."""
+    out: List[str] = []
+    for v in values:
+        for part in str(v).split(", "):
+            if part and part not in out:
+                out.append(part)
+    return out
+
+
+def sankey_visualization(
+    mapping_df: pd.DataFrame,
+    industry_included: bool = False,
+    usecase_included: bool = False,
+) -> dict:
+    """Plotly sankey JSON of attribute→feature links (ref :465-560).
+
+    ``industry_included``/``usecase_included`` append extra node layers:
+    feature → usecase → industry, with comma-joined corpus values split into
+    individual nodes like the reference.  ``find_attr_by_relevance`` output
+    has no industry/usecase columns, so the flags are ignored for it
+    (reference :516-526).
+    """
+    if "Recommended Input Attribute" in mapping_df.columns:
+        if industry_included or usecase_included:
+            print(
+                "Input is find_attr_by_relevance output DataFrame. "
+                "There is no suggested Industry and/or Usecase."
+            )
+        attrs = list(dict.fromkeys(mapping_df["Input Feature Desc"]))
+        feats = list(dict.fromkeys(mapping_df["Recommended Input Attribute"]))
+        labels = attrs + feats
+        src = [attrs.index(a) for a in mapping_df["Input Feature Desc"]]
+        tgt = [len(attrs) + feats.index(f) for f in mapping_df["Recommended Input Attribute"]]
+        val = [float(v) for v in mapping_df["Input Attribute Similarity Score"]]
+        title = "feature description → attribute relevance"
+    else:
+        attrs = list(dict.fromkeys(mapping_df["Attribute Name"]))
+        feats = list(dict.fromkeys(mapping_df["Feature Name"]))
+        labels = attrs + feats
+        src = [attrs.index(a) for a in mapping_df["Attribute Name"]]
+        tgt = [len(attrs) + feats.index(f) for f in mapping_df["Feature Name"]]
+        val = [float(v) for v in mapping_df["Similarity Score"]]
+        title = "attribute → feature mapping"
+        layers = []
+        if usecase_included and "Usecase" in mapping_df.columns:
+            layers.append("Usecase")
+        if industry_included and "Industry" in mapping_df.columns:
+            layers.append("Industry")
+        prev_col, prev_labels, prev_base = "Feature Name", feats, len(attrs)
+        for col in layers:
+            nodes = _split_multi(mapping_df[col].dropna())
+            base = len(labels)
+            labels = labels + nodes
+            for _, row in mapping_df.iterrows():
+                # prev_col values are themselves comma-joined past the first layer
+                prev_val = str(row[prev_col])
+                srcs = (
+                    [prev_val]
+                    if prev_val in prev_labels
+                    else [p for p in prev_val.split(", ") if p in prev_labels]
+                )
+                for part in str(row[col]).split(", "):
+                    if not part or part not in nodes:  # NaN rows were dropped from nodes
+                        continue
+                    for s in srcs:
+                        src.append(prev_base + prev_labels.index(s))
+                        tgt.append(base + nodes.index(part))
+                        val.append(float(row["Similarity Score"]))
+            prev_col, prev_labels, prev_base = col, nodes, base
     return {
         "data": [
             {
                 "type": "sankey",
                 "node": {"label": labels, "pad": 12},
-                "link": {
-                    "source": src,
-                    "target": tgt,
-                    "value": [float(v) for v in mapping_df["Similarity Score"]],
-                },
+                "link": {"source": src, "target": tgt, "value": val},
             }
         ],
-        "layout": {"title": {"text": "attribute → feature mapping"}},
+        "layout": {"title": {"text": title}},
     }

@@ -180,6 +180,29 @@ def test_feature_recommender():
     assert set(mapping["Attribute Name"]) == {"cust_age", "txn_amt"}
     fig = sankey_visualization(mapping)
     assert fig["data"][0]["type"] == "sankey"
+    # industry/usecase node layers (reference sankey kwargs)
+    fig2 = sankey_visualization(mapping, industry_included=True, usecase_included=True)
+    labels2 = fig2["data"][0]["node"]["label"]
+    assert len(labels2) > len(fig["data"][0]["node"]["label"])
+    assert len(fig2["data"][0]["link"]["source"]) > len(fig["data"][0]["link"]["source"])
+
+
+def test_feature_recommender_prep_api():
+    from anovos_tpu.feature_recommender.featrec_init import (
+        feature_exploration_prep,
+        feature_recommendation_prep,
+        init_input_fer,
+    )
+    from anovos_tpu.feature_recommender.feature_explorer import process_industry
+
+    raw = init_input_fer()
+    assert len(raw) > 1000
+    expl = feature_exploration_prep()
+    assert all(" " not in c for c in expl.columns)
+    texts, grouped = feature_recommendation_prep()
+    assert len(texts) == len(grouped) and len(grouped) <= len(raw)
+    # semantic=False must pass the cleaned string through untouched
+    assert process_industry("NoSuchIndustryXYZ", semantic=False) == "nosuchindustryxyz"
 
 
 def test_feast_exporter(tmp_path):
@@ -207,3 +230,14 @@ def test_feast_exporter(tmp_path):
     code = open(out).read()
     assert "FeatureView" in code and 'join_keys=["ifa"]' in code and "income_svc" in code
     compile(code, out, "exec")  # generated repo file must be valid python
+
+
+def test_feature_retrieval_entity_frame():
+    from anovos_tpu.feature_store import feature_retrieval as fr
+
+    df = fr.build_entity_frame()
+    assert list(df.columns) == ["ifa", "event_timestamp"] and len(df) == 10
+    df2 = fr.build_entity_frame(["u1", "u2"], entity_name="userid")
+    assert list(df2["userid"]) == ["u1", "u2"]
+    with pytest.raises((ImportError, ValueError)):
+        fr.retrieve_historical_features("/nonexistent", df)
