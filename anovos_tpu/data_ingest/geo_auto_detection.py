@@ -138,3 +138,33 @@ def ll_gh_cols(idf: Table, max_records: int = 100000) -> Tuple[List[str], List[s
 def geo_to_latlong(gh: str) -> Tuple[float, float]:
     """Geohash cell center (reference :101-175)."""
     return geohash_decode(gh)
+
+
+def conv_str_plus(col):
+    """Prefix positive values with '+' (reference :45-66) — the detector's
+    signed-string form for regex probing."""
+    if col is None:
+        return None
+    if col < 0:
+        return col
+    return "+" + str(col)
+
+
+def precision_lev(col) -> int:
+    """Number of significant digits after the decimal point, capped at 8
+    (reference :72-100 — whose unstripped 8dp padding made every fractional
+    value score 8, so low-precision columns were indistinguishable from
+    coordinate-grade ones)."""
+    if col is None:
+        return 0
+    frac = format(float(col), ".8f").split(".")[1].rstrip("0")
+    return len(frac)
+
+
+def latlong_to_geo(lat, long, precision: int = 9):
+    """(lat, lon) → geohash string (reference :143-176), on our own codec."""
+    from anovos_tpu.data_transformer.geo_utils import geohash_encode
+
+    if lat is None or long is None:
+        return None
+    return geohash_encode(float(lat), float(long), precision)

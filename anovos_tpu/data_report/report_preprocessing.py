@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from pathlib import Path
 from typing import List, Optional
 
@@ -89,6 +90,140 @@ def _violin_fig(values: np.ndarray, name: str) -> dict:
 def _write_json(fig: dict, path: str) -> None:
     with open(path, "w") as f:
         json.dump(fig, f)
+
+
+_BIN_RANGE = re.compile(r"^(-?\d+(?:\.\d+)?)-(-?\d+(?:\.\d+)?)$")
+
+
+def edit_binRange(col):
+    """Collapse degenerate "x-x" bin-range labels to "x" (reference :130-152).
+    The split keys on the separator hyphen, not a leading minus sign, so
+    negative-bound ranges like "-10--5" survive intact."""
+    m = _BIN_RANGE.match(str(col))
+    if m and m.group(1) == m.group(2):
+        return m.group(1)
+    return col
+
+
+def binRange_to_binIdx(idf: Table, col: str, cutoffs_path: str) -> Table:
+    """Map a column's values to 1-based bin indices using a persisted binning
+    model (reference :158-197): the report-side re-binning primitive."""
+    from anovos_tpu.data_transformer.model_io import load_model_df
+    from anovos_tpu.ops.drift_kernels import compare_digitize
+    from anovos_tpu.shared.table import Column
+
+    dfm = load_model_df(cutoffs_path, "attribute_binning")
+    cut_map = {r["attribute"]: np.asarray(list(r["parameters"]), float) for _, r in dfm.iterrows()}
+    if col not in cut_map:
+        raise ValueError(f"no binning model for column {col} under {cutoffs_path}")
+    c = idf.columns[col]
+    bins = compare_digitize(c.data[:, None], jnp.asarray(cut_map[col][None, :], jnp.float32))[:, 0] + 1
+    return idf.with_column(
+        col + "_binIdx", Column("num", bins.astype(jnp.float32), c.mask, dtype_name="double")
+    )
+
+
+def plot_frequency(idf: Table, col: str, cutoffs_path: Optional[str] = None, bin_size: int = 10) -> dict:
+    """Frequency-distribution figure for one column (reference :200-257).
+    Numeric columns bin against the persisted model when given, else fresh
+    equal-frequency cutoffs; categoricals count by dictionary code."""
+    c = idf.columns[col]
+    if c.kind == "cat":
+        vsize = max(len(c.vocab), 1)
+        cnts = np.asarray(code_counts(c.data, c.mask, vsize))
+        order = np.argsort(-cnts)
+        return _bar_fig(
+            [str(c.vocab[j]) for j in order if cnts[j] > 0],
+            [float(cnts[j]) for j in order if cnts[j] > 0],
+            col,
+        )
+    cuts = _col_cutoffs(idf, col, cutoffs_path, bin_size)
+    bin_size = len(cuts) + 1  # a persisted model may have been fit with another bin count
+    counts = np.asarray(
+        binned_histograms(c.data[:, None], c.mask[:, None], jnp.asarray(cuts[None, :], jnp.float32), bin_size)
+    )[0]
+    return _bar_fig([f"{j + 1}" for j in range(bin_size)], counts.tolist(), col)
+
+
+def plot_outlier(idf: Table, col: str, split_var=None, sample_size: int = 500000) -> dict:
+    """Violin figure of a numeric column on a ≤sample_size sample (reference :260-300)."""
+    vals = np.asarray(idf.columns[col].data)[: idf.nrows].astype(float)
+    mask = np.asarray(idf.columns[col].mask)[: idf.nrows]
+    sample = vals[mask]
+    if len(sample) > sample_size:
+        sample = np.random.default_rng(0).choice(sample, sample_size, replace=False)
+    return _violin_fig(sample, col)
+
+
+def plot_eventRate(
+    idf: Table, col: str, label_col: str, event_label, cutoffs_path: Optional[str] = None, bin_size: int = 10
+) -> dict:
+    """Per-bin / per-category event-rate figure (reference :303-367)."""
+    from anovos_tpu.data_transformer.transformers import _event_vector
+
+    y, ym = _event_vector(idf, label_col, event_label)
+    c = idf.columns[col]
+    if c.kind == "cat":
+        from anovos_tpu.ops.segment import code_label_counts
+
+        vsize = max(len(c.vocab), 1)
+        m_eff = c.mask & ym
+        tot = np.asarray(code_label_counts(c.data, m_eff, jnp.ones_like(y), vsize))
+        evs = np.asarray(code_label_counts(c.data, m_eff, y, vsize))
+        with np.errstate(invalid="ignore", divide="ignore"):
+            rate = np.where(tot > 0, evs / np.maximum(tot, 1), 0.0)
+        order = np.argsort(-tot)
+        return _bar_fig(
+            [str(c.vocab[j]) for j in order if tot[j] > 0],
+            [float(rate[j]) for j in order if tot[j] > 0],
+            f"event rate: {col}",
+            global_theme_r,
+        )
+    from anovos_tpu.ops.drift_kernels import compare_digitize
+    from anovos_tpu.ops.histogram import masked_bincount
+
+    cuts = _col_cutoffs(idf, col, cutoffs_path, bin_size)
+    bin_size = len(cuts) + 1  # a persisted model may have been fit with another bin count
+    bins = compare_digitize(c.data[:, None], jnp.asarray(cuts[None, :], jnp.float32))
+    Mv = c.mask[:, None] & ym[:, None]
+    tot = np.asarray(masked_bincount(bins, Mv, bin_size))[0]
+    evs = np.asarray(masked_bincount(bins, Mv & (y[:, None] > 0), bin_size))[0]
+    with np.errstate(invalid="ignore", divide="ignore"):
+        rate = np.where(tot > 0, evs / np.maximum(tot, 1), 0.0)
+    return _bar_fig([f"{j + 1}" for j in range(bin_size)], rate.tolist(), f"event rate: {col}", global_theme_r)
+
+
+def plot_comparative_drift(idf: Table, source_path: str, col: str, model_directory: str = "drift_statistics") -> dict:
+    """Source-vs-target frequency figure from the persisted drift model CSVs
+    (reference :370-466)."""
+    fpath = os.path.join(source_path, model_directory, "frequency_counts", col, "part-00000.csv")
+    if not os.path.exists(fpath):
+        raise FileNotFoundError(f"no persisted source frequencies for {col} under {source_path}")
+    fdf = pd.read_csv(fpath, dtype=str)
+    skeys = fdf.iloc[:, 0].astype(str).tolist()
+    sfreq = fdf["p"].astype(float).to_numpy()
+    fig_t = plot_frequency(idf, col, cutoffs_path=os.path.join(source_path, model_directory))
+    t_x = [str(v) for v in fig_t["data"][0]["x"]]
+    t_y = np.asarray(fig_t["data"][0]["y"], float)
+    t_y = t_y / max(t_y.sum(), 1)
+    tmap = dict(zip(t_x, t_y))
+    return _grouped_fig(skeys, {"source": sfreq, "target": [tmap.get(k, 0.0) for k in skeys]}, f"drift: {col}")
+
+
+def _col_cutoffs(idf: Table, col: str, cutoffs_path: Optional[str], bin_size: int) -> np.ndarray:
+    """Cutoffs from a persisted binning model when available, else a fresh fit."""
+    if cutoffs_path:
+        from anovos_tpu.data_transformer.model_io import load_model_df
+
+        try:
+            dfm = load_model_df(cutoffs_path, "attribute_binning")
+            cut_map = {r["attribute"]: np.asarray(list(r["parameters"]), float) for _, r in dfm.iterrows()}
+            if col in cut_map:
+                return cut_map[col]
+        except FileNotFoundError:
+            pass
+    c = idf.columns[col]
+    return np.asarray(fit_cutoffs((c.data,), (c.mask,), bin_size, "equal_frequency"))[0]
 
 
 def charts_to_objects(

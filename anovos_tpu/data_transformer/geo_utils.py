@@ -215,3 +215,144 @@ def point_in_country_approx(lat: np.ndarray, lon: np.ndarray, country: str) -> n
             lon = np.asarray(lon, float)
             return (lat >= lo_lat) & (lat <= hi_lat) & (lon >= lo_lon) & (lon <= hi_lon)
     raise ValueError(f"unknown country for approx containment: {country}")
+
+
+# ----------------------------------------------------------------------
+# scalar location-format helpers (reference geo_utils.py:14-226) — the
+# notebook-facing API; the batched device paths live in ops/geo_kernels.py
+# ----------------------------------------------------------------------
+def in_range(loc, loc_format: str = "dd") -> None:
+    """Warn when a location is outside the valid lat/lon range (reference :14-49)."""
+    import warnings
+
+    try:
+        if loc_format == "dd":
+            lat, lon = [float(i) for i in loc]
+        else:
+            lat, lon = to_latlon_decimal_degrees(loc, loc_format)
+    except Exception:
+        return
+    if lat is None or lon is None:
+        return
+    if lat > 90 or lat < -90 or lon > 180 or lon < -180:
+        warnings.warn(
+            "Rows may contain unintended values due to longitude and/or latitude "
+            "values being out of the valid range"
+        )
+
+
+def decimal_degrees_to_degrees_minutes_seconds(dd) -> List:
+    """Decimal degrees → [degree, minute, second] (reference :139-158)."""
+    if dd is None:
+        return [None, None, None]
+    minute, second = divmod(float(dd) * 3600, 60)
+    degree, minute = divmod(minute, 60)
+    return [degree, minute, second]
+
+
+def to_latlon_decimal_degrees(loc, input_format: str, radius: float = EARTH_RADIUS_M):
+    """Any supported location format → [lat, lon] (reference :51-137)."""
+    import warnings
+
+    if loc is None:
+        return None
+    if isinstance(loc, (list, tuple)) and any(i is None for i in loc):
+        return None
+    if (
+        isinstance(loc, (list, tuple))
+        and loc
+        and isinstance(loc[0], (list, tuple))
+        and any(i is None for i in tuple(loc[0]) + tuple(loc[1]))
+    ):
+        return None
+    if input_format not in ("dd", "dms", "radian", "cartesian", "geohash"):
+        raise ValueError(f"unknown input_format {input_format}")
+    lat = lon = None
+    try:
+        if input_format == "dd":
+            lat, lon = float(loc[0]), float(loc[1])
+        elif input_format == "dms":
+            d1, m1, s1 = [float(i) for i in loc[0]]
+            d2, m2, s2 = [float(i) for i in loc[1]]
+            lat = d1 + m1 / 60 + s1 / 3600
+            lon = d2 + m2 / 60 + s2 / 3600
+        elif input_format == "radian":
+            lat = math.degrees(float(loc[0]))
+            lon = math.degrees(float(loc[1]))
+        elif input_format == "cartesian":
+            x, y, z = [float(i) for i in loc]
+            lat = math.degrees(math.asin(z / radius))
+            lon = math.degrees(math.atan2(y, x))
+        elif input_format == "geohash":
+            lat, lon = geohash_decode(loc)
+    except Exception:  # malformed row: warn and drop, never crash (ref :80-136)
+        warnings.warn("Rows dropped due to invalid longitude and/or latitude values")
+        return [None, None]
+    in_range((lat, lon))
+    return [lat, lon]
+
+
+def from_latlon_decimal_degrees(
+    loc, output_format: str, radius: float = EARTH_RADIUS_M, geohash_precision: int = 8
+):
+    """[lat, lon] → any supported location format (reference :161-226)."""
+    lat, lon = (None, None) if loc is None else (loc[0], loc[1])
+    if output_format == "dd":
+        return [lat, lon]
+    if output_format == "dms":
+        return [
+            decimal_degrees_to_degrees_minutes_seconds(lat),
+            decimal_degrees_to_degrees_minutes_seconds(lon),
+        ]
+    if lat is None or lon is None:
+        return [None, None, None] if output_format == "cartesian" else (
+            None if output_format == "geohash" else [None, None]
+        )
+    if output_format == "radian":
+        return [math.radians(float(lat)), math.radians(float(lon))]
+    if output_format == "cartesian":
+        lat_r, lon_r = math.radians(float(lat)), math.radians(float(lon))
+        return [
+            radius * math.cos(lat_r) * math.cos(lon_r),
+            radius * math.cos(lat_r) * math.sin(lon_r),
+            radius * math.sin(lat_r),
+        ]
+    if output_format == "geohash":
+        return geohash_encode(float(lat), float(lon), geohash_precision)
+    raise ValueError(f"unknown output_format {output_format}")
+
+
+def _points_in_polygon_list(x, y, polygon_list, south_west_loc=(), north_east_loc=()) -> np.ndarray:
+    """Vectorized membership of (x=lon, y=lat) arrays against a
+    MultiPolygon-style nested coordinate list; holes carve out via even-odd
+    parity.  Bounding-box args pre-filter like the reference (:466-470)."""
+    x = np.asarray(x, float)
+    y = np.asarray(y, float)
+    candidate = np.ones(x.shape, bool)
+    if south_west_loc:
+        candidate &= (x >= south_west_loc[0]) & (y >= south_west_loc[1])
+    if north_east_loc:
+        candidate &= (x <= north_east_loc[0]) & (y <= north_east_loc[1])
+    inside = np.zeros(x.shape, bool)
+    for poly in polygon_list:
+        rings = poly if isinstance(poly[0][0], (list, tuple)) else [poly]
+        hit = point_in_polygon(y, x, [(p[0], p[1]) for p in rings[0]])
+        for hole in rings[1:]:
+            hit &= ~point_in_polygon(y, x, [(p[0], p[1]) for p in hole])
+        inside |= hit
+    return (inside & candidate).astype(np.int32)
+
+
+def point_in_polygons(x, y, polygon_list, south_west_loc=(), north_east_loc=()) -> int:
+    """Scalar form of the membership check (reference :453-500)."""
+    return int(_points_in_polygon_list([x], [y], polygon_list, south_west_loc, north_east_loc)[0])
+
+
+def f_point_in_polygons(polygon_list, south_west_loc=(), north_east_loc=()):
+    """Membership function over arrays (the reference's UDF factory :503-516
+    without Spark): returns f(lon, lat) → int array, fully vectorized."""
+
+    def f(x, y):
+        return _points_in_polygon_list(x, y, polygon_list, south_west_loc, north_east_loc)
+
+    return f

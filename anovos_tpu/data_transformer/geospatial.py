@@ -383,12 +383,55 @@ def _bbox_program(lat, lon, lo_lon, lo_lat, hi_lon, hi_lat):
     return (lat >= lo_lat) & (lat <= hi_lat) & (lon >= lo_lon) & (lon <= hi_lon)
 
 
+def location_in_polygon(
+    idf: Table,
+    list_of_lat,
+    list_of_lon,
+    polygon: dict,
+    result_prefix=(),
+    output_mode: str = "append",
+    **_ignored,
+) -> Table:
+    """Flag rows inside a GeoJSON object — Polygon, MultiPolygon, Feature or
+    FeatureCollection (reference :727-812).  The rings are flattened into one
+    padded edge set and every lat-lon pair ray-casts against it in a single
+    device program per pair."""
+    if isinstance(list_of_lat, str):
+        list_of_lat = [v.strip() for v in list_of_lat.split("|")]
+    if isinstance(list_of_lon, str):
+        list_of_lon = [v.strip() for v in list_of_lon.split("|")]
+    if isinstance(result_prefix, str):
+        result_prefix = [v.strip() for v in result_prefix.split("|")]
+    missing = [c for c in list(list_of_lat) + list(list_of_lon) if c not in idf.col_names]
+    if missing:
+        raise TypeError(f"Invalid input for list_of_lat or list_of_lon: {missing}")
+    if len(list_of_lat) != len(list_of_lon):
+        raise TypeError("list_of_lat and list_of_lon must have the same length")
+    if result_prefix and len(result_prefix) != len(list_of_lat):
+        raise TypeError("result_prefix must have the same length as list_of_lat")
+    ex1, ey1, ex2, ey2 = _geojson_obj_edges(polygon)
+    odf = idf
+    for i, (lat_c, lon_c) in enumerate(zip(list_of_lat, list_of_lon)):
+        lat, ml = _dev_num(idf, lat_c)
+        lon, mo = _dev_num(idf, lon_c)
+        inside = gk.point_in_polygons(lat, lon, ex1, ey1, ex2, ey2)
+        name = (result_prefix[i] if result_prefix else f"{lat_c}_{lon_c}") + "_in_poly"
+        odf = _add_dev(odf, name, inside.astype(jnp.float32), ml & mo)
+        if output_mode == "replace":
+            odf = odf.drop([lat_c, lon_c])
+    return odf
+
+
 def _geojson_edges(path: str):
     """Host: flatten all rings of a geojson file into padded edge arrays."""
     import json
 
     with open(path) as f:
-        gj = json.load(f)
+        return _geojson_obj_edges(json.load(f))
+
+
+def _geojson_obj_edges(gj: dict):
+    """Flatten all rings of a parsed geojson object into padded edge arrays."""
     feats = gj["features"] if gj.get("type") == "FeatureCollection" else [gj]
     x1s, y1s, x2s, y2s = [], [], [], []
     for feat in feats:

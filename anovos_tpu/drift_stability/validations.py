@@ -2,7 +2,67 @@
 
 from __future__ import annotations
 
+from functools import partial, wraps
 from typing import List, Optional
+
+
+def check_list_of_columns(
+    func=None,
+    columns: str = "list_of_cols",
+    target_idx: int = 1,
+    target: str = "idf_target",
+    drop: str = "drop_cols",
+):
+    """Decorator resolving ``list_of_cols``/"all"/pipe-strings minus
+    ``drop_cols`` against the target Table before the wrapped function runs
+    (reference validations.py:8-68)."""
+    if func is None:
+        return partial(
+            check_list_of_columns, columns=columns, target_idx=target_idx, target=target, drop=drop
+        )
+
+    @wraps(func)
+    def validate(*args, **kwargs):
+        idf_target = kwargs.get(target, None)
+        if idf_target is None and len(args) > target_idx:
+            idf_target = args[target_idx]
+        cols_raw = kwargs.get(columns, "all")
+        if isinstance(cols_raw, str):
+            if cols_raw == "all":
+                num_cols, cat_cols, _ = idf_target.attribute_type_segregation()
+                cols = num_cols + cat_cols
+            else:
+                cols = [x.strip() for x in cols_raw.split("|")]
+        elif isinstance(cols_raw, list):
+            cols = cols_raw
+        else:
+            raise TypeError(
+                f"'{columns}' must be either a string or a list of strings. Received {type(cols_raw)}."
+            )
+        drops_raw = kwargs.get(drop, [])
+        if drops_raw is None:
+            drops_raw = []
+        if isinstance(drops_raw, str):
+            drops = [x.strip() for x in drops_raw.split("|")]
+        elif isinstance(drops_raw, list):
+            drops = drops_raw
+        else:
+            raise TypeError(
+                f"'{drop}' must be either a string or a list of strings. Received {type(drops_raw)}."
+            )
+        final_cols = list(set(e for e in cols if e not in drops))
+        if not final_cols:
+            raise ValueError(
+                f"Empty set of columns is given. Columns to select: {cols}, columns to drop: {drops}."
+            )
+        missing = [x for x in final_cols if x not in idf_target.col_names]
+        if missing:
+            raise ValueError(f"Not all columns are in the input dataframe. Missing columns: {set(missing)}")
+        kwargs[columns] = final_cols
+        kwargs[drop] = []
+        return func(*args, **kwargs)
+
+    return validate
 
 
 def check_distance_method(method_type: str) -> List[str]:

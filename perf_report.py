@@ -165,7 +165,33 @@ def bench_e2e() -> dict:
     }
 
 
-def main() -> None:
+SECTION_TIMEOUT = int(os.environ.get("PERF_SECTION_TIMEOUT", 600))
+
+
+def _run_section(section: str) -> dict:
+    """One bench section in its own subprocess so a slow remote compile (or a
+    wedged TPU tunnel) costs at most SECTION_TIMEOUT, not the whole report."""
+    import subprocess
+
+    t0 = time.perf_counter()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--section", section],
+            capture_output=True, text=True,
+            timeout=SECTION_TIMEOUT if section != "e2e" else max(SECTION_TIMEOUT, 1800),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"section timed out after {time.perf_counter() - t0:.0f}s"}
+    for line in reversed(r.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                break
+    return {"error": f"rc={r.returncode}: {(r.stderr or r.stdout or '')[-300:]}"}
+
+
+def _init_backend():
     # honor JAX_PLATFORMS even though the container's PJRT hook latches the
     # backend at interpreter startup (env var alone is not enough)
     if os.environ.get("JAX_PLATFORMS"):
@@ -174,24 +200,45 @@ def main() -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax
 
-    backend = jax.default_backend()
-    peaks = PEAKS.get(backend, PEAKS["cpu"])
     from anovos_tpu.shared import init_runtime
 
     init_runtime()
-    df = _load_income(ROWS)
+    return jax
+
+
+def run_one(section: str) -> None:
+    jax = _init_backend()
+    if section == "psi":
+        out = bench_psi(_load_income(ROWS))
+    elif section == "hist":
+        out = bench_hist_pallas(_load_income(min(ROWS, 1_000_000)))
+    elif section == "ae":
+        out = bench_ae_mfu()
+    elif section == "e2e":
+        out = bench_e2e()
+    else:
+        raise SystemExit(f"unknown section {section}")
+    print(json.dumps(out))
+
+
+def main() -> None:
+    jax = _init_backend()
+    backend = jax.default_backend()
+    peaks = PEAKS.get(backend, PEAKS["cpu"])
     results = {"backend": backend, "devices": len(jax.devices())}
-    results["psi_drift"] = bench_psi(df)
-    results["psi_drift"]["hbm_util_pct"] = round(
-        100 * results["psi_drift"]["achieved_gbps"] / peaks["hbm_gbps"], 1
-    )
-    results["hist_pallas_vs_xla"] = bench_hist_pallas(df.iloc[: min(len(df), 1_000_000)])
-    results["ae_train"] = bench_ae_mfu()
-    results["ae_train"]["mfu_pct"] = round(
-        100 * results["ae_train"]["tflops"] / peaks["f32_tflops"], 1
-    )
+    results["psi_drift"] = _run_section("psi")
+    if "achieved_gbps" in results["psi_drift"]:
+        results["psi_drift"]["hbm_util_pct"] = round(
+            100 * results["psi_drift"]["achieved_gbps"] / peaks["hbm_gbps"], 1
+        )
+    results["hist_pallas_vs_xla"] = _run_section("hist")
+    results["ae_train"] = _run_section("ae")
+    if "tflops" in results["ae_train"]:
+        results["ae_train"]["mfu_pct"] = round(
+            100 * results["ae_train"]["tflops"] / peaks["f32_tflops"], 1
+        )
     if os.environ.get("PERF_E2E", "1") == "1":
-        results["configs_full_e2e"] = bench_e2e()
+        results["configs_full_e2e"] = _run_section("e2e")
     print(json.dumps(results))
     _write_md(results)
 
@@ -208,13 +255,23 @@ def _write_md(r: dict) -> None:
         "",
         "| benchmark | metric | value |",
         "|---|---|---|",
-        f"| PSI drift ({psi['rows']:,} rows × {psi['cols']} cols) | wall | {psi['wall_s']} s |",
-        f"| | rows/sec | {psi['rows_per_sec']:,} |",
-        f"| | bytes moved | {psi['bytes_gb']} GB |",
-        f"| | achieved bandwidth | {psi['achieved_gbps']} GB/s ({psi['hbm_util_pct']}% of peak) |",
-        f"| AE train step ({ae.get('shape', '?')} batch) | step time | {ae['step_s']} s |",
-        f"| | throughput | {ae['tflops']} TFLOP/s ({ae['mfu_pct']}% MFU) |",
     ]
+    if "rows" in psi:
+        lines += [
+            f"| PSI drift ({psi['rows']:,} rows × {psi['cols']} cols) | wall | {psi['wall_s']} s |",
+            f"| | rows/sec | {psi['rows_per_sec']:,} |",
+            f"| | bytes moved | {psi['bytes_gb']} GB |",
+            f"| | achieved bandwidth | {psi['achieved_gbps']} GB/s ({psi.get('hbm_util_pct', '?')}% of peak) |",
+        ]
+    else:
+        lines.append(f"| PSI drift | error | {psi.get('error', '?')[:100]} |")
+    if "step_s" in ae:
+        lines += [
+            f"| AE train step ({ae.get('shape', '?')} batch) | step time | {ae['step_s']} s |",
+            f"| | throughput | {ae['tflops']} TFLOP/s ({ae.get('mfu_pct', '?')}% MFU) |",
+        ]
+    else:
+        lines.append(f"| AE train step | error | {ae.get('error', '?')[:100]} |")
     h = r.get("hist_pallas_vs_xla", {})
     if "xla_s" in h:
         lines.append(f"| fused histogram (XLA) | steady wall | {h['xla_s']} s |")
@@ -222,10 +279,14 @@ def _write_md(r: dict) -> None:
         lines.append(f"| fused histogram (Pallas) | steady wall | {h['pallas_s']} s |")
     elif "pallas_error" in h:
         lines.append(f"| fused histogram (Pallas) | unavailable | {h['pallas_error'][:80]} |")
+    if "xla_s" not in h:
+        lines.append(f"| fused histogram | error | {h.get('error', '?')[:100]} |")
     e = r.get("configs_full_e2e")
-    if e:
+    if e and "wall_s" in e:
         lines.append(f"| configs_full e2e (32,561 rows) | wall | {e['wall_s']} s |")
         lines.append(f"| | rows/sec/chip | {e['rows_per_sec_per_chip']} |")
+    elif e:
+        lines.append(f"| configs_full e2e | error | {e.get('error', '?')[:100]} |")
     lines += [
         "",
         "Run `python perf_report.py` (TPU) or `JAX_PLATFORMS=cpu python perf_report.py`",
@@ -238,4 +299,7 @@ def _write_md(r: dict) -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--section":
+        run_one(sys.argv[2])
+    else:
+        main()

@@ -241,3 +241,54 @@ def test_feature_retrieval_entity_frame():
     assert list(df2["userid"]) == ["u1", "u2"]
     with pytest.raises((ImportError, ValueError)):
         fr.retrieve_historical_features("/nonexistent", df)
+
+
+def test_location_in_polygon_and_geo_utils():
+    from anovos_tpu.data_transformer import geospatial as geo
+    from anovos_tpu.data_transformer import geo_utils as gu
+
+    t = Table.from_pandas(
+        pd.DataFrame({"lat1": [0.5, 2.0, 0.1], "lon1": [0.5, 2.0, 0.9]})
+    )
+    square = {"type": "Polygon", "coordinates": [[[0, 0], [1, 0], [1, 1], [0, 1], [0, 0]]]}
+    out = geo.location_in_polygon(t, ["lat1"], ["lon1"], square)
+    flags = out.to_pandas()["lat1_lon1_in_poly"].tolist()
+    assert flags == [1.0, 0.0, 1.0]
+    # Feature + result_prefix + replace mode
+    feat = {"type": "Feature", "geometry": square}
+    out2 = geo.location_in_polygon(t, "lat1", "lon1", feat, result_prefix="P", output_mode="replace")
+    assert "P_in_poly" in out2.col_names and "lat1" not in out2.col_names
+
+    # scalar helpers round-trip (reference geo_utils surface)
+    lat, lon = gu.to_latlon_decimal_degrees([[40, 26, 46], [79, 58, 56]], "dms")
+    assert abs(lat - 40.446111) < 1e-5 and abs(lon - 79.982222) < 1e-5
+    dms = gu.from_latlon_decimal_degrees([lat, lon], "dms")
+    assert int(dms[0][0]) == 40 and int(dms[0][1]) == 26
+    cart = gu.from_latlon_decimal_degrees([lat, lon], "cartesian")
+    back = gu.to_latlon_decimal_degrees(cart, "cartesian")
+    assert abs(back[0] - lat) < 1e-6 and abs(back[1] - lon) < 1e-6
+    gh = gu.from_latlon_decimal_degrees([lat, lon], "geohash", geohash_precision=9)
+    back_gh = gu.to_latlon_decimal_degrees(gh, "geohash")
+    assert abs(back_gh[0] - lat) < 1e-3 and abs(back_gh[1] - lon) < 1e-3
+    assert gu.point_in_polygons(0.5, 0.5, [[[(0, 0), (1, 0), (1, 1), (0, 1)]]]) == 1
+    assert gu.point_in_polygons(5, 5, [[[(0, 0), (1, 0), (1, 1), (0, 1)]]]) == 0
+    f = gu.f_point_in_polygons([[[(0, 0), (1, 0), (1, 1), (0, 1)]]])
+    assert f([0.5, 5.0], [0.5, 5.0]).tolist() == [1, 0]
+
+
+def test_check_list_of_columns_decorator():
+    from anovos_tpu.drift_stability.validations import check_list_of_columns
+
+    t = Table.from_pandas(pd.DataFrame({"a": [1.0, 2.0], "b": [3.0, 4.0], "c": ["x", "y"]}))
+
+    @check_list_of_columns(target_idx=0, target="idf_target")
+    def grab(idf_target, list_of_cols="all", drop_cols=[]):
+        return sorted(list_of_cols)
+
+    assert grab(t) == ["a", "b", "c"]
+    assert grab(t, list_of_cols="a|b") == ["a", "b"]
+    assert grab(t, list_of_cols="all", drop_cols=["c"]) == ["a", "b"]
+    with pytest.raises(ValueError):
+        grab(t, list_of_cols="nope")
+    with pytest.raises(ValueError):
+        grab(t, list_of_cols="a", drop_cols="a")

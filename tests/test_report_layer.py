@@ -96,3 +96,55 @@ def test_basic_report_end_to_end(rep_table, tmp_path):
         assert (rs / f).exists(), f
     iv = pd.read_csv(rs / "IV_calculation.csv")
     assert "label" not in set(iv["attribute"])  # label itself excluded
+
+
+def test_public_plot_builders(rep_table, tmp_path):
+    from anovos_tpu.data_report.report_preprocessing import (
+        binRange_to_binIdx,
+        edit_binRange,
+        plot_comparative_drift,
+        plot_eventRate,
+        plot_frequency,
+        plot_outlier,
+    )
+
+    assert edit_binRange("5-5") == "5" and edit_binRange("1-2") == "1-2"
+
+    fig = plot_frequency(rep_table, "num1")
+    assert fig["data"][0]["type"] == "bar" and sum(fig["data"][0]["y"]) == rep_table.nrows
+    figc = plot_frequency(rep_table, "cat1")
+    assert set(figc["data"][0]["x"]) == {"a", "b", "c"}
+
+    out = plot_outlier(rep_table, "num2", sample_size=500)
+    assert out["data"][0]["type"] == "violin" and len(out["data"][0]["y"]) == 500
+
+    ev = plot_eventRate(rep_table, "num1", "label", "yes")
+    assert all(0 <= v <= 1 for v in ev["data"][0]["y"])
+    evc = plot_eventRate(rep_table, "cat1", "label", "yes")
+    assert all(0 <= v <= 1 for v in evc["data"][0]["y"])
+
+    # drift figure against a persisted model
+    from anovos_tpu.drift_stability.drift_detector import statistics
+
+    g = np.random.default_rng(6)
+    n = 3000
+    src = Table.from_pandas(
+        pd.DataFrame(
+            {
+                "num1": g.normal(50, 10, n),
+                "num2": g.exponential(5, n),
+                "cat1": g.choice(["a", "b", "c"], n),
+                "label": g.choice(["yes", "no"], n),
+            }
+        )
+    )
+    statistics(rep_table, src, use_sampling=False, source_path=str(tmp_path / "drift"))
+    dfig = plot_comparative_drift(rep_table, str(tmp_path / "drift"), "num1")
+    names = {tr["name"] for tr in dfig["data"]}
+    assert names == {"source", "target"}
+
+    # persisted-model re-binning
+    t2 = binRange_to_binIdx(rep_table, "num1", str(tmp_path / "drift" / "drift_statistics"))
+    assert "num1_binIdx" in t2.col_names
+    vals = np.asarray(t2.columns["num1_binIdx"].data)[: t2.nrows]
+    assert vals.min() >= 1 and vals.max() <= 10
