@@ -77,12 +77,13 @@ def _fill_counts_light(idf: Table, cols: List[str]) -> np.ndarray:
     describe (sorts etc.); when describe is already cached, reuse it."""
     cache = getattr(idf, "_describe_cache", None)
     if cache:
-        num_out, cat_out = next(iter(cache.values()))
-        num_all, cat_all, _ = idf.attribute_type_segregation()
-        ni = {c: i for i, c in enumerate(num_all)}
-        ci = {c: i for i, c in enumerate(cat_all)}
-        if all(c in ni or c in ci for c in cols):
-            return np.array([_fill_count(idf, c, num_out, cat_out, ni, ci) for c in cols])
+        # a cache entry may cover only a subset of columns — positions must
+        # come from ITS key, not from the table's full column lists
+        for (knum, kcat), (num_out, cat_out) in cache.items():
+            ni = {c: i for i, c in enumerate(knum)}
+            ci = {c: i for i, c in enumerate(kcat)}
+            if all(c in ni or c in ci for c in cols):
+                return np.array([_fill_count(idf, c, num_out, cat_out, ni, ci) for c in cols])
     M = _stacked_valid_mask(idf, cols)
     return np.asarray(M.sum(axis=0, dtype=jnp.int32)).astype(np.int64)
 

@@ -141,12 +141,13 @@ def geo_to_latlong(gh: str) -> Tuple[float, float]:
 
 
 def conv_str_plus(col):
-    """Prefix positive values with '+' (reference :45-66) — the detector's
-    signed-string form for regex probing."""
+    """Signed-string form for regex probing: positives get a '+' prefix
+    (reference :45-66 — whose Spark UDF declares StringType, so the raw
+    negative it returns is cast to its string form downstream)."""
     if col is None:
         return None
     if col < 0:
-        return col
+        return str(col)
     return "+" + str(col)
 
 
@@ -157,7 +158,10 @@ def precision_lev(col) -> int:
     coordinate-grade ones)."""
     if col is None:
         return 0
-    frac = format(float(col), ".8f").split(".")[1].rstrip("0")
+    v = float(col)
+    if not np.isfinite(v):  # NaN is this codebase's numeric null
+        return 0
+    frac = format(v, ".8f").split(".")[1].rstrip("0")
     return len(frac)
 
 

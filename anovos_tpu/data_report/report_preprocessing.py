@@ -105,15 +105,28 @@ def edit_binRange(col):
     return col
 
 
+def _load_cut_map(cutoffs_path: Optional[str]) -> dict:
+    """{attribute: cutoff array} from a persisted attribute_binning model;
+    {} when the path holds no model (the one loader every binning consumer
+    in this file shares)."""
+    if not cutoffs_path:
+        return {}
+    from anovos_tpu.data_transformer.model_io import load_model_df
+
+    try:
+        dfm = load_model_df(cutoffs_path, "attribute_binning")
+    except (FileNotFoundError, ValueError):
+        return {}
+    return {r["attribute"]: np.asarray(list(r["parameters"]), float) for _, r in dfm.iterrows()}
+
+
 def binRange_to_binIdx(idf: Table, col: str, cutoffs_path: str) -> Table:
     """Map a column's values to 1-based bin indices using a persisted binning
     model (reference :158-197): the report-side re-binning primitive."""
-    from anovos_tpu.data_transformer.model_io import load_model_df
     from anovos_tpu.ops.drift_kernels import compare_digitize
     from anovos_tpu.shared.table import Column
 
-    dfm = load_model_df(cutoffs_path, "attribute_binning")
-    cut_map = {r["attribute"]: np.asarray(list(r["parameters"]), float) for _, r in dfm.iterrows()}
+    cut_map = _load_cut_map(cutoffs_path)
     if col not in cut_map:
         raise ValueError(f"no binning model for column {col} under {cutoffs_path}")
     c = idf.columns[col]
@@ -145,14 +158,34 @@ def plot_frequency(idf: Table, col: str, cutoffs_path: Optional[str] = None, bin
     return _bar_fig([f"{j + 1}" for j in range(bin_size)], counts.tolist(), col)
 
 
-def plot_outlier(idf: Table, col: str, split_var=None, sample_size: int = 500000) -> dict:
-    """Violin figure of a numeric column on a ≤sample_size sample (reference :260-300)."""
+def plot_outlier(idf: Table, col: str, split_var: Optional[str] = None, sample_size: int = 500000) -> dict:
+    """Violin figure of a numeric column on a ≤sample_size sample; with
+    ``split_var`` one violin trace per category of that column
+    (reference :260-300)."""
     vals = np.asarray(idf.columns[col].data)[: idf.nrows].astype(float)
     mask = np.asarray(idf.columns[col].mask)[: idf.nrows]
-    sample = vals[mask]
-    if len(sample) > sample_size:
-        sample = np.random.default_rng(0).choice(sample, sample_size, replace=False)
-    return _violin_fig(sample, col)
+    if split_var is None:
+        sample = vals[mask]
+        if len(sample) > sample_size:
+            sample = np.random.default_rng(0).choice(sample, sample_size, replace=False)
+        return _violin_fig(sample, col)
+    sc = idf.columns[split_var]
+    codes = np.asarray(sc.data)[: idf.nrows]
+    smask = mask & np.asarray(sc.mask)[: idf.nrows] & (codes >= 0)
+    fig = None
+    for code, name in enumerate(sc.vocab):
+        sample = vals[smask & (codes == code)]
+        if not len(sample):
+            continue
+        if len(sample) > sample_size:
+            sample = np.random.default_rng(code).choice(sample, sample_size, replace=False)
+        part = _violin_fig(sample, str(name))
+        if fig is None:
+            fig = part
+            fig["layout"]["title"] = {"text": f"{col} by {split_var}"}
+        else:
+            fig["data"].extend(part["data"])
+    return fig if fig is not None else _violin_fig(vals[mask], col)
 
 
 def plot_eventRate(
@@ -212,16 +245,9 @@ def plot_comparative_drift(idf: Table, source_path: str, col: str, model_directo
 
 def _col_cutoffs(idf: Table, col: str, cutoffs_path: Optional[str], bin_size: int) -> np.ndarray:
     """Cutoffs from a persisted binning model when available, else a fresh fit."""
-    if cutoffs_path:
-        from anovos_tpu.data_transformer.model_io import load_model_df
-
-        try:
-            dfm = load_model_df(cutoffs_path, "attribute_binning")
-            cut_map = {r["attribute"]: np.asarray(list(r["parameters"]), float) for _, r in dfm.iterrows()}
-            if col in cut_map:
-                return cut_map[col]
-        except FileNotFoundError:
-            pass
+    cut_map = _load_cut_map(cutoffs_path)
+    if col in cut_map:
+        return cut_map[col]
     c = idf.columns[col]
     return np.asarray(fit_cutoffs((c.data,), (c.mask,), bin_size, "equal_frequency"))[0]
 
@@ -278,12 +304,7 @@ def charts_to_objects(
 
     # ---- numeric columns: bin once (reuse drift cutoffs when available) ----
     if num_cols:
-        cut_map = {}
-        if drift_model_dir and os.path.isdir(os.path.join(drift_model_dir, "attribute_binning")):
-            from anovos_tpu.data_transformer.model_io import load_model_df
-
-            dfm = load_model_df(drift_model_dir, "attribute_binning")
-            cut_map = {r["attribute"]: np.asarray(list(r["parameters"]), float) for _, r in dfm.iterrows()}
+        cut_map = _load_cut_map(drift_model_dir)
         fit_cols = [c for c in num_cols if c not in cut_map]
         if fit_cols:
             cuts = np.asarray(

@@ -371,8 +371,8 @@ def location_in_country(
                 raise ValueError(f"unknown country for approx containment: {country}")
             inside = _bbox_program(lat, lon, *map(float, bbox))
         else:
-            ex1, ey1, ex2, ey2 = _geojson_edges(country_shapefile_path)
-            inside = gk.point_in_polygons(lat, lon, ex1, ey1, ex2, ey2)
+            ex1, ey1, ex2, ey2, pid, n_poly = _geojson_edges(country_shapefile_path)
+            inside = gk.point_in_polygon_set(lat, lon, ex1, ey1, ex2, ey2, pid, n_poly)
         pre = (result_prefix + "_") if result_prefix else ""
         odf = _add_dev(odf, f"{pre}{lat_c}_{lon_c}_in_{country}", inside.astype(jnp.float32), mask)
     return odf
@@ -409,12 +409,12 @@ def location_in_polygon(
         raise TypeError("list_of_lat and list_of_lon must have the same length")
     if result_prefix and len(result_prefix) != len(list_of_lat):
         raise TypeError("result_prefix must have the same length as list_of_lat")
-    ex1, ey1, ex2, ey2 = _geojson_obj_edges(polygon)
+    ex1, ey1, ex2, ey2, pid, n_poly = _geojson_obj_edges(polygon)
     odf = idf
     for i, (lat_c, lon_c) in enumerate(zip(list_of_lat, list_of_lon)):
         lat, ml = _dev_num(idf, lat_c)
         lon, mo = _dev_num(idf, lon_c)
-        inside = gk.point_in_polygons(lat, lon, ex1, ey1, ex2, ey2)
+        inside = gk.point_in_polygon_set(lat, lon, ex1, ey1, ex2, ey2, pid, n_poly)
         name = (result_prefix[i] if result_prefix else f"{lat_c}_{lon_c}") + "_in_poly"
         odf = _add_dev(odf, name, inside.astype(jnp.float32), ml & mo)
         if output_mode == "replace":
@@ -431,25 +431,33 @@ def _geojson_edges(path: str):
 
 
 def _geojson_obj_edges(gj: dict):
-    """Flatten all rings of a parsed geojson object into padded edge arrays."""
+    """Flatten all rings of a parsed geojson object into edge arrays plus a
+    per-edge polygon id: rings of one polygon (outer + holes) share an id so
+    even-odd parity runs per polygon, and overlapping polygons union instead
+    of cancelling.  Returns (ex1, ey1, ex2, ey2, poly_id, n_poly)."""
     feats = gj["features"] if gj.get("type") == "FeatureCollection" else [gj]
-    x1s, y1s, x2s, y2s = [], [], [], []
+    x1s, y1s, x2s, y2s, pids = [], [], [], [], []
+    n_poly = 0
     for feat in feats:
         geom = feat.get("geometry", feat)
         polys = geom["coordinates"] if geom["type"] == "MultiPolygon" else [geom["coordinates"]]
         for poly in polys:
-            for ring in poly:  # outer + holes: even-odd parity handles both
+            for ring in poly:
                 pts = np.asarray(ring, float)
                 nxt = np.roll(pts, -1, axis=0)
                 x1s.append(pts[:, 0])
                 y1s.append(pts[:, 1])
                 x2s.append(nxt[:, 0])
                 y2s.append(nxt[:, 1])
+                pids.append(np.full(len(pts), n_poly, np.int32))
+            n_poly += 1
     return (
         jnp.asarray(np.concatenate(x1s), jnp.float32),
         jnp.asarray(np.concatenate(y1s), jnp.float32),
         jnp.asarray(np.concatenate(x2s), jnp.float32),
         jnp.asarray(np.concatenate(y2s), jnp.float32),
+        jnp.asarray(np.concatenate(pids)),
+        n_poly,
     )
 
 

@@ -21,8 +21,22 @@ def check_list_of_columns(
             check_list_of_columns, columns=columns, target_idx=target_idx, target=target, drop=drop
         )
 
+    import inspect
+
+    sig = inspect.signature(func)
+
     @wraps(func)
     def validate(*args, **kwargs):
+        # bind positionals to their parameter names so a positionally-passed
+        # column list is validated instead of colliding with the kwarg write
+        try:
+            bound = sig.bind_partial(*args, **kwargs)
+            args, kwargs = (), dict(bound.arguments)
+            for p in sig.parameters.values():  # re-flatten a packed **kwargs
+                if p.kind == inspect.Parameter.VAR_KEYWORD and p.name in kwargs:
+                    kwargs.update(kwargs.pop(p.name))
+        except TypeError:
+            pass  # signature mismatch: let func raise its own error
         idf_target = kwargs.get(target, None)
         if idf_target is None and len(args) > target_idx:
             idf_target = args[target_idx]

@@ -191,6 +191,23 @@ def point_in_polygons(lat: jax.Array, lon: jax.Array, ex1, ey1, ex2, ey2) -> jax
     return crossings % 2 == 1
 
 
+@functools.partial(jax.jit, static_argnames=("n_poly",))
+def point_in_polygon_set(lat, lon, ex1, ey1, ex2, ey2, poly_id, n_poly: int) -> jax.Array:
+    """Union of per-polygon even-odd containment: parity is computed per
+    polygon id (rings of one polygon, incl. holes, share an id) and OR-ed,
+    so overlapping polygons don't cancel each other the way a single global
+    parity would.  The per-polygon crossing count is a (rows, E) @ (E,
+    n_poly) one-hot matmul — MXU work, one dispatch."""
+    py, px = lat[:, None], lon[:, None]
+    y1, y2 = ey1[None, :], ey2[None, :]
+    x1, x2 = ex1[None, :], ex2[None, :]
+    straddles = (y1 > py) != (y2 > py)
+    xi = x1 + (py - y1) * (x2 - x1) / jnp.where(y2 == y1, 1.0, y2 - y1)
+    crossing = (straddles & (px < xi)).astype(jnp.float32)
+    counts = crossing @ jax.nn.one_hot(poly_id, n_poly, dtype=jnp.float32)
+    return (counts.astype(jnp.int32) % 2 == 1).any(axis=1)
+
+
 @functools.partial(jax.jit, static_argnames=("nseg",))
 def segment_centroid(x, y, z, seg, valid, nseg: int):
     """Per-segment cartesian means → (clat, clon, count) arrays (nseg,)."""

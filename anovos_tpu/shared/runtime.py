@@ -69,8 +69,23 @@ class Runtime:
 
     def pad_rows(self, n: int) -> int:
         """Rows are padded to a multiple of the data-axis size so every
-        shard has identical (static) shape — XLA requires static shapes."""
+        shard has identical (static) shape — XLA requires static shapes.
+
+        On top of that, row counts are bucketed into geometric size classes
+        (2^k and 1.5·2^k — ≤33% padding waste) so tables with nearby row
+        counts share compiled program shapes: every jit is keyed on the
+        padded shape, and on a remote-compile backend each novel shape costs
+        seconds of XLA compile.  Padding rows carry mask=False, so kernels
+        are unaffected.  ANOVOS_SHAPE_BUCKETS=0 disables the bucketing."""
         m = self.n_data
+        if os.environ.get("ANOVOS_SHAPE_BUCKETS", "1") != "0" and n > 256:
+            b = 256
+            while b < n:
+                if (c := b + b // 2) >= n:  # 1.5·2^k class between doublings
+                    b = c
+                    break
+                b *= 2
+            n = b
         return ((n + m - 1) // m) * m
 
 

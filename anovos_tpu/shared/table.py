@@ -30,6 +30,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from anovos_tpu.shared.runtime import get_runtime
 
@@ -235,15 +236,30 @@ class Table:
     # device block extraction for batched kernels
     # ------------------------------------------------------------------
     def numeric_block(
-        self, names: Sequence[str], dtype=jnp.float32
+        self, names: Sequence[str], dtype=jnp.float32, shard_cols: bool = False
     ) -> Tuple[jax.Array, jax.Array]:
         """Stack numeric columns into (padded_rows, k) X and bool mask M,
         row-sharded.  This is the input shape for every batched stats kernel.
         Cast+stack runs as ONE jitted program — per-column eager casts would
-        cost one device dispatch each (expensive on remote backends)."""
+        cost one device dispatch each (expensive on remote backends).
+
+        ``shard_cols=True`` additionally shards the column axis over the
+        mesh's model axis — the wide-table analogue of tensor parallelism
+        (SURVEY §2.10): per-column stats kernels reduce over rows only, so a
+        frame whose (rows × cols) block exceeds one chip's HBM splits across
+        the whole mesh with no kernel changes (GSPMD inserts the layout)."""
         datas = tuple(self.columns[n].data for n in names)
         masks = tuple(self.columns[n].mask for n in names)
-        return _stack_cast(datas, masks, dtype)
+        X, M = _stack_cast(datas, masks, dtype)
+        if shard_cols:
+            from anovos_tpu.shared.runtime import DATA_AXIS, MODEL_AXIS
+
+            rt = get_runtime()
+            if rt.mesh is not None and len(names) >= rt.mesh.shape.get(MODEL_AXIS, 1) > 1:
+                sh = NamedSharding(rt.mesh, P(DATA_AXIS, MODEL_AXIS))
+                X = jax.device_put(X, sh)
+                M = jax.device_put(M, sh)
+        return X, M
 
     def row_mask(self) -> jax.Array:
         """Validity of the *row* (excludes padding rows).  Multi-host tables

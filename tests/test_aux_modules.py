@@ -292,3 +292,35 @@ def test_check_list_of_columns_decorator():
         grab(t, list_of_cols="nope")
     with pytest.raises(ValueError):
         grab(t, list_of_cols="a", drop_cols="a")
+
+
+def test_location_in_polygon_overlap_union_and_hole():
+    from anovos_tpu.data_transformer import geospatial as geo
+
+    t = Table.from_pandas(pd.DataFrame({"la": [1.5, 0.5, 2.5, 5.0], "lo": [1.5, 0.5, 2.5, 5.0]}))
+    overlap = {
+        "type": "FeatureCollection",
+        "features": [
+            {"type": "Feature", "geometry": {"type": "Polygon", "coordinates": [[[0, 0], [2, 0], [2, 2], [0, 2], [0, 0]]]}},
+            {"type": "Feature", "geometry": {"type": "Polygon", "coordinates": [[[1, 1], [3, 1], [3, 3], [1, 3], [1, 1]]]}},
+        ],
+    }
+    # intersection point must be inside (union, not global parity)
+    assert geo.location_in_polygon(t, ["la"], ["lo"], overlap).to_pandas()["la_lo_in_poly"].tolist() == [1.0, 1.0, 1.0, 0.0]
+    holed = {"type": "Polygon", "coordinates": [[[0, 0], [4, 0], [4, 4], [0, 4], [0, 0]], [[1, 1], [3, 1], [3, 3], [1, 3], [1, 1]]]}
+    assert geo.location_in_polygon(t, ["la"], ["lo"], holed).to_pandas()["la_lo_in_poly"].tolist() == [0.0, 1.0, 0.0, 0.0]
+
+
+def test_check_list_of_columns_positional():
+    from anovos_tpu.drift_stability.validations import check_list_of_columns
+
+    t = Table.from_pandas(pd.DataFrame({"a": [1.0], "b": [2.0]}))
+
+    @check_list_of_columns(target_idx=0, target="idf_target")
+    def grab(idf_target, list_of_cols="all", drop_cols=[]):
+        return sorted(list_of_cols)
+
+    assert grab(t, ["a"]) == ["a"]  # positional list must be honored
+    assert grab(t, "a|b", ["b"]) == ["a"]
+    with pytest.raises(ValueError):
+        grab(t, ["nope"])

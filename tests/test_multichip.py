@@ -114,3 +114,32 @@ def test_sharded_stats_match_single_device(income_df):
     finally:
         init_runtime()  # restore the 8-device mesh for other tests
     pd.testing.assert_frame_equal(out8, out1)
+
+
+def test_column_sharded_describe_matches_row_sharded():
+    """Wide-table path: (rows, cols) block sharded over (data, model) axes
+    must give identical stats to the row-sharded layout."""
+    import jax
+    import numpy as np
+    import pandas as pd
+
+    from anovos_tpu.ops.reductions import masked_moments
+    from anovos_tpu.shared.runtime import MODEL_AXIS, init_runtime
+    from anovos_tpu.shared.table import Table
+
+    init_runtime(mesh_shape=(4, 2))
+    try:
+        g = np.random.default_rng(11)
+        df = pd.DataFrame({f"w{i}": g.normal(i, 1 + i / 10, 500) for i in range(8)})
+        df.iloc[::7, 3] = np.nan
+        t = Table.from_pandas(df)
+        cols = list(df.columns)
+        Xr, Mr = t.numeric_block(cols)
+        Xc, Mc = t.numeric_block(cols, shard_cols=True)
+        assert MODEL_AXIS in str(Xc.sharding.spec), Xc.sharding
+        mr = {k: np.asarray(v) for k, v in masked_moments(Xr, Mr).items()}
+        mc = {k: np.asarray(v) for k, v in masked_moments(Xc, Mc).items()}
+        for k in mr:
+            np.testing.assert_allclose(mr[k], mc[k], rtol=1e-5, err_msg=k)
+    finally:
+        init_runtime()  # restore the default 8-device data mesh

@@ -94,3 +94,19 @@ def test_income_parity(income_df):
     assert out.loc["sex", "mode"] == income_df["sex"].mode()[0]
     card = sg.measures_of_cardinality(t, drop_cols=["ifa"]).set_index("attribute")
     assert card.loc["education", "unique_values"] == income_df["education"].nunique()
+
+
+def test_subset_describe_cache_then_full_counts():
+    """A describe computed over a column SUBSET must not poison the
+    count-only fast path for the full table (TPU e2e crash: positions from
+    the full column list indexed into a subset-sized cache entry)."""
+    g = np.random.default_rng(9)
+    df = pd.DataFrame({f"n{i}": g.normal(size=50) for i in range(9)})
+    df["c1"] = g.choice(["x", "y"], 50)
+    t = Table.from_pandas(df)
+    from anovos_tpu.ops.describe import table_describe
+
+    # warm the cache with an 8-of-9 numeric subset
+    table_describe(t, [f"n{i}" for i in range(8)], ["c1"])
+    out = sg.missingCount_computation(t).set_index("attribute")
+    assert len(out) == 10 and (out["missing_count"] == 0).all()
