@@ -485,7 +485,7 @@ def recast_column(idf: Table, list_of_cols, list_of_dtypes, print_impact: bool =
                     v = col.exact_host(idf.nrows)
                     new = _host_to_column(
                         np.clip(v, np.iinfo(np.int32).min, np.iinfo(np.int32).max).astype(np.int64),
-                        idf.nrows, rt.pad_rows(max(idf.nrows, 1)), rt,
+                        idf.nrows, idf.pad_target(), rt,
                     )
             else:
                 new = Column("num", col.data.astype(tgt), col.mask, dtype_name=dt if dt != "integer" else "int")
@@ -501,7 +501,7 @@ def recast_column(idf: Table, list_of_cols, list_of_dtypes, print_impact: bool =
                 else:
                     vals[:] = [repr(float(v)) for v in host]
                 vals[~mask] = None
-                new = _host_to_column(vals, idf.nrows, rt.pad_rows(max(idf.nrows, 1)), rt)
+                new = _host_to_column(vals, idf.nrows, idf.pad_target(), rt)
         elif dt == "timestamp":
             host = np.asarray(col.data)[: idf.nrows]
             mask = np.asarray(col.mask)[: idf.nrows]
@@ -513,7 +513,7 @@ def recast_column(idf: Table, list_of_cols, list_of_dtypes, print_impact: bool =
             else:
                 ts = pd.to_datetime(pd.Series(host.astype("int64"), dtype="int64"), unit="s", errors="coerce")
                 ts[~mask] = pd.NaT
-            new = _host_to_column(ts.to_numpy(), idf.nrows, rt.pad_rows(max(idf.nrows, 1)), rt)
+            new = _host_to_column(ts.to_numpy(), idf.nrows, idf.pad_target(), rt)
         else:
             raise ValueError(f"unsupported recast dtype: {dt}")
         odf = odf.with_column(name, new)

@@ -143,11 +143,10 @@ def read_dataset_distributed(
             # sync instead and the rows never leave their host)
             all_vocabs = _allgather_obj(local_vocab)
             vocab = np.array(sorted({v for vs in all_vocabs for v in vs}), dtype=object)
-            lut = {v: i for i, v in enumerate(vocab)}
             codes = np.full(n, -1, np.int32)
-            for i, (v, b) in enumerate(zip(strs, isnull)):
-                if not b:
-                    codes[i] = lut[v]
+            nz = ~isnull
+            if vocab.size and nz.any():  # vocab is sorted: searchsorted = exact code
+                codes[nz] = np.searchsorted(vocab, strs[nz]).astype(np.int32)
             columns[c] = Column(
                 "cat",
                 _global_sharded(_pad(codes, np.int32(-1)), -1),

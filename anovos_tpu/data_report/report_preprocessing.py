@@ -170,6 +170,8 @@ def plot_outlier(idf: Table, col: str, split_var: Optional[str] = None, sample_s
             sample = np.random.default_rng(0).choice(sample, sample_size, replace=False)
         return _violin_fig(sample, col)
     sc = idf.columns[split_var]
+    if sc.kind != "cat":
+        raise ValueError(f"split_var must be a categorical column, got {sc.kind!r} ({split_var})")
     codes = np.asarray(sc.data)[: idf.nrows]
     smask = mask & np.asarray(sc.mask)[: idf.nrows] & (codes >= 0)
     fig = None
@@ -388,6 +390,12 @@ def charts_to_objects(
                 _grouped_fig(skeys, {"source": sfreq, "target": [tmap.get(k, 0.0) for k in skeys]}, f"drift: {c}"),
                 ends_with(master_path) + "drift_" + c,
             )
+
+    # ---- label distribution chart (exec-summary pie source, reference :560) --
+    # the label is excluded from the per-attribute loops above, but its own
+    # frequency chart must exist for the report's label pie
+    if label_col and label_col in idf.columns:
+        _write_json(plot_frequency(idf, label_col), ends_with(master_path) + "freqDist_" + label_col)
 
     # ---- dtype manifest (reference :712) -----------------------------------
     pd.DataFrame(idf.dtypes(), columns=["attribute", "data_type"]).to_csv(

@@ -115,7 +115,7 @@ def timestamp_to_unix(idf: Table, list_of_cols, precision: str = "s", tz: str = 
             from anovos_tpu.shared.table import wide_int_parts
 
             rt = get_runtime()
-            npad = rt.pad_rows(max(idf.nrows, 1))
+            npad = idf.pad_target()
             secs = np.asarray(jax.device_get(col.data))[: idf.nrows].astype("int64")
             mask_h = np.asarray(jax.device_get(col.mask))[: idf.nrows]
             v64 = np.where(mask_h, secs * 1000, 0)
@@ -143,7 +143,7 @@ def unix_to_timestamp(idf: Table, list_of_cols, precision: str = "s", tz: str = 
             # exact int64 epochs (ms or s) — divide host-side, re-upload int32
             v = col.exact_host(idf.nrows) // (1000 if precision == "ms" else 1)
             mask_h = np.asarray(jax.device_get(col.mask))[: idf.nrows]
-            npad = rt.pad_rows(max(idf.nrows, 1))
+            npad = idf.pad_target()
             pad = np.zeros(npad - idf.nrows, np.int64)
             secs_d = rt.shard_rows(np.concatenate([v, pad]).astype(np.int32))
             mask_d = rt.shard_rows(
@@ -244,7 +244,7 @@ def timestamp_to_string(idf: Table, list_of_cols, output_format: str = "%Y-%m-%d
         s = _ts_series(idf, c)
         vals = np.array(s.dt.strftime(output_format).to_numpy(dtype=object), copy=True)
         vals[s.isna().to_numpy()] = None
-        new = _host_to_column(vals, idf.nrows, rt.pad_rows(max(idf.nrows, 1)), rt)
+        new = _host_to_column(vals, idf.nrows, idf.pad_target(), rt)
         odf = odf.with_column(_out_name(c, output_mode, "_str"), new)
     return odf
 
@@ -731,7 +731,7 @@ def window_aggregator(
                 rt = get_runtime()
                 v = vals_h.astype(np.float64)
                 v[~ok_h] = np.nan
-                newc = _host_to_column(v, idf.nrows, rt.pad_rows(max(idf.nrows, 1)), rt)
+                newc = _host_to_column(v, idf.nrows, idf.pad_target(), rt)
                 odf = odf.with_column(f"{c}_{a}_{window_type}", newc)
                 continue
             vals, ok = _window_program(

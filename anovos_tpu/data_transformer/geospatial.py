@@ -60,14 +60,14 @@ def _host_cat(idf: Table, col: str) -> np.ndarray:
 def _add_num(idf: Table, name: str, values: np.ndarray) -> Table:
     rt = get_runtime()
     return idf.with_column(
-        name, _host_to_column(np.asarray(values, float), idf.nrows, rt.pad_rows(max(idf.nrows, 1)), rt)
+        name, _host_to_column(np.asarray(values, float), idf.nrows, idf.pad_target(), rt)
     )
 
 
 def _add_cat(idf: Table, name: str, values: np.ndarray) -> Table:
     rt = get_runtime()
     return idf.with_column(
-        name, _host_to_column(np.asarray(values, object), idf.nrows, rt.pad_rows(max(idf.nrows, 1)), rt)
+        name, _host_to_column(np.asarray(values, object), idf.nrows, idf.pad_target(), rt)
     )
 
 
@@ -138,7 +138,7 @@ def _latlon_dev_from_input(idf: Table, lat_c: str, lon_c: str, fmt: str):
         lat_h = _dms_str_to_dd(_host_cat(idf, lat_c))
         lon_h = _dms_str_to_dd(_host_cat(idf, lon_c))
         ok = np.isfinite(lat_h) & np.isfinite(lon_h)
-        npad = rt.pad_rows(max(idf.nrows, 1))
+        npad = idf.pad_target()
         pad = np.zeros(npad - idf.nrows)
         lat_d = rt.shard_rows(np.concatenate([np.where(ok, lat_h, 0.0), pad]).astype(np.float32))
         lon_d = rt.shard_rows(np.concatenate([np.where(ok, lon_h, 0.0), pad]).astype(np.float32))

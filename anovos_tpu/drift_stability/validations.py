@@ -25,21 +25,32 @@ def check_list_of_columns(
 
     sig = inspect.signature(func)
 
+    param_names = list(sig.parameters)
+    has_varargs = any(
+        p.kind == inspect.Parameter.VAR_POSITIONAL for p in sig.parameters.values()
+    )
+
     @wraps(func)
     def validate(*args, **kwargs):
         # bind positionals to their parameter names so a positionally-passed
         # column list is validated instead of colliding with the kwarg write
-        try:
-            bound = sig.bind_partial(*args, **kwargs)
-            args, kwargs = (), dict(bound.arguments)
-            for p in sig.parameters.values():  # re-flatten a packed **kwargs
-                if p.kind == inspect.Parameter.VAR_KEYWORD and p.name in kwargs:
-                    kwargs.update(kwargs.pop(p.name))
-        except TypeError:
-            pass  # signature mismatch: let func raise its own error
+        # (*args functions can't round-trip through bind → left as-is)
+        if not has_varargs:
+            try:
+                bound = sig.bind_partial(*args, **kwargs)
+                args, kwargs = (), dict(bound.arguments)
+                for p in sig.parameters.values():  # re-flatten a packed **kwargs
+                    if p.kind == inspect.Parameter.VAR_KEYWORD and p.name in kwargs:
+                        kwargs.update(kwargs.pop(p.name))
+            except TypeError:
+                pass  # signature mismatch: let func raise its own error
         idf_target = kwargs.get(target, None)
         if idf_target is None and len(args) > target_idx:
             idf_target = args[target_idx]
+        if idf_target is None and target_idx < len(param_names):
+            # bound under its real parameter name, which may differ from
+            # the decorator's `target` label — fall back to position
+            idf_target = kwargs.get(param_names[target_idx])
         cols_raw = kwargs.get(columns, "all")
         if isinstance(cols_raw, str):
             if cols_raw == "all":

@@ -178,34 +178,23 @@ def geohash_digits(lat: jax.Array, lon: jax.Array, precision: int) -> jax.Array:
     return jnp.stack(digits, axis=1)
 
 
-@jax.jit
-def point_in_polygons(lat: jax.Array, lon: jax.Array, ex1, ey1, ex2, ey2) -> jax.Array:
-    """Even-odd ray-cast containment: (rows,) bool for E padded edges
-    (degenerate padding edges never cross).  x = lon, y = lat."""
-    py, px = lat[:, None], lon[:, None]
-    y1, y2 = ey1[None, :], ey2[None, :]
-    x1, x2 = ex1[None, :], ex2[None, :]
-    straddles = (y1 > py) != (y2 > py)
-    xi = x1 + (py - y1) * (x2 - x1) / jnp.where(y2 == y1, 1.0, y2 - y1)
-    crossings = (straddles & (px < xi)).sum(axis=1)
-    return crossings % 2 == 1
-
-
 @functools.partial(jax.jit, static_argnames=("n_poly",))
 def point_in_polygon_set(lat, lon, ex1, ey1, ex2, ey2, poly_id, n_poly: int) -> jax.Array:
-    """Union of per-polygon even-odd containment: parity is computed per
-    polygon id (rings of one polygon, incl. holes, share an id) and OR-ed,
-    so overlapping polygons don't cancel each other the way a single global
-    parity would.  The per-polygon crossing count is a (rows, E) @ (E,
-    n_poly) one-hot matmul — MXU work, one dispatch."""
+    """Union of per-polygon even-odd ray-cast containment: parity is computed
+    per polygon id (rings of one polygon, incl. holes, share an id) and
+    OR-ed, so overlapping polygons don't cancel each other the way a single
+    global parity would.  Per-polygon counts come from a segment_sum over
+    the edge axis — a dense (E, n_poly) one-hot would be gigabytes for an
+    archipelago shapefile (3e5 edges × 5e3 polygons).  x = lon, y = lat;
+    degenerate padding edges never cross."""
     py, px = lat[:, None], lon[:, None]
     y1, y2 = ey1[None, :], ey2[None, :]
     x1, x2 = ex1[None, :], ex2[None, :]
     straddles = (y1 > py) != (y2 > py)
     xi = x1 + (py - y1) * (x2 - x1) / jnp.where(y2 == y1, 1.0, y2 - y1)
-    crossing = (straddles & (px < xi)).astype(jnp.float32)
-    counts = crossing @ jax.nn.one_hot(poly_id, n_poly, dtype=jnp.float32)
-    return (counts.astype(jnp.int32) % 2 == 1).any(axis=1)
+    crossing = (straddles & (px < xi)).astype(jnp.int32)
+    counts = jax.ops.segment_sum(crossing.T, poly_id, num_segments=n_poly)  # (n_poly, rows)
+    return (counts % 2 == 1).any(axis=0)
 
 
 @functools.partial(jax.jit, static_argnames=("nseg",))

@@ -178,6 +178,16 @@ class Table:
             return 0
         return next(iter(self.columns.values())).padded_len
 
+    def pad_target(self) -> int:
+        """Padded length a NEW column of this table must have.  Always the
+        table's existing padded length when it has columns — a fresh
+        ``pad_rows(nrows)`` would diverge on multi-host tables (interleaved
+        per-process padding) and whenever the bucketing policy changed
+        between table creation and column addition."""
+        if self.columns:
+            return self.padded_rows
+        return get_runtime().pad_rows(max(self.nrows, 1))
+
     def dtypes(self) -> List[Tuple[str, str]]:
         return [(k, c.dtype_name) for k, c in self.columns.items()]
 

@@ -125,3 +125,27 @@ def test_income_against_pandas(income_df):
         np.testing.assert_allclose(out["mean"][i], s.mean(), rtol=1e-4)
         np.testing.assert_allclose(out["stddev"][i], s.std(ddof=1), rtol=1e-3)
         assert out["count"][i] == len(s)
+
+
+def test_add_column_matches_existing_padding():
+    """New columns must pad to the TABLE's padded length, not a freshly
+    computed (bucketed) one — a multi-host table carries non-bucketed
+    interleaved padding, and re-bucketing would make column stacks ragged."""
+    import numpy as np
+
+    from anovos_tpu.shared.runtime import get_runtime
+    from anovos_tpu.shared.table import Column, Table
+
+    rt = get_runtime()
+    n = 600  # 600 % 8 == 0 but 600 is not a 2^k / 1.5*2^k bucket (768 is)
+    data = rt.shard_rows(np.arange(n, dtype=np.float32))
+    mask = rt.shard_rows(np.ones(n, bool))
+    t = Table({"x": Column("num", data, mask, dtype_name="double")}, n)
+    assert t.padded_rows == n != rt.pad_rows(n)
+
+    from anovos_tpu.data_transformer.geospatial import _add_num
+
+    t2 = _add_num(t, "y", np.ones(n))
+    assert t2.padded_rows == n
+    X, M = t2.numeric_block(["x", "y"])  # raggedness would crash the stack
+    assert X.shape == (n, 2)
