@@ -190,7 +190,7 @@ def cluster_analysis(
     m0, m1, mstep = (int(float(x)) for x in str(min_samples).split(","))
     rows = []
     sub = pts
-    grid_cap = int(os.environ.get("ANOVOS_DBSCAN_GRID_SAMPLE", 8000))
+    grid_cap = int(os.environ.get("ANOVOS_DBSCAN_GRID_SAMPLE", 4096))
     if len(sub) > grid_cap:
         # the grid scan is a hyperparameter search: O(n²) propagation per
         # combo, so it runs on a subsample with min_samples SCALED by the
@@ -199,10 +199,14 @@ def cluster_analysis(
         # sklearn scan — and unscaled was both wrong and 6× slower)
         sub = sub[np.random.default_rng(2).choice(len(sub), grid_cap, replace=False)]
     frac = len(sub) / max(len(pts), 1)
+    from anovos_tpu.ops.cluster import neighbor_counts
+
     for e in np.arange(e0, e1 + 1e-9, estep):
+        # one neighbor-count pass per eps, shared by every min_samples
+        counts = neighbor_counts(sub, float(e))
         for m in range(m0, m1 + 1, mstep):
             m_eff = max(2, int(round(m * frac)))
-            labels = dbscan_fit(sub, float(e), m_eff)
+            labels = dbscan_fit(sub, float(e), m_eff, counts=counts)
             n_clusters = len(set(labels[labels >= 0]))
             score = _silhouette(sub, labels) if n_clusters >= 2 else -1.0
             rows.append(

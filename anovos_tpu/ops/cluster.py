@@ -109,6 +109,17 @@ def _neighbor_counts_tile(Xq: jax.Array, Xs: jax.Array, eps2: jax.Array) -> jax.
     return (D <= eps2).sum(axis=1)
 
 
+def neighbor_counts(X: np.ndarray, eps: float, tile: int = 4096) -> np.ndarray:
+    """Within-eps neighbor count per point (incl. self) — the count pass
+    dbscan_fit uses; public so a hyperparameter grid can compute it once per
+    eps and share it across every min_samples."""
+    Xd = jnp.asarray(X, jnp.float32)
+    eps2 = jnp.asarray(eps * eps, jnp.float32)
+    return np.concatenate(
+        [np.asarray(_neighbor_counts_tile(Xd[s : s + tile], Xd, eps2)) for s in range(0, len(X), tile)]
+    )
+
+
 @functools.partial(jax.jit, static_argnames=())
 def _nearest_core_tile(Xq: jax.Array, Xs: jax.Array, eps2: jax.Array):
     """Nearest within-eps fit-set point per query row: (index, hit)."""
@@ -119,7 +130,9 @@ def _nearest_core_tile(Xq: jax.Array, Xs: jax.Array, eps2: jax.Array):
 
 
 @functools.partial(jax.jit, static_argnames=("tile", "max_iter"))
-def _propagate_labels(Xc: jax.Array, valid: jax.Array, eps2: jax.Array, tile: int, max_iter: int):
+def _propagate_labels(
+    Xc: jax.Array, valid: jax.Array, eps2: jax.Array, tile: int, max_iter: int, lab0=None
+):
     """Min-label propagation over the within-eps core graph as ONE compiled
     program: a while_loop of tiled distance sweeps + pointer jumping, with
     the convergence check on device.  Round 1 dispatched each tile eagerly
@@ -127,9 +140,12 @@ def _propagate_labels(Xc: jax.Array, valid: jax.Array, eps2: jax.Array, tile: in
     wall time (~13 s per fit on a 20k sample; the grid scan runs 35 fits).
 
     Xc is padded to a multiple of ``tile``; padding rows have valid=False
-    and keep their own label."""
+    and keep their own label.  ``lab0`` seeds the labels (e.g. grid-cell
+    cliques merged upfront) — rounds then scale with the CELL-graph
+    diameter, not the point count along a dense cluster."""
     m = Xc.shape[0]
-    lab0 = jnp.arange(m, dtype=jnp.float32)
+    if lab0 is None:
+        lab0 = jnp.arange(m, dtype=jnp.float32)
     starts = jnp.arange(m // tile) * tile
 
     def one_round(lab):
@@ -142,7 +158,7 @@ def _propagate_labels(Xc: jax.Array, valid: jax.Array, eps2: jax.Array, tile: in
             return jnp.where(vq, jnp.minimum(lq, nbr.min(axis=1)), lq)
 
         new = jax.lax.map(tile_fn, starts).reshape(m)
-        for _ in range(3):  # pointer jumping: O(log diameter) convergence
+        for _ in range(6):  # pointer jumping: O(log diameter) convergence
             new = jnp.minimum(new, new[new.astype(jnp.int32)])
         return new
 
@@ -159,7 +175,29 @@ def _propagate_labels(Xc: jax.Array, valid: jax.Array, eps2: jax.Array, tile: in
     return lab, done
 
 
-def dbscan_fit(X: np.ndarray, eps: float, min_samples: int, tile: int = 4096, max_iter: int = 200) -> np.ndarray:
+def _cell_clique_seed(Xc_host: np.ndarray, eps: float) -> np.ndarray:
+    """Initial labels from an (eps/√2)-cell grid: points sharing a cell are
+    within eps of each other (cell diagonal = eps), hence one clique — merge
+    them upfront so propagation rounds scale with the cell-graph diameter
+    instead of the point count along a dense cluster."""
+    m = len(Xc_host)
+    if not eps > 0:  # eps=0: no merging is valid (only exact duplicates connect)
+        return np.arange(m, dtype=np.float32)
+    cell = np.floor(Xc_host / (eps / np.sqrt(Xc_host.shape[1]))).astype(np.int64)
+    _, inv = np.unique(cell, axis=0, return_inverse=True)
+    seed = np.full(inv.max() + 1, m, np.int64)
+    np.minimum.at(seed, inv, np.arange(m))
+    return seed[inv].astype(np.float32)
+
+
+def dbscan_fit(
+    X: np.ndarray,
+    eps: float,
+    min_samples: int,
+    tile: int = 4096,
+    max_iter: int = 200,
+    counts: "np.ndarray | None" = None,
+) -> np.ndarray:
     """DBSCAN labels (−1 = noise).
 
     Core-component discovery is min-label propagation over the within-eps
@@ -167,14 +205,14 @@ def dbscan_fit(X: np.ndarray, eps: float, min_samples: int, tile: int = 4096, ma
     converging in O(log diameter) rounds (no per-pair host loops, no
     materialized edge list — a dense cluster's clique would otherwise cost
     O(E) memory).  Border points adopt their NEAREST within-eps core
-    neighbor's cluster.
+    neighbor's cluster.  ``counts`` lets a hyperparameter grid reuse one
+    neighbor-count pass for every min_samples at the same eps.
     """
     n = len(X)
     Xd = jnp.asarray(X, jnp.float32)
     eps2 = jnp.asarray(eps * eps, jnp.float32)
-    counts = np.concatenate(
-        [np.asarray(_neighbor_counts_tile(Xd[s : s + tile], Xd, eps2)) for s in range(0, n, tile)]
-    )
+    if counts is None:
+        counts = neighbor_counts(X, eps, tile)
     core = counts >= min_samples
     labels = np.full(n, -1, np.int64)
     core_idx = np.nonzero(core)[0]
@@ -187,7 +225,9 @@ def dbscan_fit(X: np.ndarray, eps: float, min_samples: int, tile: int = 4096, ma
     # test) but must not overflow f32 squares into NaN-producing inf-inf
     Xc = jnp.full((m_pad, X.shape[1]), 1e9, jnp.float32).at[:m].set(Xd[core_idx])
     vmask = jnp.arange(m_pad) < m
-    lab_d, done = _propagate_labels(Xc, vmask, eps2, t, max_iter)
+    seed = _cell_clique_seed(np.asarray(X, np.float32)[core_idx], eps)
+    lab0 = jnp.concatenate([jnp.asarray(seed), jnp.arange(m, m_pad, dtype=jnp.float32)])
+    lab_d, done = _propagate_labels(Xc, vmask, eps2, t, max_iter, lab0)
     lab = np.asarray(lab_d)[:m]
     if not bool(done):
         import warnings

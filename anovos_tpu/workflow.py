@@ -189,25 +189,31 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                 start = timeit.default_timer()
                 # omit None-valued config keys so callee defaults apply
                 opt = {k: v for k, v in args.items() if v is not None}
-                if opt.get("auto_detection", False):
-                    df = ts_preprocess(
-                        df, opt.get("id_col"), output_path=report_input_path or ".",
-                        tz_offset=opt.get("tz_offset", "local"), run_type=run_type,
-                    )
-                if opt.get("inspection", False):
-                    from anovos_tpu.data_analyzer.ts_analyzer import ts_analyzer
+                # auto-detection is best-effort in the reference too
+                # (ts_auto_detection.py:707 swallows per-column failures):
+                # a malformed timestamp column must not kill the pipeline
+                try:
+                    if opt.get("auto_detection", False):
+                        df = ts_preprocess(
+                            df, opt.get("id_col"), output_path=report_input_path or ".",
+                            tz_offset=opt.get("tz_offset", "local"), run_type=run_type,
+                        )
+                    if opt.get("inspection", False):
+                        from anovos_tpu.data_analyzer.ts_analyzer import ts_analyzer
 
-                    kw = {
-                        k: opt[k]
-                        for k in ("max_days", "tz_offset")
-                        if k in opt
-                    }
-                    if "analysis_level" in opt:
-                        kw["output_type"] = opt["analysis_level"]
-                    ts_analyzer(
-                        df, opt.get("id_col"), output_path=report_input_path or ".",
-                        run_type=run_type, **kw,
-                    )
+                        kw = {
+                            k: opt[k]
+                            for k in ("max_days", "tz_offset")
+                            if k in opt
+                        }
+                        if "analysis_level" in opt:
+                            kw["output_type"] = opt["analysis_level"]
+                        ts_analyzer(
+                            df, opt.get("id_col"), output_path=report_input_path or ".",
+                            run_type=run_type, **kw,
+                        )
+                except Exception:
+                    logger.exception("timeseries_analyzer failed; continuing without ts analysis")
                 logger.info(f"{key}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}")
                 continue
 
@@ -225,9 +231,12 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                         )
                         if ga.get(k) is not None
                     }
-                    geospatial_autodetection(
-                        df, ga.get("id_col"), report_input_path or ".", run_type=run_type, **kw
-                    )
+                    try:
+                        geospatial_autodetection(
+                            df, ga.get("id_col"), report_input_path or ".", run_type=run_type, **kw
+                        )
+                    except Exception:
+                        logger.exception("geospatial_analyzer failed; continuing without geo analysis")
                     logger.info(
                         f"{key}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
                     )

@@ -113,3 +113,39 @@ def test_workflow_end_to_end(tmp_path, monkeypatch):
     # report + final dataset
     assert (rs / "ml_anovos_report.html").exists()
     assert (tmp_path / "output" / "final_dataset" / "_SUCCESS").exists()
+
+
+def test_ts_geo_failures_do_not_kill_pipeline(tmp_path, monkeypatch):
+    """Reference resilience semantics: ts/geo auto-detection is best-effort
+    (ts_auto_detection.py:707 swallows) — a crash there must not abort the
+    run or the downstream stats."""
+    import anovos_tpu.workflow as wf
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic ts failure")
+
+    monkeypatch.setattr(wf, "ts_preprocess", boom)
+    monkeypatch.setattr(
+        "anovos_tpu.data_analyzer.geospatial_analyzer.geospatial_autodetection", boom
+    )
+    cfg = {
+        "input_dataset": {
+            "read_dataset": {
+                "file_path": "/root/reference/examples/data/income_dataset/parquet",
+                "file_type": "parquet",
+            },
+            "delete_column": ["logfnl", "empty", "dt_2"],
+        },
+        "timeseries_analyzer": {"auto_detection": True, "id_col": "ifa"},
+        "geospatial_controller": {
+            "geospatial_analyzer": {"auto_detection_analyzer": True, "id_col": "ifa"}
+        },
+        "stats_generator": {
+            "metric": ["global_summary"],
+            "metric_args": {"list_of_cols": "all", "drop_cols": ["ifa"]},
+        },
+        "report_preprocessing": {"master_path": str(tmp_path)},
+    }
+    monkeypatch.chdir(tmp_path)
+    wf.main(cfg, "local")
+    assert (tmp_path / "global_summary.csv").exists()
