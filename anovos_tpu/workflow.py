@@ -191,13 +191,17 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                 opt = {k: v for k, v in args.items() if v is not None}
                 # auto-detection is best-effort in the reference too
                 # (ts_auto_detection.py:707 swallows per-column failures):
-                # a malformed timestamp column must not kill the pipeline
+                # a malformed timestamp column must not kill the pipeline,
+                # and a detection failure must not also cost the inspection
                 try:
                     if opt.get("auto_detection", False):
                         df = ts_preprocess(
                             df, opt.get("id_col"), output_path=report_input_path or ".",
                             tz_offset=opt.get("tz_offset", "local"), run_type=run_type,
                         )
+                except Exception:
+                    logger.exception("ts auto-detection failed; continuing with the raw table")
+                try:
                     if opt.get("inspection", False):
                         from anovos_tpu.data_analyzer.ts_analyzer import ts_analyzer
 
@@ -213,7 +217,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                             run_type=run_type, **kw,
                         )
                 except Exception:
-                    logger.exception("timeseries_analyzer failed; continuing without ts analysis")
+                    logger.exception("ts inspection failed; continuing without ts analysis")
                 logger.info(f"{key}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}")
                 continue
 

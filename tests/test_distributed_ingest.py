@@ -114,3 +114,91 @@ def test_two_process_stats_parity(tmp_path):
     exp_counts = exp["cat"].value_counts()
     for v, cnt in zip(vocab, got["cat_counts"]["cat"]):
         assert int(cnt) == int(exp_counts.get(v, 0)), v
+
+
+_DRIFT_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    pid = int(sys.argv[1]); port = sys.argv[2]; src_dir = sys.argv[3]; tgt_dir = sys.argv[4]; out = sys.argv[5]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    sys.path.insert(0, "/root/repo")
+    from anovos_tpu.shared.runtime import init_runtime
+    init_runtime()
+
+    from anovos_tpu.data_ingest.distributed_ingest import read_dataset_distributed
+    src = read_dataset_distributed(src_dir, "parquet")
+    tgt = read_dataset_distributed(tgt_dir, "parquet")
+
+    from anovos_tpu.drift_stability.drift_detector import statistics
+    res = statistics(
+        tgt, src, method_type="PSI|JSD", use_sampling=False,
+        source_path=out + f"_model_p{pid}",
+    )
+    if pid == 0:
+        res.to_json(out, orient="records")
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_drift_parity(tmp_path):
+    """The full drift pipeline (cutoff fit on device, fused per-side
+    histograms, vocab-union categoricals) over two 2-process distributed
+    tables must match the single-process computation to 1e-3 in PSI (f32
+    reduction order differs across process shardings, so not bit-exact)."""
+    rng = np.random.default_rng(7)
+    n = 3000
+    src_df = pd.DataFrame(
+        {
+            "x": rng.normal(0, 1, n),
+            "y": rng.exponential(2, n),
+            "cat": rng.choice(["a", "b", "c"], n, p=[0.5, 0.3, 0.2]),
+        }
+    )
+    tgt_df = pd.DataFrame(
+        {
+            "x": rng.normal(0.4, 1.2, n),  # drifted
+            "y": rng.exponential(2, n),
+            "cat": rng.choice(["a", "b", "c"], n, p=[0.2, 0.3, 0.5]),
+        }
+    )
+    src_dir, tgt_dir = tmp_path / "src", tmp_path / "tgt"
+    for d, df in ((src_dir, src_df), (tgt_dir, tgt_df)):
+        d.mkdir()
+        df.iloc[: n // 2].to_parquet(d / "part-00000.parquet", index=False)
+        df.iloc[n // 2 :].to_parquet(d / "part-00001.parquet", index=False)
+
+    worker = tmp_path / "drift_worker.py"
+    worker.write_text(_DRIFT_WORKER)
+    out = tmp_path / "drift.json"
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "29519", str(src_dir), str(tgt_dir), str(out)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    logs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"drift worker failed:\n{log[-3000:]}"
+    got = pd.read_json(out).set_index("attribute")
+
+    # single-process oracle over the identical data
+    from anovos_tpu.drift_stability.drift_detector import statistics
+    from anovos_tpu.shared.table import Table
+
+    exp = statistics(
+        Table.from_pandas(tgt_df), Table.from_pandas(src_df),
+        method_type="PSI|JSD", use_sampling=False, source_path=str(tmp_path / "solo_model"),
+    ).set_index("attribute")
+    for c in ("x", "y", "cat"):
+        assert abs(float(got.loc[c, "PSI"]) - float(exp.loc[c, "PSI"])) < 1e-3, c
+        assert int(got.loc[c, "flagged"]) == int(exp.loc[c, "flagged"]), c
+    assert int(exp.loc["x", "flagged"]) == 1  # the drift is real
