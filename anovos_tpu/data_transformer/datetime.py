@@ -41,11 +41,11 @@ def _cols(list_of_cols) -> List[str]:
     return list(list_of_cols)
 
 
-def argument_checker(fn_name: str, args: dict) -> None:
+def argument_checker(func_name: str, args: dict) -> None:
     """Shared validation (reference :39-124)."""
     oc = args.get("output_mode")
     if oc is not None and oc not in ("replace", "append"):
-        raise TypeError(f"{fn_name}: Invalid input for output_mode")
+        raise TypeError(f"{func_name}: Invalid input for output_mode")
 
 
 def _ts_col(idf: Table, col: str) -> Column:
@@ -399,13 +399,30 @@ def _shift_program(secs, delta):
 
 
 def timestamp_comparison(
-    idf: Table, list_of_cols, comparison_type: str = "greater_than", comparison_value: str = "1970-01-01 00:00:00", output_mode: str = "append"
+    idf: Table,
+    list_of_cols,
+    comparison_type: str = "greater_than",
+    comparison_value: str = "1970-01-01 00:00:00",
+    comparison_format: str = "%Y-%m-%d %H:%M:%S",
+    output_mode: str = "append",
 ) -> Table:
-    """(:829) boolean flag vs a fixed timestamp."""
+    """(:829) boolean flag vs a fixed timestamp parsed with
+    ``comparison_format`` (reference :835)."""
     argument_checker("timestamp_comparison", {"output_mode": output_mode})
     if comparison_type not in ("greater_than", "less_than", "greaterThan_equalTo", "lessThan_equalTo"):
         raise TypeError("Invalid input for comparison_type")
-    ref = jnp.int32(int(pd.Timestamp(comparison_value).timestamp()))
+    # pd naive-as-UTC matches the module's epoch convention (strptime would
+    # apply the host timezone).  An EXPLICIT format is strict like the
+    # reference (a silent auto-parse fallback would undo the day-first/
+    # month-first disambiguation the parameter exists for); only the
+    # default format is lenient, accepting e.g. bare dates
+    try:
+        cmp_ts = pd.to_datetime(str(comparison_value), format=comparison_format)
+    except ValueError:
+        if comparison_format != "%Y-%m-%d %H:%M:%S":
+            raise
+        cmp_ts = pd.to_datetime(str(comparison_value))
+    ref = jnp.int32(int(cmp_ts.timestamp()))
     odf = idf
     for c in _cols(list_of_cols):
         col = _ts_col(idf, c)
@@ -711,14 +728,31 @@ def _aggregator_host(idf: Table, cols, aggs, time_col, granularity_format) -> pd
 
 
 def window_aggregator(
-    idf: Table, list_of_cols, list_of_aggs, order_col: str, window_type: str = "expanding", window_size: int = 3, **_ignored
+    idf: Table,
+    list_of_cols,
+    list_of_aggs,
+    order_col: str,
+    window_type: str = "expanding",
+    window_size: int = 3,
+    partition_col: str = "",
+    output_mode: str = "append",
+    **_ignored,
 ) -> Table:
     """(:1824) expanding / rolling window aggregates ordered by a ts col —
     device cumsum / reduce-window kernels (pandas min_periods semantics:
-    rolling needs a full window of valid values, expanding needs one)."""
+    rolling needs a full window of valid values, expanding needs one).
+    ``partition_col`` restarts every window at its group boundary
+    (reference :1899-1905 Window.partitionBy)."""
+    argument_checker("window_aggregator", {"output_mode": output_mode})
     ocol = _ts_col(idf, order_col)
     aggs = _cols(list_of_aggs)
     w = int(window_size)
+    pcode = None
+    if partition_col:
+        pc = idf.columns[partition_col]
+        if pc.kind != "cat":
+            raise TypeError("partition_col must be a categorical column")
+        pcode = pc.data
     odf = idf
     for c in _cols(list_of_cols):
         col = idf.columns[c]
@@ -727,7 +761,7 @@ def window_aggregator(
                 raise TypeError(f"Invalid aggregate function {a}")
             if a == "median" and window_type == "expanding":
                 # expanding median has no O(n) device form; host fallback
-                vals_h, ok_h = _expanding_median_host(idf, c, order_col)
+                vals_h, ok_h = _expanding_median_host(idf, c, order_col, partition_col)
                 rt = get_runtime()
                 v = vals_h.astype(np.float64)
                 v[~ok_h] = np.nan
@@ -736,56 +770,105 @@ def window_aggregator(
                 continue
             vals, ok = _window_program(
                 ocol.data, ocol.mask, col.data.astype(jnp.float32), col.mask,
-                idf.row_mask(), a, window_type, w,
+                idf.row_mask(), a, window_type, w, pcode,
             )
             odf = _emit_num(odf, f"{c}_{a}_{window_type}", vals, ok, "append", "")
+        if output_mode == "replace":
+            odf = odf.drop([c])
     return odf
 
 
-def _expanding_median_host(idf: Table, c: str, order_col: str):
+def _expanding_median_host(idf: Table, c: str, order_col: str, partition_col: str = ""):
     s = _ts_series(idf, order_col)
-    order = np.argsort(s.to_numpy(), kind="stable")
     col = idf.columns[c]
     vals = np.asarray(jax.device_get(col.data))[: idf.nrows].astype(float)
     vals[~np.asarray(jax.device_get(col.mask))[: idf.nrows]] = np.nan
-    res = pd.Series(vals[order]).expanding().median().to_numpy()
     back = np.empty(idf.nrows)
-    back[order] = res
+    if partition_col:
+        pc = idf.columns[partition_col]
+        codes = np.asarray(jax.device_get(pc.data))[: idf.nrows]
+        order = np.lexsort((s.to_numpy(), codes))
+        ser = pd.Series(vals[order])
+        res = ser.groupby(codes[order]).expanding().median().to_numpy()
+        back[order] = res
+    else:
+        order = np.argsort(s.to_numpy(), kind="stable")
+        res = pd.Series(vals[order]).expanding().median().to_numpy()
+        back[order] = res
     return back, ~np.isnan(back)
 
 
+def _segmented_cummin(x, newseg):
+    """Running min that restarts where ``newseg`` is True — an associative
+    scan over (boundary, min) pairs."""
+
+    def combine(a, b):
+        fa, ma = a
+        fb, mb = b
+        return fa | fb, jnp.where(fb, mb, jnp.minimum(ma, mb))
+
+    _, out = jax.lax.associative_scan(combine, (newseg, x))
+    return out
+
+
 @_functools.partial(jax.jit, static_argnames=("agg", "window_type", "w"))
-def _window_program(osecs, omask, v, mv, row_valid, agg, window_type, w):
+def _window_program(osecs, omask, v, mv, row_valid, agg, window_type, w, pcode=None):
+    """``pcode`` (int32 partition codes) makes every window restart at its
+    partition boundary: rows lex-sort by (partition, ts) and cumulatives
+    subtract their value at the segment start (reference :1899-1905
+    Window.partitionBy)."""
     rows = v.shape[0]
     key = jnp.where(omask, osecs, _I32_BIG)
     order = jnp.argsort(key, stable=True)
+    if pcode is not None:  # stable two-pass lexsort: ts first, partition second
+        order = order[jnp.argsort(pcode[order], stable=True)]
+        po = pcode[order]
+        newseg = jnp.concatenate([jnp.ones(1, bool), po[1:] != po[:-1]])
+    else:
+        po = None
+        newseg = jnp.zeros(rows, bool).at[0].set(True)
+    # index of each row's segment start (cummax propagates the last boundary)
+    seg_start = jax.lax.cummax(jnp.where(newseg, jnp.arange(rows), 0))
     vo = v[order]
     mo = mv[order]
     vz = jnp.where(mo, vo, 0.0)
     cnt = jnp.cumsum(mo.astype(jnp.float32))
     cs = jnp.cumsum(vz)
     cq = jnp.cumsum(vz * vz)
+    # cumulatives at the element just before the segment start (0 for row 0)
+    def base(c):
+        prev = jnp.concatenate([jnp.zeros(1, c.dtype), c])[seg_start]
+        return prev
+
+    cnt0, cs0, cq0 = base(cnt), base(cs), base(cq)
+    # positions since segment start, for rolling windows that must not
+    # reach into the previous partition
+    idx = jnp.arange(rows)
+    in_seg = idx - seg_start + 1  # rows available within the segment
     if window_type == "expanding":
-        n = cnt
-        s = cs
-        q = cq
+        n = cnt - cnt0
+        s = cs - cs0
+        q = cq - cq0
         ok = n >= 1
         if agg == "min":
-            res = jax.lax.cummin(jnp.where(mo, vo, jnp.inf))
+            res = _segmented_cummin(jnp.where(mo, vo, jnp.inf), newseg)
         elif agg == "max":
-            res = jax.lax.cummax(jnp.where(mo, vo, -jnp.inf))
+            res = -_segmented_cummin(jnp.where(mo, -vo, jnp.inf), newseg)
     else:  # rolling, min_periods = w
         pad = jnp.zeros(w, jnp.float32)
-        n = cnt - jnp.concatenate([pad, cnt])[:rows]
-        s = cs - jnp.concatenate([pad, cs])[:rows]
-        q = cq - jnp.concatenate([pad, cq])[:rows]
-        ok = n >= w
+        shifted = lambda c: jnp.concatenate([pad.astype(c.dtype), c])[:rows]
+        # window start = max(i - w + 1, segment start): clamp the subtracted
+        # cumulative to the segment base
+        n = jnp.minimum(cnt - shifted(cnt), cnt - cnt0)
+        s = jnp.where(in_seg >= w, cs - shifted(cs), cs - cs0)
+        q = jnp.where(in_seg >= w, cq - shifted(cq), cq - cq0)
+        ok = (n >= w) & (in_seg >= w)
         if agg in ("min", "max", "median"):
             # windowed gather: (rows, w) value matrix per position
             pos = jnp.arange(rows)[:, None] - (w - 1) + jnp.arange(w)[None, :]
             safe = jnp.clip(pos, 0, rows - 1)
             Wv = jnp.where(pos >= 0, vo[safe], jnp.nan)
-            Wm = (pos >= 0) & mo[safe]
+            Wm = (pos >= 0) & mo[safe] & (pos >= seg_start[:, None])
             if agg == "min":
                 res = jnp.where(Wm, Wv, jnp.inf).min(axis=1)
             elif agg == "max":
@@ -798,7 +881,7 @@ def _window_program(osecs, omask, v, mv, row_valid, agg, window_type, w):
         # pandas count gates on window ROW coverage, not valid-value count:
         # NaN only while the window extends past the start of the series
         if window_type == "rolling":
-            ok = jnp.arange(rows) + 1 >= w
+            ok = in_seg >= w
         else:
             ok = jnp.ones_like(ok)
     elif agg == "sum":
@@ -821,18 +904,34 @@ def _window_program(osecs, omask, v, mv, row_valid, agg, window_type, w):
 
 
 def lagged_ts(
-    idf: Table, list_of_cols, lag: int = 1, output_type: str = "ts", tsdiff_unit: str = "days", order_col: str = "", **_ignored
+    idf: Table,
+    list_of_cols,
+    lag: int = 1,
+    output_type: str = "ts",
+    tsdiff_unit: str = "days",
+    order_col: str = "",
+    partition_col: str = "",
+    output_mode: str = "append",
+    **_ignored,
 ) -> Table:
     """(:1933) lag a ts column (ordered by itself or order_col) and
     optionally emit the lag difference — argsort + shift + inverse scatter,
-    one device program per column."""
+    one device program per column.  ``partition_col`` lags within each group
+    only (reference :1939 Window.partitionBy)."""
+    argument_checker("lagged_ts", {"output_mode": output_mode})
     odf = idf
     lag = int(lag)
+    pcode = None
+    if partition_col:
+        pc = idf.columns[partition_col]
+        if pc.kind != "cat":
+            raise TypeError("partition_col must be a categorical column")
+        pcode = pc.data
     for c in _cols(list_of_cols):
         col = _ts_col(idf, c)
         kcol = _ts_col(idf, order_col) if order_col else col
         lag_secs, lag_ok = _lag_program(
-            col.data, col.mask, kcol.data, kcol.mask, idf.row_mask(), lag
+            col.data, col.mask, kcol.data, kcol.mask, idf.row_mask(), lag, pcode
         )
         name = f"{c}_lag{lag}"
         if output_type == "ts":
@@ -841,18 +940,26 @@ def lagged_ts(
             div = float(_div_for(tsdiff_unit))
             diff, ok = _lag_diff_program(col.data, col.mask, lag_secs, lag_ok, div)
             odf = _emit_num(odf, name + "_diff", diff, ok, "append", "")
+        if output_mode == "replace":
+            odf = odf.drop([c])
     return odf
 
 
 @_functools.partial(jax.jit, static_argnames=("lag",))
-def _lag_program(secs, mask, ksecs, kmask, row_valid, lag):
+def _lag_program(secs, mask, ksecs, kmask, row_valid, lag, pcode=None):
     rows = secs.shape[0]
     key = jnp.where(kmask, ksecs, _I32_BIG)
     order = jnp.argsort(key, stable=True)
+    if pcode is not None:  # lexsort (partition, ts); lags stay in-partition
+        order = order[jnp.argsort(pcode[order], stable=True)]
     so = secs[order]
     mo = mask[order]
     shift_s = jnp.concatenate([jnp.zeros(lag, so.dtype), so])[:rows]
     shift_m = jnp.concatenate([jnp.zeros(lag, bool), mo])[:rows]
+    if pcode is not None:
+        po = pcode[order]
+        shift_p = jnp.concatenate([jnp.full(lag, -1, po.dtype), po])[:rows]
+        shift_m = shift_m & (shift_p == po)
     inv = jnp.zeros(rows, jnp.int32).at[order].set(jnp.arange(rows, dtype=jnp.int32))
     # padding rows sort last and would inherit the tail's mask — re-mask them
     return shift_s[inv], shift_m[inv] & row_valid

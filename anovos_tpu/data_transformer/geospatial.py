@@ -161,21 +161,40 @@ def geo_format_latlon(
     idf: Table,
     list_of_lat: Union[str, List[str]],
     list_of_lon: Union[str, List[str]],
+    input_format: Optional[str] = None,
+    output_format: Optional[str] = None,
+    result_prefix="",
+    optional_configs: Optional[dict] = None,
+    output_mode: str = "append",
     loc_input_format: str = "dd",
     loc_output_format: str = "dms",
-    result_prefix: str = "",
-    output_mode: str = "append",
 ) -> Table:
     """Convert lat/lon pairs between dd / dms / radian / cartesian / geohash
-    (reference :39-188)."""
+    (reference :39-188).  ``input_format``/``output_format``/``optional_configs``
+    are the reference's names; ``loc_input_format``/``loc_output_format``
+    remain as aliases."""
+    if isinstance(optional_configs, str):
+        # legacy positional call: output_mode used to sit in this slot
+        optional_configs, output_mode = None, optional_configs
+    loc_input_format = input_format or loc_input_format
+    loc_output_format = output_format or loc_output_format
+    from anovos_tpu.data_transformer.datetime import argument_checker
+
+    argument_checker("geo_format_latlon", {"output_mode": output_mode})
+    gh_precision = int((optional_configs or {}).get("geohash_precision", 9))
     if isinstance(list_of_lat, str):
         list_of_lat = [x.strip() for x in list_of_lat.split("|")]
     if isinstance(list_of_lon, str):
         list_of_lon = [x.strip() for x in list_of_lon.split("|")]
+    if isinstance(result_prefix, (list, tuple)):  # reference passes a list
+        result_prefix = "|".join(str(p) for p in result_prefix)
     odf = idf
-    for lat_c, lon_c in zip(list_of_lat, list_of_lon):
+    for i, (lat_c, lon_c) in enumerate(zip(list_of_lat, list_of_lon)):
         lat, lon, mask = _latlon_dev_from_input(idf, lat_c, lon_c, loc_input_format)
-        pre = (result_prefix + "_") if result_prefix else ""
+        # keep EMPTY entries: ["", "p2"] means pair 0 is unprefixed
+        prefixes = str(result_prefix).split("|") if result_prefix else []
+        pre = prefixes[i] if i < len(prefixes) else (prefixes[-1] if prefixes else "")
+        pre = pre + "_" if pre else ""
         if loc_output_format == "dd":
             odf = _add_dev(odf, f"{pre}{lat_c}_dd", lat, mask)
             odf = _add_dev(odf, f"{pre}{lon_c}_dd", lon, mask)
@@ -196,7 +215,7 @@ def geo_format_latlon(
             odf = _add_dev(odf, f"{pre}{lat_c}_{lon_c}_y", y, mask)
             odf = _add_dev(odf, f"{pre}{lat_c}_{lon_c}_z", z, mask)
         elif loc_output_format == "geohash":
-            odf = _geohash_column(odf, lat, lon, mask, f"{pre}{lat_c}_{lon_c}_geohash")
+            odf = _geohash_column(odf, lat, lon, mask, f"{pre}{lat_c}_{lon_c}_geohash", gh_precision)
         else:
             raise ValueError(f"unsupported loc_output_format {loc_output_format}")
         if output_mode == "replace":
@@ -205,9 +224,22 @@ def geo_format_latlon(
 
 
 def geo_format_cartesian(
-    idf: Table, list_of_x, list_of_y, list_of_z, loc_output_format: str = "dd", result_prefix: str = "", **_ignored
+    idf: Table,
+    list_of_x,
+    list_of_y,
+    list_of_z,
+    output_format: Optional[str] = None,
+    result_prefix: str = "",
+    loc_output_format: str = "dd",
+    output_mode: str = "append",
+    **_ignored,
 ) -> Table:
-    """Cartesian → dd/radian/geohash (reference :190-331), device trig."""
+    """Cartesian → dd/radian/geohash (reference :190-331), device trig.
+    ``output_format`` is the reference's name for ``loc_output_format``."""
+    from anovos_tpu.data_transformer.datetime import argument_checker
+
+    argument_checker("geo_format_cartesian", {"output_mode": output_mode})
+    loc_output_format = output_format or loc_output_format
     if isinstance(list_of_x, str):
         list_of_x = [v.strip() for v in list_of_x.split("|")]
     if isinstance(list_of_y, str):
@@ -232,15 +264,28 @@ def geo_format_cartesian(
             odf = _geohash_column(odf, lat, lon, mask, f"{pre}{xc}_{yc}_{zc}_geohash")
         else:
             raise ValueError(f"unsupported loc_output_format {loc_output_format}")
+        if output_mode == "replace":
+            odf = odf.drop([xc, yc, zc])
     return odf
 
 
 def geo_format_geohash(
-    idf: Table, list_of_geohash, loc_output_format: str = "dd", result_prefix: str = "", **_ignored
+    idf: Table,
+    list_of_geohash,
+    output_format: Optional[str] = None,
+    result_prefix: str = "",
+    loc_output_format: str = "dd",
+    output_mode: str = "append",
+    **_ignored,
 ) -> Table:
     """Geohash → lat/lon: decode once per DISTINCT hash on host (dictionary
     discipline), then a device gather maps codes → coordinates
-    (reference :333-458)."""
+    (reference :333-458).  ``output_format`` is the reference's name for
+    ``loc_output_format``."""
+    from anovos_tpu.data_transformer.datetime import argument_checker
+
+    argument_checker("geo_format_geohash", {"output_mode": output_mode})
+    loc_output_format = output_format or loc_output_format
     if isinstance(list_of_geohash, str):
         list_of_geohash = [v.strip() for v in list_of_geohash.split("|")]
     odf = idf
@@ -260,6 +305,8 @@ def geo_format_geohash(
             lat_d, lon_d = _deg2rad(lat_d), _deg2rad(lon_d)
         odf = _add_dev(odf, f"{pre}{c}_latitude", lat_d, mask)
         odf = _add_dev(odf, f"{pre}{c}_longitude", lon_d, mask)
+        if output_mode == "replace":
+            odf = odf.drop([c])
     return odf
 
 
@@ -273,15 +320,30 @@ def _gather_decoded(codes, mask, lat_v, lon_v, ok_v):
 
 def location_distance(
     idf: Table,
-    list_of_lat,
-    list_of_lon,
+    list_of_lat=None,
+    list_of_lon=None,
     distance_type: str = "haversine",
     unit: str = "m",
     result_prefix: str = "",
+    list_of_cols_loc1=None,
+    list_of_cols_loc2=None,
+    loc_format: str = "dd",
     **_ignored,
 ) -> Table:
-    """Pairwise distance between two lat/lon column pairs — one device
-    program (reference :460-651)."""
+    """Pairwise distance between two locations — one device program
+    (reference :460-651).  Two calling conventions: the reference's
+    ``list_of_cols_loc1=["lat1","lon1"], list_of_cols_loc2=["lat2","lon2"]``
+    with a ``loc_format`` (dd/radian — radians convert on device), or this
+    framework's ``list_of_lat=["lat1","lat2"], list_of_lon=["lon1","lon2"]``."""
+    if (list_of_cols_loc1 is None) != (list_of_cols_loc2 is None):
+        raise TypeError("list_of_cols_loc1 and list_of_cols_loc2 must be given together")
+    if list_of_cols_loc1 is not None and list_of_cols_loc2 is not None:
+        if isinstance(list_of_cols_loc1, str):
+            list_of_cols_loc1 = [v.strip() for v in list_of_cols_loc1.split("|")]
+        if isinstance(list_of_cols_loc2, str):
+            list_of_cols_loc2 = [v.strip() for v in list_of_cols_loc2.split("|")]
+        list_of_lat = [list_of_cols_loc1[0], list_of_cols_loc2[0]]
+        list_of_lon = [list_of_cols_loc1[1], list_of_cols_loc2[1]]
     if isinstance(list_of_lat, str):
         list_of_lat = [v.strip() for v in list_of_lat.split("|")]
     if isinstance(list_of_lon, str):
@@ -292,6 +354,10 @@ def location_distance(
     lat2, m2 = _dev_num(idf, list_of_lat[1])
     lon1, m3 = _dev_num(idf, list_of_lon[0])
     lon2, m4 = _dev_num(idf, list_of_lon[1])
+    if loc_format == "radian":
+        lat1, lat2, lon1, lon2 = map(_rad2deg, (lat1, lat2, lon1, lon2))
+    elif loc_format != "dd":
+        raise ValueError(f"unsupported loc_format {loc_format} (dd/radian)")
     fn = {"haversine": gk.haversine, "vincenty": gk.vincenty, "euclidean": gk.equirectangular}.get(
         distance_type
     )
@@ -305,15 +371,25 @@ def location_distance(
 
 
 def geohash_precision_control(
-    idf: Table, list_of_geohash, km_max_error: float = 10.0, output_mode: str = "replace", **_ignored
+    idf: Table,
+    list_of_geohash,
+    output_precision: Optional[int] = None,
+    km_max_error: Optional[float] = None,
+    output_mode: str = "replace",
+    **_ignored,
 ) -> Table:
-    """Truncate geohashes to the precision bounding the error radius —
-    pure VOCAB operation: distinct strings truncate on host, codes remap on
-    device via a small LUT (reference :653-812)."""
+    """Truncate geohashes to a target precision — pure VOCAB operation:
+    distinct strings truncate on host, codes remap on device via a small LUT
+    (reference :653-812).  ``output_precision`` is the reference's primary
+    parameter (default 8); ``km_max_error`` derives the precision from an
+    error-radius bound instead when given."""
     if isinstance(list_of_geohash, str):
         list_of_geohash = [v.strip() for v in list_of_geohash.split("|")]
     err_km = [2500, 630, 78, 20, 2.4, 0.61, 0.076, 0.019, 0.0024, 0.0006, 0.000074]
-    precision = next((i + 1 for i, e in enumerate(err_km) if e <= km_max_error), len(err_km))
+    if km_max_error is not None:
+        precision = next((i + 1 for i, e in enumerate(err_km) if e <= km_max_error), len(err_km))
+    else:
+        precision = int(output_precision if output_precision is not None else 8)
     odf = idf
     for c in list_of_geohash:
         col = idf.columns[c]

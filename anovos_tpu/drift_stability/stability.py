@@ -47,6 +47,10 @@ def stability_index_computation(
 ) -> pd.DataFrame:
     """[attribute, type, mean_stddev, mean_cv, stddev_cv, kurtosis_cv,
     mean_si, stddev_si, kurtosis_si, stability_index, flagged]."""
+    # the reference takes ONE ``idfs`` list argument (stability.py:17);
+    # accept that calling convention alongside varargs
+    if len(idfs) == 1 and isinstance(idfs[0], (list, tuple)):
+        idfs = tuple(idfs[0])
     check_metric_weightages(metric_weightages)
     check_threshold(threshold)
     if isinstance(binary_cols, str):

@@ -79,3 +79,84 @@ def test_window_and_lag():
     lg = dtm.lagged_ts(t, ["ts"], lag=1, output_type="ts_diff", tsdiff_unit="days").to_pandas()
     np.testing.assert_allclose(lg["ts_lag1_diff"].iloc[1:].to_numpy(), 1.0)
     assert np.isnan(lg["ts_lag1_diff"].iloc[0])
+
+
+def test_partitioned_windows_and_lags_match_pandas():
+    """partition_col restarts windows/lags at group boundaries
+    (reference Window.partitionBy, datetime.py:1899/:1939)."""
+    g = np.random.default_rng(3)
+    n = 400
+    base = pd.Timestamp("2023-01-01")
+    df = pd.DataFrame(
+        {
+            "ts": base + pd.to_timedelta(g.permutation(n) * 3600, unit="s"),
+            "val": g.normal(10, 2, n),
+            "grp": g.choice(["a", "b", "c"], n),
+        }
+    )
+    df.loc[g.choice(n, 25, replace=False), "val"] = np.nan
+    t = Table.from_pandas(df)
+    from anovos_tpu.data_transformer import datetime as dtm
+
+    out = dtm.window_aggregator(t, ["val"], ["mean", "min"], "ts", window_type="expanding", partition_col="grp")
+    out = dtm.window_aggregator(out, ["val"], ["sum", "max"], "ts", window_type="rolling", window_size=4, partition_col="grp")
+    got = out.to_pandas()
+    sdf = df.sort_values(["grp", "ts"], kind="stable")
+    oracle = {
+        "val_mean_expanding": sdf.groupby("grp")["val"].expanding().mean(),
+        "val_min_expanding": sdf.groupby("grp")["val"].expanding().min(),
+        "val_sum_rolling": sdf.groupby("grp")["val"].rolling(4, min_periods=4).sum(),
+        "val_max_rolling": sdf.groupby("grp")["val"].rolling(4, min_periods=4).max(),
+    }
+    for name, exp in oracle.items():
+        ev = exp.reset_index(level=0, drop=True).reindex(df.index).to_numpy()
+        gv = got[name].to_numpy()
+        assert (np.isfinite(gv) == np.isfinite(ev)).all(), name
+        both = np.isfinite(gv)
+        np.testing.assert_allclose(gv[both], ev[both], rtol=1e-4, atol=1e-4, err_msg=name)
+
+    lg = dtm.lagged_ts(t, ["ts"], lag=1, output_type="ts", partition_col="grp").to_pandas()
+    exp_lag = sdf.groupby("grp")["ts"].shift(1).reindex(df.index)
+    pd.testing.assert_series_equal(
+        lg["ts_lag1"].astype("datetime64[s]"), exp_lag.astype("datetime64[s]"), check_names=False
+    )
+    d = dtm.lagged_ts(t, ["ts"], lag=2, output_type="ts_diff", tsdiff_unit="hours", partition_col="grp").to_pandas()
+    exp_d = (sdf["ts"] - sdf.groupby("grp")["ts"].shift(2)).dt.total_seconds().div(3600).reindex(df.index).to_numpy()
+    gv = d["ts_lag2_diff"].to_numpy()
+    assert (np.isfinite(gv) == np.isfinite(exp_d)).all()
+    np.testing.assert_allclose(gv[np.isfinite(gv)], exp_d[np.isfinite(exp_d)], atol=1e-4)
+
+
+def test_reference_kwarg_names():
+    """A reference user's kwargs must work verbatim: comparison_format,
+    stability idfs-list, geo input/output_format, location loc1/loc2."""
+    g = np.random.default_rng(4)
+    n = 60
+    df = pd.DataFrame(
+        {
+            "ts": pd.Timestamp("2023-06-01") + pd.to_timedelta(g.integers(0, 10_000, n), unit="s"),
+            "lat1": g.uniform(10, 11, n), "lon1": g.uniform(20, 21, n),
+            "lat2": g.uniform(12, 13, n), "lon2": g.uniform(22, 23, n),
+            "v": g.normal(size=n),
+        }
+    )
+    t = Table.from_pandas(df)
+    from anovos_tpu.data_transformer import datetime as dtm, geospatial as geo
+    from anovos_tpu.drift_stability.stability import stability_index_computation
+
+    out = dtm.timestamp_comparison(
+        t, ["ts"], comparison_type="greater_than",
+        comparison_value="01/06/2023 01:00:00", comparison_format="%d/%m/%Y %H:%M:%S",
+    ).to_pandas()
+    assert set(out["ts_comparison"].dropna().unique()) <= {0.0, 1.0}
+
+    o = geo.geo_format_latlon(t, ["lat1"], ["lon1"], input_format="dd", output_format="radian")
+    assert "lat1_radian" in o.col_names
+    o2 = geo.location_distance(
+        t, list_of_cols_loc1=["lat1", "lon1"], list_of_cols_loc2=["lat2", "lon2"],
+        loc_format="dd", distance_type="haversine", unit="km",
+    ).to_pandas()
+    assert o2["distance_haversine"].between(100, 500).all()
+
+    si = stability_index_computation([t, t, t], list_of_cols=["v"])
+    assert float(si.iloc[0]["stability_index"]) >= 3.5  # identical datasets: stable
