@@ -77,6 +77,141 @@ def descriptive_stats_geospatial(idf: Table, lat_col: str, lon_col: str, max_rec
     return stats
 
 
+def _geohash_profile(idf: Table, gh_col: str, max_val: int):
+    """(top frame, overall-summary frame, stats row) for one geohash column."""
+    col = idf.columns[gh_col]
+    from anovos_tpu.ops.segment import code_counts
+
+    cnts = np.asarray(code_counts(col.data, col.mask, max(len(col.vocab), 1)))
+    order = np.argsort(-cnts)[:max_val] if len(col.vocab) else np.zeros(0, dtype=int)
+    decoded = [geohash_decode(str(col.vocab[j])) for j in order]
+    top_gh = pd.DataFrame(
+        {
+            "geohash": [str(col.vocab[j]) for j in order],
+            "count": cnts[order].astype(int),
+            "lat": [round(d[0], 6) for d in decoded],
+            "lon": [round(d[1], 6) for d in decoded],
+        }
+    )
+    precisions = {len(str(v)) for v in col.vocab[:1000]}
+    overall = pd.DataFrame(
+        {
+            "stats": ["Distinct Geohash", "Geohash Precision Level", "Most Common Geohash"],
+            "count": [
+                int((cnts > 0).sum()),
+                ",".join(str(p) for p in sorted(precisions)),
+                str(col.vocab[order[0]]) if len(order) else "",
+            ],
+        }
+    )
+    row = {
+        "lat_col": gh_col,
+        "lon_col": "",
+        "records": int(cnts.sum()),
+        "distinct_pairs": int((cnts > 0).sum()),
+        "most_common_pair": str(col.vocab[order[0]]) if len(order) else "",
+        "most_common_pair_count": int(cnts[order[0]]) if len(order) else 0,
+    }
+    return top_gh, overall, row
+
+
+def descriptive_stats_gen(
+    idf: Table,
+    lat_col: Optional[str],
+    long_col: Optional[str],
+    geohash_col: Optional[str],
+    id_col: Optional[str],
+    master_path: str,
+    max_val: int,
+    _pts: Optional[np.ndarray] = None,
+    _max_records: int = 100000,
+) -> Optional[dict]:
+    """Base stats writer for one geospatial field (reference :64-233).
+
+    For a lat-long pair writes the two-column overall summary
+    (``geospatial_overall_<lat>_<lon>.csv``) plus the top-pairs table and
+    chart dumps; for a geohash column the distinct/precision/most-common
+    summary plus the top-geohash table.  Returns the flat stats row that
+    ``geospatial_stats.csv`` aggregates."""
+    Path(master_path).mkdir(parents=True, exist_ok=True)
+    if lat_col is not None and long_col is not None:
+        pts = _pts if _pts is not None else _latlon_points(idf, lat_col, long_col, _max_records)
+        stats, pair_counts = _pair_profile(idf, lat_col, long_col, pts)
+        top = (
+            pair_counts.head(max_val).reset_index(name="count")
+            if pair_counts is not None
+            else pd.DataFrame(columns=["lat", "lon", "count"])
+        )
+        top.to_csv(ends_with(master_path) + f"geospatial_top_{lat_col}_{long_col}.csv", index=False)
+        _write_geo_charts(master_path, f"{lat_col}_{long_col}", top)
+        if stats.get("records"):
+            pd.DataFrame(
+                {
+                    "stats": [
+                        "Distinct {Lat, Long} Pair", "Distinct Latitude", "Distinct Longitude",
+                        "Most Common {Lat, Long} Pair", "Most Common Pair Occurrence",
+                    ],
+                    "count": [
+                        stats["distinct_pairs"], stats["distinct_lat"], stats["distinct_lon"],
+                        stats["most_common_pair"], stats["most_common_pair_count"],
+                    ],
+                }
+            ).to_csv(
+                ends_with(master_path) + f"geospatial_overall_{lat_col}_{long_col}.csv", index=False
+            )
+        return stats
+    if geohash_col is not None:
+        top_gh, overall, row = _geohash_profile(idf, geohash_col, max_val)
+        top_gh.to_csv(ends_with(master_path) + f"geospatial_top_{geohash_col}.csv", index=False)
+        _write_geo_charts(master_path, geohash_col, top_gh)
+        overall.to_csv(ends_with(master_path) + f"geospatial_overall_{geohash_col}.csv", index=False)
+        return row
+    return None
+
+
+def lat_long_col_stats_gen(
+    idf: Table, lat_col: List[str], long_col: List[str], id_col: Optional[str], master_path: str, max_val: int
+) -> List[dict]:
+    """Stats for every detected lat-long pair (reference :235-273)."""
+    rows = []
+    for lat_c, lon_c in zip(lat_col, long_col):
+        row = descriptive_stats_gen(idf, lat_c, lon_c, None, id_col, master_path, max_val)
+        if row is not None:
+            rows.append(row)
+    return rows
+
+
+def geohash_col_stats_gen(
+    idf: Table, geohash_col: List[str], id_col: Optional[str], master_path: str, max_val: int
+) -> List[dict]:
+    """Stats for every detected geohash column (reference :275-311)."""
+    rows = []
+    for gh_c in geohash_col:
+        row = descriptive_stats_gen(idf, None, None, gh_c, id_col, master_path, max_val)
+        if row is not None:
+            rows.append(row)
+    return rows
+
+
+def stats_gen_lat_long_geo(
+    idf: Table,
+    lat_col: List[str],
+    long_col: List[str],
+    geohash_col: List[str],
+    id_col: Optional[str],
+    master_path: str,
+    max_val: int,
+) -> List[dict]:
+    """Main stats entry feeding the report's geospatial tab (reference
+    :313-388): lat-long pair stats + geohash stats, aggregated into
+    ``geospatial_stats.csv``."""
+    rows = lat_long_col_stats_gen(idf, lat_col, long_col, id_col, master_path, max_val)
+    rows += geohash_col_stats_gen(idf, geohash_col, id_col, master_path, max_val)
+    if rows:
+        pd.DataFrame(rows).to_csv(ends_with(master_path) + "geospatial_stats.csv", index=False)
+    return rows
+
+
 def _pair_profile(idf: Table, lat_col: str, lon_col: str, pts: np.ndarray):
     """(stats dict, rounded-grid pair counts) for one lat-lon pair — shared
     by the stats row and the top-locations dump so the grid count runs once.
@@ -221,6 +356,115 @@ def cluster_analysis(
     return km, pd.DataFrame(rows)
 
 
+def geo_cluster_analysis(
+    idf: Table,
+    lat_col: str,
+    long_col: str,
+    max_cluster: int,
+    eps: str,
+    min_samples: str,
+    master_path: str,
+    col_name: str,
+    global_map_box_val=None,
+    _pts: Optional[np.ndarray] = None,
+    _max_records: int = 100000,
+) -> None:
+    """KMeans + DBSCAN analysis for one field (reference :390-733).
+
+    Writes both the reference's ``cluster_output_{kmeans,dbscan}_<col>.csv``
+    names and the ``geospatial_{kmeans,dbscan}_<col>.csv`` names the report
+    tab hydrates."""
+    pts = _pts if _pts is not None else _latlon_points(idf, lat_col, long_col, _max_records)
+    if len(pts) < 50:
+        return
+    km, db = cluster_analysis(pts, max_cluster or 20, eps, min_samples)
+    for name, frame in [("kmeans", km), ("dbscan", db)]:
+        frame.to_csv(ends_with(master_path) + f"geospatial_{name}_{col_name}.csv", index=False)
+        frame.to_csv(ends_with(master_path) + f"cluster_output_{name}_{col_name}.csv", index=False)
+
+
+def geo_cluster_generator(
+    idf: Table,
+    lat_col_list: List[str],
+    long_col_list: List[str],
+    geo_col_list: List[str],
+    max_cluster: int = 20,
+    eps: str = "0.3,0.5,0.05",
+    min_samples: str = "500,1100,100",
+    master_path: str = ".",
+    global_map_box_val=None,
+    max_records: int = 100000,
+) -> None:
+    """Cluster-analysis controller over every detected field (reference
+    :734-849); geohash columns are decoded to lat-long before clustering."""
+    for lat_c, lon_c in zip(lat_col_list or [], long_col_list or []):
+        geo_cluster_analysis(
+            idf, lat_c, lon_c, max_cluster, eps, min_samples, master_path,
+            f"{lat_c}_{lon_c}", global_map_box_val, _max_records=max_records,
+        )
+    for gh_c in geo_col_list or []:
+        pts = _geohash_points(idf, gh_c, max_records)
+        geo_cluster_analysis(
+            idf, gh_c, gh_c, max_cluster, eps, min_samples, master_path,
+            gh_c, global_map_box_val, _pts=pts,
+        )
+
+
+def _geohash_points(idf: Table, gh_col: str, max_records: int) -> np.ndarray:
+    """Decode a geohash column's values (via its dictionary) to lat-long points."""
+    col = idf.columns[gh_col]
+    codes = np.asarray(col.data)[: idf.nrows]
+    mask = np.asarray(col.mask)[: idf.nrows]
+    decoded = np.array([geohash_decode(str(v))[:2] for v in col.vocab]) if len(col.vocab) else np.zeros((0, 2))
+    pts = decoded[codes[mask]] if len(decoded) else np.zeros((0, 2))
+    if len(pts) > max_records:
+        pts = pts[np.random.default_rng(0).choice(len(pts), max_records, replace=False)]
+    return pts
+
+
+def generate_loc_charts_processor(
+    idf: Table,
+    lat_col: Optional[List[str]],
+    long_col: Optional[List[str]],
+    geohash_col: Optional[List[str]],
+    max_val: int,
+    id_col: Optional[str] = None,
+    global_map_box_val=None,
+    master_path: str = ".",
+) -> None:
+    """Location-chart writer (reference :851-1027): scatter + density JSON
+    per lat-long pair, and per geohash column after decode."""
+    for lat_c, lon_c in zip(lat_col or [], long_col or []):
+        # max_val caps the DISPLAYED top locations; the grid count itself
+        # runs over the full analysis sample
+        pts = _latlon_points(idf, lat_c, lon_c, max(int(max_val), 100000))
+        _, pair_counts = _pair_profile(idf, lat_c, lon_c, pts)
+        if pair_counts is not None:
+            top = pair_counts.head(max_val).reset_index(name="count")
+            _write_geo_charts(master_path, f"{lat_c}_{lon_c}", top)
+    for gh_c in geohash_col or []:
+        top_gh, _, _ = _geohash_profile(idf, gh_c, max_val)
+        _write_geo_charts(master_path, gh_c, top_gh)
+
+
+def generate_loc_charts_controller(
+    idf: Table,
+    id_col: Optional[str],
+    lat_col: List[str],
+    long_col: List[str],
+    geohash_col: List[str],
+    max_val: int,
+    global_map_box_val=None,
+    master_path: str = ".",
+) -> None:
+    """Chart-generation trigger (reference :1029-1117): lat-long pairs first
+    (geohash None), then geohash columns (lat/long None)."""
+    if lat_col:
+        generate_loc_charts_processor(idf, lat_col, long_col, None, max_val, id_col, global_map_box_val, master_path)
+    if geohash_col:
+        generate_loc_charts_processor(idf, None, None, geohash_col, max_val, id_col, global_map_box_val, master_path)
+
+
 def geospatial_autodetection(
     idf: Table,
     id_col: Optional[str] = None,
@@ -242,76 +486,19 @@ def geospatial_autodetection(
     lat_cols, lon_cols, gh_cols = ll_gh_cols(idf, max_analysis_records)
     stats_rows = []
     for lat_c, lon_c in zip(lat_cols, lon_cols):
+        # points are extracted once per pair and shared by the stats writer
+        # and the cluster scan (both accept them via _pts)
         pts = _latlon_points(idf, lat_c, lon_c, max_analysis_records)
-        stats, pair_counts = _pair_profile(idf, lat_c, lon_c, pts)
-        stats_rows.append(stats)
-        if len(pts) >= 50:
-            km, db = cluster_analysis(pts, max_cluster or 20, eps, min_samples)
-            km.to_csv(ends_with(master_path) + f"geospatial_kmeans_{lat_c}_{lon_c}.csv", index=False)
-            db.to_csv(ends_with(master_path) + f"geospatial_dbscan_{lat_c}_{lon_c}.csv", index=False)
-        # top locations (rounded 4dp grid, counted once in _pair_profile)
-        top = (
-            pair_counts.head(top_geo_records).reset_index(name="count")
-            if pair_counts is not None
-            else pd.DataFrame(columns=["lat", "lon", "count"])
+        row = descriptive_stats_gen(
+            idf, lat_c, lon_c, None, id_col, master_path, top_geo_records, _pts=pts
         )
-        top.to_csv(ends_with(master_path) + f"geospatial_top_{lat_c}_{lon_c}.csv", index=False)
-        _write_geo_charts(master_path, f"{lat_c}_{lon_c}", top)
-        # reference-style two-column overall summary table per pair
-        s = stats_rows[-1]
-        if s.get("records"):
-            pd.DataFrame(
-                {
-                    "stats": [
-                        "Distinct {Lat, Long} Pair", "Distinct Latitude", "Distinct Longitude",
-                        "Most Common {Lat, Long} Pair", "Most Common Pair Occurrence",
-                    ],
-                    "count": [
-                        s["distinct_pairs"], s["distinct_lat"], s["distinct_lon"],
-                        s["most_common_pair"], s["most_common_pair_count"],
-                    ],
-                }
-            ).to_csv(
-                ends_with(master_path) + f"geospatial_overall_{lat_c}_{lon_c}.csv", index=False
-            )
-    for gh_c in gh_cols:
-        col = idf.columns[gh_c]
-        from anovos_tpu.ops.segment import code_counts
-
-        cnts = np.asarray(code_counts(col.data, col.mask, max(len(col.vocab), 1)))
-        order = np.argsort(-cnts)[:top_geo_records]
-        decoded = [geohash_decode(str(col.vocab[j])) for j in order]
-        top_gh = pd.DataFrame(
-            {
-                "geohash": [str(col.vocab[j]) for j in order],
-                "count": cnts[order].astype(int),
-                "lat": [round(d[0], 6) for d in decoded],
-                "lon": [round(d[1], 6) for d in decoded],
-            }
+        if row is not None:
+            stats_rows.append(row)
+        geo_cluster_analysis(
+            idf, lat_c, lon_c, max_cluster, eps, min_samples, master_path,
+            f"{lat_c}_{lon_c}", global_map_box_val, _pts=pts,
         )
-        top_gh.to_csv(ends_with(master_path) + f"geospatial_top_{gh_c}.csv", index=False)
-        _write_geo_charts(master_path, gh_c, top_gh)
-        precisions = {len(str(v)) for v in col.vocab[:1000]}
-        pd.DataFrame(
-            {
-                "stats": ["Distinct Geohash", "Geohash Precision Level", "Most Common Geohash"],
-                "count": [
-                    int((cnts > 0).sum()),
-                    ",".join(str(p) for p in sorted(precisions)),
-                    str(col.vocab[order[0]]) if len(order) else "",
-                ],
-            }
-        ).to_csv(ends_with(master_path) + f"geospatial_overall_{gh_c}.csv", index=False)
-        stats_rows.append(
-            {
-                "lat_col": gh_c,
-                "lon_col": "",
-                "records": int(cnts.sum()),
-                "distinct_pairs": int((cnts > 0).sum()),
-                "most_common_pair": str(col.vocab[order[0]]) if len(order) else "",
-                "most_common_pair_count": int(cnts[order[0]]) if len(order) else 0,
-            }
-        )
+    stats_rows += geohash_col_stats_gen(idf, gh_cols, id_col, master_path, top_geo_records)
     if stats_rows:
         pd.DataFrame(stats_rows).to_csv(
             ends_with(master_path) + "geospatial_stats.csv", index=False

@@ -728,6 +728,424 @@ document.addEventListener('DOMContentLoaded', () => {
 """
 
 
+# ----------------------------------------------------------------------
+# reference-named public section generators.  The reference returns
+# datapane objects from these (report_generation.py:78-3982); the analogue
+# here is the section's HTML fragment — or plotly fig dicts / pandas
+# frames for the chart and stats helpers — which anovos_report assembles
+# into the final document.
+# ----------------------------------------------------------------------
+def remove_u_score(col: str) -> str:
+    """Underscored file/stat name → display title (reference :78-97)."""
+    out = []
+    for part in str(col).split("_"):
+        if part in ("nullColumns", "nullRows"):
+            out.append("Null")
+        elif part:
+            out.append(part[0].upper() + part[1:])
+    return " ".join(out)
+
+
+def lambda_cat(val: float) -> str:
+    """Box-Cox λ → transformation label (reference :2734-2765)."""
+    if val < -1:
+        return "Reciprocal Square Transform"
+    if val < -0.5:
+        return "Reciprocal Transform"
+    if val < 0:
+        return "Receiprocal Square Root Transform"
+    if val < 0.5:
+        return "Log Transform"
+    if val < 1:
+        return "Square Root Transform"
+    if val < 2:
+        return "No Transform"
+    return "Square Transform"
+
+
+def list_ts_remove_append(l: list, opt) -> list:
+    """Strip (opt==1) or append (else) the ``_ts`` suffix (reference :2308-2343)."""
+    if opt == 1:
+        return [i[:-3] if str(i).endswith("_ts") else i for i in l]
+    return [i if str(i).endswith("_ts") else i + "_ts" for i in l]
+
+
+def drift_stability_ind(missing_recs_drift, drift_tab, missing_recs_stability, stability_tab):
+    """(drift_ind, stability_ind) from the missing-file lists (reference :440-473)."""
+    drift_ind = 0 if len(missing_recs_drift) == len(drift_tab) else 1
+    if len(missing_recs_stability) == len(stability_tab):
+        stability_ind = 0
+    elif "stabilityIndex_metrics" in missing_recs_stability and "stability_index" not in missing_recs_stability:
+        stability_ind = 0.5
+    else:
+        stability_ind = 1
+    return drift_ind, stability_ind
+
+
+def chart_gen_list(master_path: str, chart_type: str, type_col=None) -> List[dict]:
+    """Plotly fig dicts for every ``<chart_type>*`` dump (reference :475-521);
+    ``type_col`` restricts to the named attributes."""
+    figs = []
+    for f in sorted(glob.glob(ends_with(master_path) + chart_type + "*")):
+        attr = os.path.basename(f)[len(chart_type):]
+        attr = attr[:-5] if attr.endswith(".json") else attr
+        if type_col is not None and attr not in set(map(str, type_col)):
+            continue
+        fig = _load_fig(f)
+        if fig is not None:
+            figs.append(fig)
+    return figs
+
+
+def line_chart_gen_stability(df1: pd.DataFrame, df2: pd.DataFrame, col: str) -> List[dict]:
+    """Per-attribute stability charts (reference :99-230): metric lines over
+    the history frame ``df2`` plus the SI gauge from the summary frame ``df1``."""
+    figs = []
+    hist = df2[df2["attribute"].astype(str) == str(col)] if df2 is not None and "attribute" in df2 else None
+    if hist is not None and len(hist):
+        x = list(range(1, len(hist) + 1))
+        for metric in ("mean", "stddev", "kurtosis"):
+            if metric in hist:
+                figs.append(_line_fig(x, {metric: hist[metric].tolist()}, f"{metric} across idx — {col}", metric))
+    if df1 is not None and "attribute" in df1:
+        row = df1[df1["attribute"].astype(str) == str(col)]
+        if len(row):
+            si = float(row["stability_index"].iloc[0])
+            figs.append(
+                {
+                    "data": [{
+                        "type": "indicator", "mode": "gauge+number", "value": si,
+                        "title": {"text": f"{col} — {_si_category(si)}"},
+                        "gauge": {"axis": {"range": [0, 4]}},
+                    }],
+                    "layout": {"template": "plotly_white"},
+                }
+            )
+    return figs
+
+
+def executive_summary_gen(
+    master_path: str,
+    label_col: str = "",
+    ds_ind=None,
+    id_col: str = "",
+    iv_threshold: float = 0.02,
+    corr_threshold: float = 0.4,
+    print_report: bool = False,
+) -> str:
+    """Executive-summary tab (reference :524-906)."""
+    return _executive_summary(master_path, id_col, label_col, corr_threshold, iv_threshold)
+
+
+def wiki_generator(
+    master_path: str, dataDict_path=None, metricDict_path=None, print_report: bool = False
+) -> str:
+    """Wiki tab: data dictionary + metric dictionary + observed datatypes
+    (reference :909-991)."""
+    html = ""
+    dt = _read_csv(master_path, "data_type")
+    if dt is not None and len(dt):
+        html += _table_html(dt, "observed data types")
+    for path, title in [(dataDict_path, "data dictionary"), (metricDict_path, "metric dictionary")]:
+        if path and path != "NA" and os.path.exists(str(path)):
+            try:
+                html += _table_html(pd.read_csv(path), title)
+            except Exception:
+                pass
+    return html
+
+
+def data_analyzer_output(master_path: str, avl_recs_tab, tab_name: str) -> str:
+    """Tables for one analyzer tab's available stat files (reference :233-438)."""
+    html = ""
+    for name in avl_recs_tab or []:
+        df = _read_csv(master_path, str(name))
+        if df is not None:
+            html += _table_html(df, str(name))
+    return html
+
+
+def descriptive_statistics(
+    master_path: str,
+    SG_tabs=tuple(_SG_FILES),
+    avl_recs_SG=None,
+    missing_recs_SG=None,
+    all_charts_num_1_=None,
+    all_charts_cat_1_=None,
+    print_report: bool = False,
+    label_col: str = "",
+) -> str:
+    """Descriptive-stats tab with per-attribute drill-downs (reference :994-1151)."""
+    sg_frames = {name: df for name in SG_tabs if (df := _read_csv(master_path, name)) is not None}
+    html = "".join(_table_html(df, name) for name, df in sg_frames.items())
+    profiles_html, covered = _attribute_profiles(master_path, label_col, sg_frames)
+    html += profiles_html
+    html += _charts_html(master_path, "freqDist_", "frequency distributions", exclude=covered)
+    if label_col:
+        html += _charts_html(master_path, "eventDist_", f"event rates vs {label_col}", exclude=covered)
+    return html
+
+
+def quality_check(
+    master_path: str,
+    QC_tabs=tuple(_QC_FILES),
+    avl_recs_QC=None,
+    missing_recs_QC=None,
+    all_charts_num_3_=None,
+    print_report: bool = False,
+) -> str:
+    """Quality-check tab (reference :1154-1288)."""
+    html = "".join(
+        _table_html(df, name) for name in QC_tabs if (df := _read_csv(master_path, name)) is not None
+    )
+    return html + _charts_html(master_path, "outlier_", "outlier distributions")
+
+
+def attribute_associations(
+    master_path: str,
+    AE_tabs=tuple(_AE_FILES),
+    avl_recs_AE=None,
+    missing_recs_AE=None,
+    label_col: str = "",
+    all_charts_num_2_=None,
+    all_charts_cat_2_=None,
+    print_report: bool = False,
+) -> str:
+    """Attribute-associations tab: correlation heatmap + IV/IG/varclus tables
+    (reference :1291-1431)."""
+    html = ""
+    corr = _read_csv(master_path, "correlation_matrix")
+    if corr is not None:
+        attrs = list(corr["attribute"])
+        z = corr.drop(columns=["attribute"]).to_numpy(dtype=float).tolist()
+        fig = {
+            "data": [{"type": "heatmap", "z": z, "x": list(corr.columns[1:]), "y": attrs, "colorscale": "RdBu", "zmid": 0}],
+            "layout": {"title": {"text": "correlation matrix"}, "template": "plotly_white"},
+        }
+        html += _fig_div(fig, "corrheat", 480)
+    for name in AE_tabs:
+        if name == "correlation_matrix":
+            continue
+        df = _read_csv(master_path, name)
+        if df is not None:
+            html += _table_html(df, name)
+    return html
+
+
+def data_drift_stability(
+    master_path: str,
+    ds_ind=None,
+    id_col: str = "",
+    drift_threshold_model: float = 0.1,
+    all_drift_charts_=None,
+    print_report: bool = False,
+) -> str:
+    """Drift & stability tab with SI gauges and metric lines (reference :1434-1939)."""
+    html = ""
+    drift = _read_csv(master_path, "drift_statistics")
+    if drift is not None:
+        if "flagged" in drift:
+            drifted = int((drift["flagged"] > 0).sum())
+            html += (
+                f"<p><b>{drifted}</b> of <b>{len(drift)}</b> attributes drifted beyond the "
+                f"{drift_threshold_model} threshold.</p>"
+            )
+        html += _table_html(drift, "drift_statistics")
+    stab = _read_csv(master_path, "stability_index")
+    if stab is not None:
+        html += _table_html(stab, "stability_index")
+    html += _stability_charts(master_path)
+    html += _charts_html(master_path, "drift_", "source vs target distributions")
+    return html
+
+
+def ts_stats(base_path: str) -> Optional[pd.DataFrame]:
+    """Timestamp-eligibility frame the ts tab leads with (reference :3051-3089)."""
+    return _read_csv(base_path, "ts_stats")
+
+
+def ts_landscape(base_path: str, ts_cols=None, id_col=None) -> Optional[pd.DataFrame]:
+    """Time-series landscape frame (reference :2636-2732)."""
+    land = _read_csv(base_path, "ts_landscape")
+    if land is not None and ts_cols:
+        keep = set(map(str, ts_cols))
+        name_col = land.columns[0]
+        land = land[land[name_col].astype(str).isin(keep)] if len(land) else land
+    return land
+
+
+_TS_GRAIN_FILES = {"daily": "ts_daily_", "hourly": "ts_daypart_", "weekly": "ts_weekly_"}
+
+
+def gen_time_series_plots(base_path: str, x_col: str, y_col: str, time_cat: str) -> Optional[dict]:
+    """One volume/trend fig at the requested grain (reference :2054-2305).
+    ``x_col`` is the timestamp column; ``y_col`` is ``count`` for volume or a
+    numeric attribute for its per-grain trend."""
+    grain = str(time_cat).lower()
+    prefix = _TS_GRAIN_FILES.get(grain)
+    if prefix is None:
+        return None
+    if y_col in ("count", "", None):
+        df = _read_csv(base_path, f"{prefix}{x_col}".replace(".csv", ""))
+        if df is None or not len(df):
+            return None
+        if grain == "daily":
+            return _line_fig(df.iloc[:, 0], {"records": df["count"].tolist()}, f"daily volume — {x_col}", "count")
+        return _bar_fig(df.iloc[:, 0], df["count"], f"{grain} volume — {x_col}")
+    num = _read_csv(base_path, f"ts_num_{grain}_{x_col}")
+    if num is None or "attribute" not in num:
+        return None
+    sub = num[num["attribute"].astype(str) == str(y_col)]
+    if not len(sub):
+        return None
+    if grain == "daily":
+        return _line_fig(sub["date"], {"mean": sub["mean"].tolist(), "median": sub["median"].tolist()},
+                         f"{y_col} over time", y_col)
+    return _bar_fig(sub["bucket"], sub["mean"], f"{y_col} mean by {grain}")
+
+
+def plotSeasonalDecompose(
+    base_path: str, x_col: str, y_col: str = "count", metric_col: str = "median",
+    title: str = "Seasonal Decomposition",
+) -> List[dict]:
+    """Observed/trend/seasonal/residual figs from the decomposition dump
+    (reference :1942-2051)."""
+    dec = _read_csv(base_path, f"ts_decompose_{x_col}")
+    if dec is None or not len(dec):
+        return []
+    return [
+        _line_fig(dec["date"], {part: dec[part].tolist()}, f"{title} — {part}")
+        for part in ("observed", "trend", "seasonal", "residual")
+        if part in dec
+    ]
+
+
+def _ts_viz(base_path, ts_col, col_list, grain):
+    """Shared body of the nine ``ts_viz_<grain>_<view>`` builders: the
+    reference repeats one figure loop per (grain, view) pair (:2345-3049);
+    here each named entry delegates with its grain and column list."""
+    cols = col_list if isinstance(col_list, (list, tuple)) else [col_list]
+    figs = [gen_time_series_plots(base_path, ts_col, "count", grain)]
+    figs += [gen_time_series_plots(base_path, ts_col, c, grain) for c in cols if c]
+    return [f for f in figs if f is not None]
+
+
+def ts_viz_1_1(base_path, x_col, y_col, output_type=None):
+    """Daily volume + one attribute trend (reference :2345)."""
+    return _ts_viz(base_path, x_col, y_col, "daily")
+
+
+def ts_viz_1_2(base_path, ts_col, col_list, output_type=None):
+    """Daily trends across attributes (reference :2370)."""
+    return _ts_viz(base_path, ts_col, col_list, "daily")
+
+
+def ts_viz_1_3(base_path, ts_col, num_cols, cat_cols=None, output_type=None):
+    """Daily trends, numeric + categorical mix (reference :2402)."""
+    return _ts_viz(base_path, ts_col, list(num_cols or []) + list(cat_cols or []), "daily")
+
+
+def ts_viz_2_1(base_path, x_col, y_col):
+    """Hourly/daypart volume + one attribute (reference :2497)."""
+    return _ts_viz(base_path, x_col, y_col, "hourly")
+
+
+def ts_viz_2_2(base_path, ts_col, col_list):
+    """Hourly trends across attributes (reference :2529)."""
+    return _ts_viz(base_path, ts_col, col_list, "hourly")
+
+
+def ts_viz_2_3(base_path, ts_col, num_cols):
+    """Hourly numeric trends (reference :2559)."""
+    return _ts_viz(base_path, ts_col, num_cols, "hourly")
+
+
+def ts_viz_3_1(base_path, x_col, y_col):
+    """Weekly volume + one attribute (reference :2767)."""
+    return _ts_viz(base_path, x_col, y_col, "weekly")
+
+
+def ts_viz_3_2(base_path, ts_col, col_list):
+    """Weekly trends across attributes (reference :2955)."""
+    return _ts_viz(base_path, ts_col, col_list, "weekly")
+
+
+def ts_viz_3_3(base_path, ts_col, num_cols):
+    """Weekly numeric trends (reference :2985)."""
+    return _ts_viz(base_path, ts_col, num_cols, "weekly")
+
+
+def ts_viz_generate(master_path: str, id_col: str = "", print_report: bool = False, output_type=None) -> str:
+    """Full time-series tab HTML (reference :3091-3207)."""
+    return _ts_tab(master_path)
+
+
+def overall_stats_gen(lat_col_list, long_col_list, geohash_col_list):
+    """(field-name dict, #lat-long pairs, #geohash cols) (reference :3210-3248)."""
+    d = {}
+    for key, cols in [
+        ("Latitude Col", lat_col_list),
+        ("Longitude Col", long_col_list),
+        ("Geohash Col", geohash_col_list),
+    ]:
+        d[key] = ",".join(str(c) for c in (cols or []))
+    return d, len(lat_col_list or []), len(geohash_col_list or [])
+
+
+def loc_field_stats(lat_col_list, long_col_list, geohash_col_list, max_records) -> pd.DataFrame:
+    """Identified-fields summary frame (reference :3250-3296)."""
+    d, n_ll, n_gh = overall_stats_gen(lat_col_list, long_col_list, geohash_col_list)
+    rows = [{"stats": k, "value": v} for k, v in d.items()]
+    rows += [
+        {"stats": "Lat-Long Pairs", "value": n_ll},
+        {"stats": "Geohash Columns", "value": n_gh},
+        {"stats": "Max Records Analyzed", "value": max_records},
+    ]
+    return pd.DataFrame(rows)
+
+
+def read_stats_ll_geo(lat_col, long_col, geohash_col, master_path: str, top_geo_records) -> Dict[str, pd.DataFrame]:
+    """Overall-summary + top-location frames per field (reference :3298-3533)."""
+    out: Dict[str, pd.DataFrame] = {}
+    names = [f"{a}_{b}" for a, b in zip(lat_col or [], long_col or [])] + list(geohash_col or [])
+    for name in names:
+        for prefix in ("geospatial_overall_", "geospatial_top_"):
+            df = _read_csv(master_path, f"{prefix}{name}")
+            if df is not None:
+                out[f"{prefix}{name}"] = df.head(int(top_geo_records)) if prefix.endswith("top_") else df
+    return out
+
+
+def read_cluster_stats_ll_geo(lat_col, long_col, geohash_col, master_path: str) -> Dict[str, pd.DataFrame]:
+    """KMeans/DBSCAN cluster frames per field (reference :3535-3810)."""
+    out: Dict[str, pd.DataFrame] = {}
+    names = [f"{a}_{b}" for a, b in zip(lat_col or [], long_col or [])] + list(geohash_col or [])
+    for name in names:
+        for algo in ("kmeans", "dbscan"):
+            df = _read_csv(master_path, f"geospatial_{algo}_{name}")
+            if df is not None:
+                out[f"{algo}_{name}"] = df
+    return out
+
+
+def read_loc_charts(master_path: str) -> List[dict]:
+    """Location scatter/density fig dicts (reference :3812-3900)."""
+    return chart_gen_list(master_path, "geo_scatter_") + chart_gen_list(master_path, "geo_heat_")
+
+
+def loc_report_gen(
+    lat_cols=None,
+    long_cols=None,
+    geohash_cols=None,
+    master_path: str = ".",
+    max_records: int = 100000,
+    top_geo_records: int = 100,
+    print_report: bool = False,
+) -> str:
+    """Full geospatial tab HTML (reference :3902-3981)."""
+    return _geo_tab(master_path)
+
+
 def anovos_report(
     master_path: str = ".",
     id_col: str = "",
@@ -749,83 +1167,34 @@ def anovos_report(
     tabs.append(
         (
             "Executive Summary",
-            _executive_summary(master_path, id_col, label_col, corr_threshold, iv_threshold)
+            executive_summary_gen(master_path, label_col, None, id_col, iv_threshold, corr_threshold)
             or "<p>no global summary found</p>",
         )
     )
-
-    # wiki: data + metric dictionary (reference :909)
-    wiki = ""
-    for path, title in [(dataDict_path, "data dictionary"), (metricDict_path, "metric dictionary")]:
-        if path and path != "NA" and os.path.exists(path):
-            try:
-                wiki += _table_html(pd.read_csv(path), title)
-            except Exception:
-                pass
-    tabs.append(("Wiki", wiki or "<p>no dictionaries configured</p>"))
-
-    # descriptive stats (reference :994) + per-attribute drill-down panels
-    # (reference data_analyzer_output :233-440).  The profiles embed each
-    # attribute's freqDist/eventDist chart; plain grids render only whatever
-    # the profiles did not cover (beyond the cap, or chart with no SG row),
-    # so no chart appears twice but none is lost.
-    sg_frames = {name: df for name in _SG_FILES if (df := _read_csv(master_path, name)) is not None}
-    sg_html = "".join(_table_html(df, name) for name, df in sg_frames.items())
-    profiles_html, covered = _attribute_profiles(master_path, label_col, sg_frames)
-    sg_html += profiles_html
-    sg_html += _charts_html(master_path, "freqDist_", "frequency distributions", exclude=covered)
-    if label_col:
-        sg_html += _charts_html(
-            master_path, "eventDist_", f"event rates vs {label_col}", exclude=covered
-        )
-    tabs.append(("Descriptive Statistics", sg_html or "<p>no stats found</p>"))
-
-    # quality (reference :1154)
-    qc_html = "".join(
-        _table_html(df, name) for name in _QC_FILES if (df := _read_csv(master_path, name)) is not None
+    tabs.append(
+        ("Wiki", wiki_generator(master_path, dataDict_path, metricDict_path) or "<p>no dictionaries configured</p>")
     )
-    qc_html += _charts_html(master_path, "outlier_", "outlier distributions")
-    tabs.append(("Quality Check", qc_html or "<p>no quality stats found</p>"))
+    tabs.append(
+        (
+            "Descriptive Statistics",
+            descriptive_statistics(master_path, label_col=label_col) or "<p>no stats found</p>",
+        )
+    )
+    tabs.append(("Quality Check", quality_check(master_path) or "<p>no quality stats found</p>"))
+    tabs.append(
+        ("Attribute Associations", attribute_associations(master_path, label_col=label_col) or "<p>no association stats found</p>")
+    )
+    tabs.append(
+        (
+            "Drift & Stability",
+            data_drift_stability(master_path, None, id_col, drift_threshold_model) or "<p>no drift stats found</p>",
+        )
+    )
 
-    # associations (reference :1291)
-    ae_html = ""
-    corr = _read_csv(master_path, "correlation_matrix")
-    if corr is not None:
-        attrs = list(corr["attribute"])
-        z = corr.drop(columns=["attribute"]).to_numpy(dtype=float).tolist()
-        fig = {
-            "data": [{"type": "heatmap", "z": z, "x": list(corr.columns[1:]), "y": attrs, "colorscale": "RdBu", "zmid": 0}],
-            "layout": {"title": {"text": "correlation matrix"}, "template": "plotly_white"},
-        }
-        ae_html += _fig_div(fig, "corrheat", 480)
-    for name in _AE_FILES[1:]:
-        df = _read_csv(master_path, name)
-        if df is not None:
-            ae_html += _table_html(df, name)
-    tabs.append(("Attribute Associations", ae_html or "<p>no association stats found</p>"))
-
-    # drift & stability (reference :1434) with SI gauges + metric lines (:99)
-    dr_html = ""
-    drift = _read_csv(master_path, "drift_statistics")
-    if drift is not None:
-        if "flagged" in drift:
-            drifted = int((drift["flagged"] > 0).sum())
-            dr_html += (
-                f"<p><b>{drifted}</b> of <b>{len(drift)}</b> attributes drifted beyond the "
-                f"{drift_threshold_model} threshold.</p>"
-            )
-        dr_html += _table_html(drift, "drift_statistics")
-    stab = _read_csv(master_path, "stability_index")
-    if stab is not None:
-        dr_html += _table_html(stab, "stability_index")
-    dr_html += _stability_charts(master_path)
-    dr_html += _charts_html(master_path, "drift_", "source vs target distributions")
-    tabs.append(("Drift & Stability", dr_html or "<p>no drift stats found</p>"))
-
-    ts_html = _ts_tab(master_path)
+    ts_html = ts_viz_generate(master_path, id_col)
     if ts_html:
         tabs.append(("Time Series", ts_html))
-    geo_html = _geo_tab(master_path)
+    geo_html = loc_report_gen(master_path=master_path)
     if geo_html:
         tabs.append(("Geospatial", geo_html))
 

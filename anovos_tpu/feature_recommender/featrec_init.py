@@ -128,6 +128,21 @@ def feature_recommendation_prep(corpus_path: Optional[str] = None):
     return texts, grouped
 
 
+class EmbeddingsTrainFer:
+    """Lazy corpus-embedding holder (reference :231-243): encodes
+    ``list_train_fer`` once on first ``.get`` and caches the matrix."""
+
+    def __init__(self, list_train_fer: List[str]):
+        self.list_train_fer = list_train_fer
+        self._embeddings = None
+
+    @property
+    def get(self) -> np.ndarray:
+        if self._embeddings is None:
+            self._embeddings = get_model().encode(self.list_train_fer)
+        return self._embeddings
+
+
 def camel_case_split(identifier: str) -> str:
     """Reference :114-131: CamelCase → spaced words."""
     matches = re.finditer(r".+?(?:(?<=[a-z])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])|$)", str(identifier))
