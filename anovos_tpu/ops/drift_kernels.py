@@ -135,12 +135,18 @@ def drift_side_full(
     if cat_datas:
         C = jnp.stack(cat_datas, axis=1)
         Mc = jnp.stack(cat_masks, axis=1)
-
-        def remap_one(c_j, lut_j):
-            return jnp.where(c_j >= 0, lut_j[jnp.clip(c_j, 0, lut_j.shape[0] - 1)], -1)
-
-        Cu = jax.vmap(remap_one, in_axes=(1, 0), out_axes=1)(C, lut)
-        cat_h = code_histograms(Cu, Mc, n_cat_bins)
+        # histogram-then-permute: counting over each column's LOCAL codes is
+        # a cheap compare-and-reduce, and the union-vocab remap then acts on
+        # the tiny (k, maxv) count matrix via the one-hot'd LUT — identical
+        # result to remapping every row first, without the (rows, k) device
+        # gather that dominated the side program (~3/4 of its wall time)
+        local_h = code_histograms(C, Mc, lut.shape[1])
+        k = local_h.shape[0]
+        # scatter-add on the (k, maxv) count matrix — O(k·maxv) work and no
+        # (k, maxv, u) intermediate, which would go quadratic in cardinality
+        cat_h = jnp.zeros((k, n_cat_bins), jnp.float32).at[
+            jnp.arange(k, dtype=jnp.int32)[:, None], lut
+        ].add(local_h)
     else:
         cat_h = jnp.zeros((0, n_cat_bins), jnp.float32)
     return num_h, cat_h

@@ -1,8 +1,10 @@
 """Shared helpers mirroring the reference's shared/utils.py surface.
 
-``attributeType_segregation`` / ``get_dtype`` (utils.py:48-76) live on
-:class:`~anovos_tpu.shared.table.Table`; this module adds the list-handling
-and path helpers plus ``pairwise_reduce`` (utils.py:113-132).
+``attributeType_segregation`` / ``get_dtype`` (utils.py:48-76) delegate to
+:class:`~anovos_tpu.shared.table.Table` when given a Table and handle pandas
+frames directly; ``flatten_dataframe`` / ``transpose_dataframe`` (utils.py:6-45)
+are host-side reshapes of stats frames.  Plus the list-handling and path
+helpers and ``pairwise_reduce`` (utils.py:113-132).
 """
 
 from __future__ import annotations
@@ -77,3 +79,54 @@ def path_ak8s_modify(path: str) -> str:
         account, _, blob_path = tail.partition("/")
         return f"https://{account}/{container}/{blob_path}"
     return path
+
+
+def attributeType_segregation(idf):
+    """(num_cols, cat_cols, other_cols) for a Table or pandas frame
+    (reference utils.py:48-65)."""
+    if hasattr(idf, "attribute_type_segregation"):
+        return idf.attribute_type_segregation()
+    num, cat, other = [], [], []
+    for c in idf.columns:
+        kind = idf[c].dtype.kind
+        (num if kind in "ifu" else cat if kind in "OUSb" else other).append(c)
+    return num, cat, other
+
+
+def get_dtype(idf, col: str) -> str:
+    """Declared dtype name of one column (reference utils.py:68-76)."""
+    if hasattr(idf, "dtypes") and callable(idf.dtypes):
+        return dict(idf.dtypes())[col]
+    return str(idf[col].dtype)
+
+
+def flatten_dataframe(idf, fixed_cols):
+    """Melt every column not in ``fixed_cols`` into key/value rows
+    (reference utils.py:6-26).  Stats frames are pandas here, so this is a
+    host-side reshape; device Tables export via ``to_pandas`` first."""
+    import pandas as pd
+
+    pdf = idf.to_pandas() if hasattr(idf, "to_pandas") else idf
+    return pd.melt(
+        pdf,
+        id_vars=list(fixed_cols),
+        value_vars=[c for c in pdf.columns if c not in set(fixed_cols)],
+        var_name="key",
+        value_name="value",
+    )
+
+
+def transpose_dataframe(idf, fixed_col):
+    """Values of ``fixed_col`` become the header row (reference utils.py:29-45).
+
+    All-NaN attributes stay as null rows (dropna=False) and rows keep the
+    source column order rather than pivot_table's alphabetical sort."""
+    flat = flatten_dataframe(idf, fixed_cols=[fixed_col])
+    pdf = idf.to_pandas() if hasattr(idf, "to_pandas") else idf
+    key_order = [c for c in pdf.columns if c != fixed_col]
+    return (
+        flat.pivot_table(index="key", columns=fixed_col, values="value", aggfunc="first", dropna=False)
+        .reindex(key_order)
+        .reset_index()
+        .rename_axis(None, axis=1)
+    )
