@@ -121,8 +121,8 @@ def transpose_dataframe(idf, fixed_col):
 
     All-NaN attributes stay as null rows (dropna=False) and rows keep the
     source column order rather than pivot_table's alphabetical sort."""
-    flat = flatten_dataframe(idf, fixed_cols=[fixed_col])
     pdf = idf.to_pandas() if hasattr(idf, "to_pandas") else idf
+    flat = flatten_dataframe(pdf, fixed_cols=[fixed_col])
     key_order = [c for c in pdf.columns if c != fixed_col]
     return (
         flat.pivot_table(index="key", columns=fixed_col, values="value", aggfunc="first", dropna=False)

@@ -210,3 +210,26 @@ def test_feast_field_helpers():
     assert 'name="age"' in line and "Int64" in line
     assert generate_fields([("age", "int"), ("id", "string")], ["id"]) == generate_field("age", "Int64")
     assert "from feast import" in generate_prefix()
+
+
+def test_shared_utils_reshapes():
+    from anovos_tpu.shared.utils import (
+        attributeType_segregation,
+        flatten_dataframe,
+        get_dtype,
+        transpose_dataframe,
+    )
+
+    df = pd.DataFrame({"attribute": ["a", "b"], "mean": [1.0, 2.0], "skew": [np.nan, np.nan]})
+    flat = flatten_dataframe(df, ["attribute"])
+    assert set(flat.columns) == {"attribute", "key", "value"} and len(flat) == 4
+    t = transpose_dataframe(df, "attribute")
+    assert list(t["key"]) == ["mean", "skew"]  # source order, all-NaN row kept
+    assert list(t.columns) == ["key", "a", "b"]
+    assert float(t.loc[t["key"] == "mean", "a"].iloc[0]) == 1.0
+    assert attributeType_segregation(df) == (["mean", "skew"], ["attribute"], [])
+    assert get_dtype(df, "mean") == "float64"
+    tbl = Table.from_pandas(pd.DataFrame({"x": [1.0, 2.0], "c": ["u", "v"]}))
+    assert attributeType_segregation(tbl) == (["x"], ["c"], [])
+    flat_tbl = flatten_dataframe(tbl, ["c"])
+    assert set(flat_tbl["key"]) == {"x"}

@@ -60,3 +60,28 @@ def test_configs_sales_supervised(tmp_path, monkeypatch):
     assert len(iv) > 3
     # supervised encoding happened before associations
     assert (out / "output" / "final_dataset" / "_SUCCESS").exists()
+
+
+def test_configs_concat_join_stages(tmp_path, monkeypatch):
+    """configs.yaml's concatenate_dataset/join_dataset blocks (reference
+    config/configs.yaml) drive the ETL helper + ingest ops end to end:
+    concat doubles the rows, the avro join attaches the dupl_* columns."""
+    with open(os.path.join(CONFIG_DIR, "configs.yaml")) as f:
+        cfg = yaml.safe_load(f)
+    monkeypatch.chdir(tmp_path)
+    from anovos_tpu.data_ingest import data_ingest
+
+    base = workflow.ETL(cfg["input_dataset"])
+    cat = cfg["concatenate_dataset"]
+    idfs = [base] + [workflow.ETL(cat[k]) for k in cat if k not in ("method", "method_type")]
+    df = data_ingest.concatenate_dataset(*idfs, method_type=cat["method"])
+    assert df.nrows == 2 * base.nrows
+    jn = cfg["join_dataset"]
+    joined = data_ingest.join_dataset(
+        df,
+        *[workflow.ETL(jn[k]) for k in jn if k not in ("join_type", "join_cols")],
+        join_cols=jn["join_cols"],
+        join_type=jn["join_type"],
+    )
+    assert {"dupl_age", "dupl_workclass"} <= set(joined.col_names)
+    assert joined.nrows > 0
