@@ -334,14 +334,16 @@ def cluster_analysis(
         # sklearn scan — and unscaled was both wrong and 6× slower)
         sub = sub[np.random.default_rng(2).choice(len(sub), grid_cap, replace=False)]
     frac = len(sub) / max(len(pts), 1)
-    from anovos_tpu.ops.cluster import neighbor_counts
+    from anovos_tpu.ops.cluster import dbscan_grid, neighbor_counts
 
+    ms_values = list(range(m0, m1 + 1, mstep))
+    ms_eff = [max(2, int(round(m * frac))) for m in ms_values]
     for e in np.arange(e0, e1 + 1e-9, estep):
-        # one neighbor-count pass per eps, shared by every min_samples
+        # one neighbor-count pass per eps; all min_samples labeled in ONE
+        # batched device program (fixed shapes — one compile for the grid)
         counts = neighbor_counts(sub, float(e))
-        for m in range(m0, m1 + 1, mstep):
-            m_eff = max(2, int(round(m * frac)))
-            labels = dbscan_fit(sub, float(e), m_eff, counts=counts)
+        labels_b = dbscan_grid(sub, float(e), ms_eff, counts=counts)
+        for m, labels in zip(ms_values, labels_b):
             n_clusters = len(set(labels[labels >= 0]))
             score = _silhouette(sub, labels) if n_clusters >= 2 else -1.0
             rows.append(

@@ -16,6 +16,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# TPU MXU f32 matmuls default to bf16 inputs; the quadratic distance
+# expansion then misjudges within-eps adjacency by orders of magnitude at
+# lat/lon-scale coordinates.  Every distance/center matmul pins true f32.
+_HI = jax.lax.Precision.HIGHEST
+
 
 @functools.partial(jax.jit, static_argnames=("k", "iters"))
 def kmeans_fit(X: jax.Array, k: int, iters: int = 50, seed: int = 0) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -28,18 +33,28 @@ def kmeans_fit(X: jax.Array, k: int, iters: int = 50, seed: int = 0) -> Tuple[ja
     def dists(C):
         # (n, k) squared distances via matmul expansion (MXU)
         return (
-            (X**2).sum(1, keepdims=True) - 2 * X @ C.T + (C**2).sum(1)[None, :]
+            (X**2).sum(1, keepdims=True) - 2 * jnp.matmul(X, C.T, precision=_HI) + (C**2).sum(1)[None, :]
         )
 
-    def body(_, C):
+    def step(C):
         D = dists(C)
         lbl = jnp.argmin(D, axis=1)
         onehot = jax.nn.one_hot(lbl, k, dtype=X.dtype)  # (n, k)
         counts = onehot.sum(0)
-        sums = onehot.T @ X  # (k, d)
+        sums = jnp.matmul(onehot.T, X, precision=_HI)  # (k, d)
         return jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1), C)
 
-    centers = jax.lax.fori_loop(0, iters, body, centers0)
+    def cond(state):
+        i, _, moved = state
+        return moved & (i < iters)
+
+    def body(state):
+        i, C, _ = state
+        Cn = step(C)
+        # device-side convergence: stop when no center moves beyond f32 noise
+        return i + 1, Cn, jnp.any(jnp.abs(Cn - C) > 1e-6 * (1.0 + jnp.abs(C)))
+
+    _, centers, _ = jax.lax.while_loop(cond, body, (0, centers0, jnp.asarray(True)))
     D = dists(centers)
     labels = jnp.argmin(D, axis=1)
     inertia = jnp.take_along_axis(D, labels[:, None], axis=1).sum()
@@ -65,18 +80,27 @@ def _kmeans_inertia_sweep(X: jax.Array, max_k: int, iters: int = 50, seed: int =
         act = jnp.arange(max_k) < active_k  # (max_k,)
 
         def dists(C):
-            D = (X**2).sum(1, keepdims=True) - 2 * X @ C.T + (C**2).sum(1)[None, :]
+            D = (X**2).sum(1, keepdims=True) - 2 * jnp.matmul(X, C.T, precision=_HI) + (C**2).sum(1)[None, :]
             return jnp.where(act[None, :], D, jnp.inf)
 
-        def body(_, C):
+        def step(C):
             D = dists(C)
             lbl = jnp.argmin(D, axis=1)
             onehot = jax.nn.one_hot(lbl, max_k, dtype=X.dtype)
             counts = onehot.sum(0)
-            sums = onehot.T @ X
+            sums = jnp.matmul(onehot.T, X, precision=_HI)
             return jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1), C)
 
-        centers = jax.lax.fori_loop(0, iters, body, centers0)
+        def cond(state):
+            i, _, moved = state
+            return moved & (i < iters)
+
+        def body(state):
+            i, C, _ = state
+            Cn = step(C)
+            return i + 1, Cn, jnp.any(jnp.abs(Cn - C) > 1e-6 * (1.0 + jnp.abs(C)))
+
+        _, centers, _ = jax.lax.while_loop(cond, body, (0, centers0, jnp.asarray(True)))
         D = dists(centers)
         return jnp.maximum(D.min(axis=1).sum(), 0.0)
 
@@ -89,7 +113,10 @@ def _kmeans_inertia_sweep(X: jax.Array, max_k: int, iters: int = 50, seed: int =
 def kmeans_elbow(X: np.ndarray, max_k: int = 20, seed: int = 0) -> Tuple[int, np.ndarray]:
     """Pick k by the knee of the inertia curve (reference's elbow method).
     One XLA compile + one dispatch for the whole 1..max_k scan."""
-    Xd = jnp.asarray(X, jnp.float32)
+    # center: inertia is translation-invariant and the quadratic expansion
+    # loses f32 bits to the coordinate magnitude, not the spread
+    X = np.asarray(X, np.float32)
+    Xd = jnp.asarray(X - X.mean(axis=0, keepdims=True), jnp.float32)
     ks = list(range(1, max(2, max_k) + 1))
     inertias = np.asarray(_kmeans_inertia_sweep(Xd, ks[-1], seed=seed), np.float64)
     if len(inertias) < 3:
@@ -105,7 +132,7 @@ def kmeans_elbow(X: np.ndarray, max_k: int = 20, seed: int = 0) -> Tuple[int, np
 
 @functools.partial(jax.jit, static_argnames=())
 def _neighbor_counts_tile(Xq: jax.Array, Xs: jax.Array, eps2: jax.Array) -> jax.Array:
-    D = (Xq**2).sum(1, keepdims=True) - 2 * Xq @ Xs.T + (Xs**2).sum(1)[None, :]
+    D = (Xq**2).sum(1, keepdims=True) - 2 * jnp.matmul(Xq, Xs.T, precision=_HI) + (Xs**2).sum(1)[None, :]
     return (D <= eps2).sum(axis=1)
 
 
@@ -113,7 +140,8 @@ def neighbor_counts(X: np.ndarray, eps: float, tile: int = 4096) -> np.ndarray:
     """Within-eps neighbor count per point (incl. self) — the count pass
     dbscan_fit uses; public so a hyperparameter grid can compute it once per
     eps and share it across every min_samples."""
-    Xd = jnp.asarray(X, jnp.float32)
+    X = np.asarray(X, np.float32)
+    Xd = jnp.asarray(X - X.mean(axis=0, keepdims=True), jnp.float32)  # magnitude → spread
     eps2 = jnp.asarray(eps * eps, jnp.float32)
     return np.concatenate(
         [np.asarray(_neighbor_counts_tile(Xd[s : s + tile], Xd, eps2)) for s in range(0, len(X), tile)]
@@ -123,7 +151,7 @@ def neighbor_counts(X: np.ndarray, eps: float, tile: int = 4096) -> np.ndarray:
 @functools.partial(jax.jit, static_argnames=())
 def _nearest_core_tile(Xq: jax.Array, Xs: jax.Array, eps2: jax.Array):
     """Nearest within-eps fit-set point per query row: (index, hit)."""
-    D = (Xq**2).sum(1, keepdims=True) - 2 * Xq @ Xs.T + (Xs**2).sum(1)[None, :]
+    D = (Xq**2).sum(1, keepdims=True) - 2 * jnp.matmul(Xq, Xs.T, precision=_HI) + (Xs**2).sum(1)[None, :]
     Dm = jnp.where(D <= eps2, D, jnp.inf)
     idx = jnp.argmin(Dm, axis=1)
     return idx, jnp.isfinite(jnp.take_along_axis(Dm, idx[:, None], axis=1)[:, 0])
@@ -153,7 +181,7 @@ def _propagate_labels(
             Xq = jax.lax.dynamic_slice_in_dim(Xc, s, tile)
             lq = jax.lax.dynamic_slice_in_dim(lab, s, tile)
             vq = jax.lax.dynamic_slice_in_dim(valid, s, tile)
-            D = (Xq**2).sum(1, keepdims=True) - 2 * Xq @ Xc.T + (Xc**2).sum(1)[None, :]
+            D = (Xq**2).sum(1, keepdims=True) - 2 * jnp.matmul(Xq, Xc.T, precision=_HI) + (Xc**2).sum(1)[None, :]
             nbr = jnp.where((D <= eps2) & valid[None, :], lab[None, :], jnp.inf)
             return jnp.where(vq, jnp.minimum(lq, nbr.min(axis=1)), lq)
 
@@ -190,6 +218,146 @@ def _cell_clique_seed(Xc_host: np.ndarray, eps: float) -> np.ndarray:
     return seed[inv].astype(np.float32)
 
 
+@functools.partial(jax.jit, static_argnames=("tile", "max_iter"))
+def _dbscan_batch(
+    Xp: jax.Array,      # (n_pad, d) padded points
+    pmask: jax.Array,   # (n_pad,) real-point mask
+    eps2: jax.Array,
+    coreB: jax.Array,   # (B, n_pad) per-labeling core masks
+    lab0B: jax.Array,   # (B, n_pad) f32 seed labels
+    tile: int,
+    max_iter: int,
+):
+    """B DBSCAN labelings over ONE point set and eps in ONE program.
+
+    A hyperparameter grid varies min_samples at fixed eps; the core sets
+    differ but the geometry doesn't, so each distance tile is computed once
+    and every labeling's masked min rides it (``lax.map`` over B keeps the
+    (tile, n) temporaries sequential).  Shapes are independent of the core
+    counts, so one compile serves the whole (eps × min_samples) grid — the
+    per-combo ``dbscan_fit`` re-specialized on every core-set size and the
+    35-combo scan spent its wall time in XLA recompiles.
+    Returns ((B, n_pad) labels: component min-index for core, nearest-core
+    label for border, −1 noise; done flag)."""
+    n = Xp.shape[0]
+    B = coreB.shape[0]
+    starts = jnp.arange(n // tile) * tile
+
+    # the within-eps adjacency is loop-invariant: build it ONCE per tile
+    # row-block before the while_loop (n² bools total — why dbscan_grid caps the batched path) instead of re-deriving
+    # the distance matrix every propagation round
+    def adj_tile(s):
+        Xq = jax.lax.dynamic_slice_in_dim(Xp, s, tile)
+        D = (Xq**2).sum(1, keepdims=True) - 2 * jnp.matmul(Xq, Xp.T, precision=_HI) + (Xp**2).sum(1)[None, :]
+        return D <= eps2
+
+    within_all = jax.lax.map(adj_tile, starts)  # (n/tile, tile, n)
+
+    def one_round(labB):
+        def tile_fn(args):
+            s, within = args
+
+            def per_b(bargs):
+                lab, core = bargs
+                lq = jax.lax.dynamic_slice_in_dim(lab, s, tile)
+                cq = jax.lax.dynamic_slice_in_dim(core, s, tile)
+                nbr = jnp.where(within & core[None, :], lab[None, :], jnp.inf).min(axis=1)
+                return jnp.where(cq, jnp.minimum(lq, nbr), lq)
+
+            return jax.lax.map(per_b, (labB, coreB))  # (B, tile)
+
+        new = jax.lax.map(tile_fn, (starts, within_all))  # (n/tile, B, tile)
+        new = jnp.moveaxis(new, 0, 1).reshape(B, n)
+        for _ in range(6):  # pointer jumping per labeling
+            new = jnp.minimum(new, jnp.take_along_axis(new, new.astype(jnp.int32), axis=1))
+        return new
+
+    def cond(state):
+        i, lab, done = state
+        return (~done) & (i < max_iter)
+
+    def body(state):
+        i, lab, _ = state
+        new = one_round(lab)
+        return i + 1, new, jnp.all(new == lab)
+
+    _, labB, done = jax.lax.while_loop(
+        cond, body, (0, one_round(lab0B), jnp.asarray(False))
+    )
+
+    # border points adopt their nearest within-eps core neighbor's label
+    def border_tile(s):
+        Xq = jax.lax.dynamic_slice_in_dim(Xp, s, tile)
+        D = (Xq**2).sum(1, keepdims=True) - 2 * jnp.matmul(Xq, Xp.T, precision=_HI) + (Xp**2).sum(1)[None, :]
+        pq = jax.lax.dynamic_slice_in_dim(pmask, s, tile)
+
+        def per_b(args):
+            lab, core = args
+            lq = jax.lax.dynamic_slice_in_dim(lab, s, tile)
+            cq = jax.lax.dynamic_slice_in_dim(core, s, tile)
+            Dm = jnp.where((D <= eps2) & core[None, :], D, jnp.inf)
+            j = jnp.argmin(Dm, axis=1)
+            hit = jnp.isfinite(jnp.take_along_axis(Dm, j[:, None], axis=1)[:, 0])
+            adopted = jnp.where(hit & pq, lab[j], -1.0)
+            return jnp.where(cq, lq, adopted)
+
+        return jax.lax.map(per_b, (labB, coreB))
+
+    out = jax.lax.map(border_tile, starts)
+    return jnp.moveaxis(out, 0, 1).reshape(B, n), done
+
+
+def dbscan_grid(
+    X: np.ndarray,
+    eps: float,
+    min_samples_list: "list[int]",
+    counts: "np.ndarray | None" = None,
+    tile: int = 4096,
+    max_iter: int = 200,
+) -> np.ndarray:
+    """DBSCAN labels for every min_samples at one eps: (B, n) int labels
+    (−1 noise), one batched device program (see _dbscan_batch).
+
+    The batched program keeps the full n² boolean adjacency resident, so
+    beyond ``ANOVOS_DBSCAN_BATCH_MAX`` points (default 16384, 256 MB) it
+    falls back to per-combo ``dbscan_fit`` whose peak memory is O(tile·n)."""
+    import os
+
+    n = len(X)
+    X = np.asarray(X, np.float32)
+    X = X - X.mean(axis=0, keepdims=True)  # f32 distance bits follow the spread
+    if counts is None:
+        counts = neighbor_counts(X, eps, tile)
+    if n > int(os.environ.get("ANOVOS_DBSCAN_BATCH_MAX", 16384)):
+        return np.stack([dbscan_fit(X, eps, ms, tile, max_iter, counts) for ms in min_samples_list])
+    t = tile if n >= tile else max(256, 1 << max(n - 1, 1).bit_length())
+    n_pad = ((n + t - 1) // t) * t
+    Xp = jnp.full((n_pad, X.shape[1]), 1e9, jnp.float32).at[:n].set(jnp.asarray(X, jnp.float32))
+    pmask = jnp.arange(n_pad) < n
+    coreB = np.zeros((len(min_samples_list), n_pad), bool)
+    for b, ms in enumerate(min_samples_list):
+        coreB[b, :n] = counts >= ms
+    # one cell-clique seed serves every labeling: same-cell points are
+    # pairwise within eps, so same-label CORE points are always connected
+    # regardless of which min_samples made them core
+    seed = _cell_clique_seed(np.asarray(X, np.float32), eps)
+    lab0 = np.concatenate([seed, np.arange(n, n_pad, dtype=np.float32)])
+    lab0B = jnp.asarray(np.broadcast_to(lab0, (len(min_samples_list), n_pad)).copy())
+    labB, done = _dbscan_batch(Xp, pmask, jnp.asarray(eps * eps, jnp.float32), jnp.asarray(coreB), lab0B, t, max_iter)
+    if not bool(done):
+        import warnings
+
+        warnings.warn(f"dbscan_grid: label propagation hit max_iter={max_iter} without converging")
+    labB = np.asarray(labB)[:, :n]
+    out = np.full((len(min_samples_list), n), -1, np.int64)
+    for b in range(len(min_samples_list)):
+        lab = labB[b]
+        hit = lab >= 0
+        if hit.any():
+            out[b, hit] = np.unique(lab[hit], return_inverse=True)[1]
+    return out
+
+
 def dbscan_fit(
     X: np.ndarray,
     eps: float,
@@ -209,6 +377,8 @@ def dbscan_fit(
     neighbor-count pass for every min_samples at the same eps.
     """
     n = len(X)
+    X = np.asarray(X, np.float32)
+    X = X - X.mean(axis=0, keepdims=True)  # f32 distance bits follow the spread
     Xd = jnp.asarray(X, jnp.float32)
     eps2 = jnp.asarray(eps * eps, jnp.float32)
     if counts is None:

@@ -101,6 +101,14 @@ def init_runtime(
     (multi-host over DCN; env-driven coordinator discovery).
     """
     global _RUNTIME
+    # TPU MXU's default f32 matmul precision is bf16 inputs — catastrophic
+    # for the quadratic-expansion distance/covariance kernels (squared lat/lon
+    # magnitudes produced within-eps errors ~800x eps^2).  A stats framework
+    # needs true-f32 matmuls; ANOVOS_MATMUL_PRECISION overrides (e.g. to
+    # "default" for throughput-over-accuracy experiments).
+    jax.config.update(
+        "jax_default_matmul_precision", os.environ.get("ANOVOS_MATMUL_PRECISION", "highest")
+    )
     cache_dir = os.environ.get("ANOVOS_COMPILE_CACHE", "")
     if cache_dir:
         # persistent XLA compilation cache: pipeline stages produce many

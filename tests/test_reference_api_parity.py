@@ -233,3 +233,48 @@ def test_shared_utils_reshapes():
     assert attributeType_segregation(tbl) == (["x"], ["c"], [])
     flat_tbl = flatten_dataframe(tbl, ["c"])
     assert set(flat_tbl["key"]) == {"x"}
+
+
+def test_dbscan_grid_matches_per_combo_fit():
+    from anovos_tpu.ops.cluster import dbscan_fit, dbscan_grid, neighbor_counts
+
+    g = np.random.default_rng(3)
+    # lat/lon-magnitude blobs: the coordinates that exposed the bf16 matmul
+    # precision bug on TPU (distance error >> eps^2 before pinning f32)
+    X = np.concatenate(
+        [g.normal((10, 70), 0.08, (800, 2)), g.normal((12, 75), 0.1, (800, 2)), g.uniform(8, 77, (400, 2))]
+    ).astype(np.float32)
+    counts = neighbor_counts(X, 0.3)
+    grid = dbscan_grid(X, 0.3, [15, 40, 90], counts=counts)
+
+    def canon(l):
+        out = np.full(len(l), -1)
+        seen, nxt = {}, 0
+        for i, v in enumerate(l):
+            if v < 0:
+                continue
+            if v not in seen:
+                seen[v] = nxt
+                nxt += 1
+            out[i] = seen[v]
+        return out
+
+    for b, ms in enumerate([15, 40, 90]):
+        ref = dbscan_fit(X, 0.3, ms, counts=counts)
+        assert ((ref < 0) == (grid[b] < 0)).all()
+        assert (canon(ref) == canon(grid[b])).all()
+    assert len(set(grid[0][grid[0] >= 0])) == 2  # the two blobs separate
+
+
+def test_kmeans_iters_budget():
+    import jax
+    import jax.numpy as jnp
+
+    from anovos_tpu.ops.cluster import kmeans_fit
+
+    g = np.random.default_rng(0)
+    X = jnp.asarray(g.normal(size=(500, 2)).astype(np.float32))
+    cen0, _, _ = kmeans_fit(X, 3, iters=0)
+    # iters=0 must return the seed centers untouched (exact step budget)
+    init = np.asarray(X)[np.asarray(jax.random.choice(jax.random.PRNGKey(0), 500, (3,), replace=False))]
+    assert np.allclose(np.asarray(cen0), init)
