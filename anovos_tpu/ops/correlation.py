@@ -12,6 +12,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from anovos_tpu.ops.reductions import masked_mean
+
 
 @jax.jit
 def masked_corr(X: jax.Array, M: jax.Array) -> jax.Array:
@@ -23,7 +25,12 @@ def masked_corr(X: jax.Array, M: jax.Array) -> jax.Array:
     """
     dt = jnp.float32
     Mf = M.astype(dt)
-    Xm = jnp.where(M, X.astype(dt), 0.0)
+    Xf = X.astype(dt)
+    # pre-center each column by its global masked mean: pairwise-complete
+    # Pearson r is exactly translation-invariant, and without the shift the
+    # n·Sxy − Sx·Sy cancellation loses most f32 bits for large-offset
+    # low-spread columns (a year column came back with r off by 0.06)
+    Xm = jnp.where(M, Xf - masked_mean(Xf, M)[None, :], 0.0)
     X2m = Xm * Xm
     n = Mf.T @ Mf                       # pairwise counts
     Sx = Xm.T @ Mf                      # Sx[a,b] = Σ x_a over both-valid rows
@@ -46,7 +53,10 @@ def masked_cov(X: jax.Array, M: jax.Array) -> jax.Array:
     matching RowMatrix.computeCovariance on complete data."""
     dt = jnp.float32
     Mf = M.astype(dt)
-    Xm = jnp.where(M, X.astype(dt), 0.0)
+    Xf = X.astype(dt)
+    # same pre-centering as masked_corr: covariance is translation-invariant
+    # and the Sxy − SxSy/n cancellation is catastrophic at raw magnitudes
+    Xm = jnp.where(M, Xf - masked_mean(Xf, M)[None, :], 0.0)
     n = Mf.T @ Mf
     Sx = Xm.T @ Mf
     Sxy = Xm.T @ Xm

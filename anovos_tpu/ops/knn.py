@@ -35,8 +35,16 @@ def knn_impute_tile(
     dt = jnp.float32
     mq = Mq.astype(dt)
     ms = Ms.astype(dt)
-    xq = jnp.where(Mq, Xq, 0.0).astype(dt)
-    xs = jnp.where(Ms, Xs, 0.0).astype(dt)
+    # center every feature by the fit-set masked mean before the quadratic
+    # expansion: per-feature differences are translation-invariant, and at
+    # raw magnitudes the x² − 2xy + y² form cancels away most f32 bits
+    # (sklearn computes the same expansion in f64).  Donor VALUES for the
+    # imputation stay uncentered below.
+    from anovos_tpu.ops.reductions import masked_mean
+
+    mu = masked_mean(Xs.astype(dt), Ms)
+    xq = jnp.where(Mq, Xq - mu[None, :], 0.0).astype(dt)
+    xs = jnp.where(Ms, Xs - mu[None, :], 0.0).astype(dt)
     # Σ_both (x−y)² = x²·m_y + m_x·y² − 2 x·y (masked)
     raw = (xq**2 * mq) @ ms.T + mq @ (xs**2 * ms).T - 2.0 * xq @ xs.T
     cnt = mq @ ms.T  # (b, s) overlapping feature counts
