@@ -4,16 +4,20 @@ imports anywhere, so this conftest does it at import time."""
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force off the real TPU tunnel for tests
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+_ON_TPU = os.environ.get("ANOVOS_TEST_TPU", "") == "1"
+
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"  # force off the real TPU tunnel for tests
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 # The container's sitecustomize imports jax at interpreter startup (axon PJRT
 # registration), which latches JAX_PLATFORMS — override via jax.config too.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -26,7 +30,14 @@ def runtime():
     from anovos_tpu.shared.runtime import init_runtime
 
     rt = init_runtime()
-    assert rt.n_devices == 8, f"expected 8 virtual devices, got {rt.n_devices}"
+    if _ON_TPU:
+        # a leftover JAX_PLATFORMS=cpu in the shell would silently turn the
+        # "on-hardware" sweep into a CPU run that misses every TPU-only
+        # numerics class (bf16 MXU inputs, transcendental approximation)
+        plat = jax.devices()[0].platform
+        assert plat != "cpu", f"ANOVOS_TEST_TPU=1 but jax backend is {plat}"
+    else:
+        assert rt.n_devices == 8, f"expected 8 virtual devices, got {rt.n_devices}"
     return rt
 
 
