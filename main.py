@@ -1,14 +1,25 @@
 """CLI entry (reference: src/main/main.py:6-13):
-``python main.py <config.yaml> <run_type> [auth_key]``."""
+``python main.py <config.yaml> <run_type> [auth_key_json]``."""
 
+import json
 import sys
 
 from anovos_tpu import workflow
 
 if __name__ == "__main__":
     if len(sys.argv) < 2:
-        sys.exit("usage: python main.py <config.yaml> [run_type] [auth_key]")
+        sys.exit("usage: python main.py <config.yaml> [run_type] [auth_key_json]")
     config_path = sys.argv[1]
     run_type = sys.argv[2] if len(sys.argv) > 2 else "local"
-    auth_key_val = {"auth_key": sys.argv[3]} if len(sys.argv) > 3 else {}
+    if len(sys.argv) > 3:
+        # reference main.py:10 passes a JSON dict; anything else (bare token,
+        # JSON scalar) is wrapped so workflow.run always receives a dict
+        try:
+            auth_key_val = json.loads(sys.argv[3])
+        except json.JSONDecodeError:
+            auth_key_val = {"auth_key": sys.argv[3]}
+        if not isinstance(auth_key_val, dict):
+            auth_key_val = {"auth_key": sys.argv[3]}
+    else:
+        auth_key_val = {}
     workflow.run(config_path, run_type, auth_key_val)
