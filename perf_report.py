@@ -1,6 +1,7 @@
 """Performance report: PSI drift micro-bench + configs_full e2e rows/sec +
 Pallas-vs-XLA histogram comparison, with bytes-moved / bandwidth estimates
-per kernel block.  Writes PERF.md and prints a JSON summary.
+per kernel block.  Prints a JSON summary and writes PERF_GENERATED.md
+(PERF_WRITE=1 overwrites the curated PERF.md instead).
 
 Usage:
     python perf_report.py              # default backend (TPU via tunnel)
@@ -302,11 +303,15 @@ def _write_md(r: dict) -> None:
     lines += [
         "",
         "Run `python perf_report.py` (TPU) or `JAX_PLATFORMS=cpu python perf_report.py`",
-        "to regenerate; `PERF_ROWS` scales the drift bench, `PERF_E2E=0` skips the",
+        "to regenerate (writes PERF_GENERATED.md; set PERF_WRITE=1 to overwrite the",
+        "curated PERF.md); `PERF_ROWS` scales the drift bench, `PERF_E2E=0` skips the",
         "end-to-end run.",
         "",
     ]
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "PERF.md"), "w") as f:
+    # PERF.md is the curated record (on-chip numbers + analysis); a default
+    # run must not clobber it with a quick CPU smoke — opt in via PERF_WRITE=1
+    name = "PERF.md" if os.environ.get("PERF_WRITE", "") == "1" else "PERF_GENERATED.md"
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), name), "w") as f:
         f.write("\n".join(lines))
 
 
