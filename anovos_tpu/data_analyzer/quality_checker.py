@@ -538,13 +538,20 @@ def _unique_compact(data: jax.Array, mask: jax.Array):
 
 
 @jax.jit
-def _member_mask(data: jax.Array, mask: jax.Array, sorted_uniq: jax.Array, bad: jax.Array):
+def _member_mask(data: jax.Array, mask: jax.Array, buf: jax.Array, nu: jax.Array, bad_full: jax.Array):
     """Row membership in the bad-value set via searchsorted against the
-    sorted distinct values (one program, no host row data)."""
-    x = data.astype(sorted_uniq.dtype)
-    idx = jnp.clip(jnp.searchsorted(sorted_uniq, x), 0, sorted_uniq.shape[0] - 1)
-    hit = sorted_uniq[idx] == x
-    return mask & hit & bad[idx]
+    compaction buffer's sorted prefix (one program, no host row data).
+
+    ``buf`` is ``_unique_compact``'s FULL fixed-shape buffer with ``nu``
+    valid leading entries — the shape is the padded row count, so every
+    column shares one compiled program (slicing ``buf[:nu]`` per column
+    compiled a fresh program per distinct count)."""
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, buf.dtype)
+    uniq = jnp.where(jnp.arange(buf.shape[0]) < nu, buf, big)
+    x = data.astype(buf.dtype)
+    idx = jnp.clip(jnp.searchsorted(uniq, x), 0, buf.shape[0] - 1)
+    hit = (uniq[idx] == x) & (idx < nu)
+    return mask & hit & bad_full[idx]
 
 
 def invalidEntries_detection(
@@ -588,7 +595,9 @@ def invalidEntries_detection(
             bad_vals = [str(col.vocab[i]) for i in bad_codes]
             lut = np.zeros(max(len(col.vocab), 1), dtype=bool)
             lut[bad_codes] = True
-            inv = col.mask & (col.data >= 0) & jnp.asarray(lut)[jnp.clip(col.data, 0, len(lut) - 1)]
+            from anovos_tpu.ops.segment import vocab_lookup
+
+            inv = col.mask & (col.data >= 0) & vocab_lookup(lut, col.data)
         elif col.is_wide_int:
             # wide int64: exact values require the host pair decode anyway
             host = col.exact_host(idf.nrows)
@@ -613,7 +622,9 @@ def invalidEntries_detection(
             # a full transfer per call on the remote backend, verdict Weak #5)
             buf, nu_d = _unique_compact(col.data, col.mask)
             nu = int(nu_d)
-            uniq = np.asarray(jax.device_get(buf[:nu]))
+            # full-buffer fetch + host slice: a per-nu device slice compiled
+            # a fresh program per distinct count
+            uniq = np.asarray(jax.device_get(buf))[:nu]
             is_int = col.data.dtype in (jnp.int32, jnp.int16, jnp.int8)
             reprs = [str(int(u)) if is_int else str(float(u)) for u in uniq]
             bad_u = np.array(
@@ -621,7 +632,9 @@ def invalidEntries_detection(
                 dtype=bool,
             )
             bad_vals = [r for r, b in zip(reprs, bad_u) if b]
-            inv = _member_mask(col.data, col.mask, buf[:nu], jnp.asarray(bad_u)) if nu else (
+            bad_full = np.zeros(buf.shape[0], dtype=bool)
+            bad_full[:nu] = bad_u
+            inv = _member_mask(col.data, col.mask, buf, nu_d, jnp.asarray(bad_full)) if nu else (
                 col.mask & False
             )
         cnt = int(jnp.sum(inv))

@@ -547,16 +547,18 @@ def _id_codes(idf: Table, id_col: str):
 
     buf, nu_d = _unique_compact(col.data, col.mask)
     nu = int(nu_d)
-    uniq = buf[:nu]
-    codes = _codes_via_search(col.data, uniq)
-    return codes, col.mask, np.asarray(jax.device_get(uniq))
+    # full fixed-shape buffer through the program + host-side slice: a
+    # per-nu device slice re-specialized XLA for every distinct count
+    codes = _codes_via_search(col.data, buf, nu_d)
+    return codes, col.mask, np.asarray(jax.device_get(buf))[:nu]
 
 
 @jax.jit
-def _codes_via_search(data, sorted_uniq):
-    x = data.astype(sorted_uniq.dtype)
-    nv = sorted_uniq.shape[0]
-    idx = jnp.clip(jnp.searchsorted(sorted_uniq, x), 0, max(nv - 1, 0))
+def _codes_via_search(data, buf, nu):
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, buf.dtype)
+    uniq = jnp.where(jnp.arange(buf.shape[0]) < nu, buf, big)
+    x = data.astype(buf.dtype)
+    idx = jnp.clip(jnp.searchsorted(uniq, x), 0, buf.shape[0] - 1)
     return idx.astype(jnp.int32)
 
 

@@ -354,9 +354,9 @@ def cat_to_num_unsupervised(
         for j, v in enumerate(col.vocab):
             if str(v) in mp:
                 code_map[j] = mp[str(v)]
-        idx = jnp.where(
-            col.data >= 0, jnp.asarray(code_map)[jnp.clip(col.data, 0, len(code_map) - 1)], -1
-        )
+        from anovos_tpu.ops.segment import vocab_lookup
+
+        idx = jnp.where(col.data >= 0, vocab_lookup(code_map, col.data), -1)
         valid = col.mask & (idx >= 0)
         if method_type == "label_encoding":
             new_cols[c] = Column("num", jnp.where(valid, idx, 0).astype(jnp.int32), valid, dtype_name="int")
@@ -414,11 +414,12 @@ def cat_to_num_supervised(
             model_rows[c] = pd.DataFrame(
                 {c: [str(v) for v in col.vocab], c + "_encoded": rates.astype(np.float64)}
             )
-        rv = jnp.asarray(np.nan_to_num(rates, nan=0.0))
+        from anovos_tpu.ops.segment import vocab_lookup
+
         valid_code = col.data >= 0
-        nanmask = jnp.asarray(~np.isnan(rates)) if len(rates) else jnp.zeros((1,), bool)
-        ok = col.mask & valid_code & nanmask[jnp.clip(col.data, 0, vsize - 1)]
-        enc = jnp.where(ok, rv[jnp.clip(col.data, 0, vsize - 1)], 0.0)
+        nanmask_h = ~np.isnan(rates) if len(rates) else np.zeros(1, bool)
+        ok = col.mask & valid_code & vocab_lookup(nanmask_h, col.data)
+        enc = jnp.where(ok, vocab_lookup(np.nan_to_num(rates, nan=0.0), col.data), 0.0)
         new_cols[c] = Column("num", enc.astype(jnp.float32), ok, dtype_name="double")
     if not pre_existing_model and model_path != "NA":
         for c, dfm in model_rows.items():

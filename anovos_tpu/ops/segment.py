@@ -37,14 +37,16 @@ def masked_nunique(X: jax.Array, M: jax.Array) -> jax.Array:
     return (trans & valid).sum(axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("vocab_size",))
-def code_counts(codes: jax.Array, M: jax.Array, vocab_size: int) -> jax.Array:
-    """Frequency of each dictionary code for ONE categorical column.
+def _bucket_segments(n: int) -> int:
+    """Static segment counts round up to 2^k size classes (min 8): every
+    vocab size in a table then reuses ONE compiled program per row shape —
+    unbucketed, a 19-column describe compiled code_counts 16 times on
+    identical array shapes, seconds of remote XLA each on the tunnel."""
+    return max(8, 1 << (max(n, 1) - 1).bit_length())
 
-    codes: (rows,) int32 with -1 for null; M: (rows,) bool.
-    Returns (vocab_size,) counts.  segment_sum keyed by code — the histogram
-    kernel of the framework (null contributes nothing).
-    """
+
+@functools.partial(jax.jit, static_argnames=("vocab_size",))
+def _code_counts_p(codes: jax.Array, M: jax.Array, vocab_size: int) -> jax.Array:
     valid = M & (codes >= 0)
     safe = jnp.where(valid, codes, 0)
     return jax.ops.segment_sum(
@@ -52,17 +54,53 @@ def code_counts(codes: jax.Array, M: jax.Array, vocab_size: int) -> jax.Array:
     )
 
 
+def code_counts(codes: jax.Array, M: jax.Array, vocab_size: int) -> jax.Array:
+    """Frequency of each dictionary code for ONE categorical column.
+
+    codes: (rows,) int32 with -1 for null; M: (rows,) bool.
+    Returns (vocab_size,) counts.  segment_sum keyed by code — the histogram
+    kernel of the framework (null contributes nothing)."""
+    return _code_counts_p(codes, M, _bucket_segments(vocab_size))[:vocab_size]
+
+
 @functools.partial(jax.jit, static_argnames=("vocab_size",))
-def code_label_counts(
+def _code_label_counts_p(
     codes: jax.Array, M: jax.Array, y: jax.Array, vocab_size: int
 ) -> jax.Array:
-    """Per-code sum of a row weight/label (event counts for IV, target
-    encoding).  Returns (vocab_size,)."""
     valid = M & (codes >= 0)
     safe = jnp.where(valid, codes, 0)
     return jax.ops.segment_sum(
         jnp.where(valid, y, 0.0).astype(jnp.float32), safe, num_segments=vocab_size
     )
+
+
+def code_label_counts(
+    codes: jax.Array, M: jax.Array, y: jax.Array, vocab_size: int
+) -> jax.Array:
+    """Per-code sum of a row weight/label (event counts for IV, target
+    encoding).  Returns (vocab_size,)."""
+    return _code_label_counts_p(codes, M, y, _bucket_segments(vocab_size))[:vocab_size]
+
+
+@jax.jit
+def _lut_gather(lut: jax.Array, codes: jax.Array) -> jax.Array:
+    return lut[jnp.clip(codes, 0, lut.shape[0] - 1)]
+
+
+def vocab_lookup(lut_host, codes: jax.Array) -> jax.Array:
+    """Per-code lookup through a small host-built table.
+
+    The LUT is padded to a 2^k size class so every vocab size shares one
+    compiled gather per row shape (eagerly indexing ``jnp.asarray(lut)[codes]``
+    per column compiled ~70 distinct gather programs across an e2e run).
+    Codes are clipped; callers keep their own null/validity masking."""
+    import numpy as np
+
+    lut_host = np.asarray(lut_host)
+    p = _bucket_segments(len(lut_host))
+    if p > len(lut_host):
+        lut_host = np.concatenate([lut_host, np.zeros(p - len(lut_host), lut_host.dtype)])
+    return _lut_gather(jnp.asarray(lut_host), codes)
 
 
 def mode_from_counts(counts: jax.Array) -> Tuple[jax.Array, jax.Array]:
