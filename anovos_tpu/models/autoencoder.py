@@ -14,6 +14,15 @@ an explicit parameter pytree so the layout can be sharded over a
 
 Training is a jitted ``lax.scan``-free minibatch loop (one jit per step,
 donated optimizer state) — the whole dataset stays device-resident.
+
+Mixed precision: on TPU the dense matmuls run with bfloat16 inputs and
+float32 accumulation (``preferred_element_type``) — the MXU's native mode —
+while master weights, optimizer state, batch-norm statistics and the loss
+stay float32.  This is the standard recipe for dense nets and is safe here
+(the on-hardware sweep that showed bf16 corrupting *distance/covariance*
+expansions — commit e7e831c — does not apply: those are quadratic
+cancellation-prone forms; an AE layer is a plain affine map).  Control it
+with ``compute_dtype=`` ("bf16" | "f32" | "auto") or ``ANOVOS_AE_COMPUTE``.
 """
 
 from __future__ import annotations
@@ -52,13 +61,58 @@ def _bn_init(n, dtype=jnp.float32):
 _LAYERS = ("enc1", "enc2", "bottleneck", "dec1", "dec2", "out")
 
 
+def _resolve_compute_dtype(requested: str):
+    """Precedence: explicit constructor arg > ANOVOS_AE_COMPUTE env > auto
+    (bf16 on TPU — the MXU's native mode — f32 elsewhere)."""
+    req = (requested or "auto").lower()
+    if req == "auto":
+        req = os.environ.get("ANOVOS_AE_COMPUTE", "auto").lower()
+    if req == "auto":
+        req = "bf16" if jax.default_backend() == "tpu" else "f32"
+    return jnp.bfloat16 if req in ("bf16", "bfloat16") else None
+
+
+def _dense(x, layer, compute_dtype):
+    """x @ w + b with optional bf16 inputs / f32 accumulation.
+
+    ``preferred_element_type=float32`` keeps the MXU accumulating in f32 and
+    propagates through the dot's transpose rule, so gradients accumulate in
+    f32 too; the bias add and everything downstream stay f32.
+    """
+    w = layer["w"]
+    if compute_dtype is not None:
+        y = jnp.matmul(
+            x.astype(compute_dtype),
+            w.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        y = x @ w
+    return y + layer["b"]
+
+
 class AutoEncoder:
     """n → 2n → n → k → n → 2n → n symmetric AE."""
 
-    def __init__(self, n_inputs: int, n_bottleneck: int, seed: int = 0):
+    def __init__(
+        self,
+        n_inputs: int,
+        n_bottleneck: int,
+        seed: int = 0,
+        compute_dtype: str = "auto",
+    ):
         self.n_inputs = int(n_inputs)
         self.n_bottleneck = int(n_bottleneck)
         self.seed = seed
+        self._requested_dtype = compute_dtype
+        self._compute_dtype_cache = ()
+
+    @property
+    def compute_dtype(self):
+        """Resolved lazily so constructing an AE never forces backend init."""
+        if self._compute_dtype_cache == ():
+            self._compute_dtype_cache = _resolve_compute_dtype(self._requested_dtype)
+        return self._compute_dtype_cache
 
     # -- parameters ------------------------------------------------------
     def init_params(self) -> Dict:
@@ -104,10 +158,9 @@ class AutoEncoder:
         return shardings
 
     # -- forward ---------------------------------------------------------
-    @staticmethod
-    def _block(x, layer, train: bool, momentum: float = 0.99):
+    def _block(self, x, layer, train: bool, momentum: float = 0.99):
         """Dense → BatchNorm → LeakyReLU; returns (y, updated_bn)."""
-        h = x @ layer["w"] + layer["b"]
+        h = _dense(x, layer, self.compute_dtype)
         bn = layer["bn"]
         if train:
             mu = h.mean(axis=0)
@@ -131,7 +184,7 @@ class AutoEncoder:
         new_params["enc1"] = {**params["enc1"], "bn": bn}
         h, bn = self._block(h, params["enc2"], train)
         new_params["enc2"] = {**params["enc2"], "bn": bn}
-        z = h @ params["bottleneck"]["w"] + params["bottleneck"]["b"]
+        z = _dense(h, params["bottleneck"], self.compute_dtype)
         return z, new_params
 
     def forward(self, params: Dict, x: jax.Array, train: bool = False):
@@ -141,7 +194,7 @@ class AutoEncoder:
         new_params["dec1"] = {**params["dec1"], "bn": bn}
         h, bn = self._block(h, params["dec2"], train)
         new_params["dec2"] = {**params["dec2"], "bn": bn}
-        x_hat = h @ params["out"]["w"] + params["out"]["b"]
+        x_hat = _dense(h, params["out"], self.compute_dtype)
         return x_hat, new_params
 
     def reconstruct(self, params: Dict, x: jax.Array) -> jax.Array:

@@ -9,7 +9,20 @@ single-process pandas implementation of the reference's per-column loop
 ``vs_baseline`` reports speedup over that pandas per-column loop — a
 conservative stand-in for Spark local[*] driver-side compute.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Robustness contract (learned rounds 1-2: the remote-TPU tunnel can hang
+``jax.devices()`` for minutes, raise UNAVAILABLE, or die mid-run):
+  * the backend probe RETRIES with backoff until a total env-tunable budget
+    (``BENCH_TPU_PROBE_TIMEOUT``, default 600s total) is exhausted;
+  * the measured run itself executes in a bounded subprocess — if the TPU
+    attempt hangs or dies it is retried, then falls back to CPU, so the
+    gate always records a real number, never a 0;
+  * the JSON line carries ``backend`` as a first-class field so a CPU
+    fallback is unmistakable.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "backend",
+...optional e2e fields}.  Set ``BENCH_E2E=1`` to also measure the
+configs_full end-to-end cold+warm rows/sec/chip (BASELINE.md's second
+metric) in the same JSON line.
 """
 
 import glob
@@ -25,14 +38,16 @@ import pandas as pd
 
 TARGET_ROWS = int(os.environ.get("BENCH_ROWS", 4_000_000))
 BIN_SIZE = 10
-PROBE_TIMEOUT = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", 150))
+# total probe budget (was a single-shot 150s in round 2 — the round's number
+# landed on CPU because the flaky tunnel missed its one chance)
+PROBE_TOTAL = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", 600))
+PROBE_ATTEMPT = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPT_TIMEOUT", 150))
+RUN_TIMEOUT = int(os.environ.get("BENCH_RUN_TIMEOUT", 1200))
+E2E_TIMEOUT = int(os.environ.get("BENCH_E2E_TIMEOUT", 2400))
 
 
-def probe_backend(timeout_s: int):
-    """Check in a subprocess (bounded time) whether the default jax backend
-    comes up.  Round 1 died here: the remote-TPU tunnel can hang ``jax.devices()``
-    for minutes or raise UNAVAILABLE (BENCH_r01.json); the bench must record a
-    number either way, so any probe failure → CPU fallback with a diagnostic.
+def probe_backend_once(timeout_s: int):
+    """One bounded subprocess probe of the default jax backend.
 
     Returns (platform_name | None, diagnostic | None).
     """
@@ -44,11 +59,35 @@ def probe_backend(timeout_s: int):
             env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "")},
         )
     except subprocess.TimeoutExpired:
-        return None, f"backend probe timed out after {timeout_s}s"
+        return None, f"probe attempt timed out after {timeout_s}s"
     if r.returncode == 0 and r.stdout.strip():
         return r.stdout.split()[0], None
     err = (r.stderr or "").strip().splitlines()
-    return None, "backend probe failed: " + (err[-1][-300:] if err else f"rc={r.returncode}")
+    return None, "probe failed: " + (err[-1][-300:] if err else f"rc={r.returncode}")
+
+
+def probe_backend(total_budget_s: int, attempt_timeout_s: int):
+    """Retry the backend probe with backoff until the total budget runs out.
+
+    The tunnel is observably flaky-but-recoverable (PERF.md); a single miss
+    must not condemn the round's record to CPU.  Returns
+    (platform | None, diagnostic, attempts).
+    """
+    deadline = time.monotonic() + total_budget_s
+    attempt, diag, backoff = 0, None, 5
+    while time.monotonic() < deadline:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        platform, diag = probe_backend_once(int(min(attempt_timeout_s, max(remaining, 10))))
+        if platform is not None:
+            return platform, None, attempt
+        print(f"bench: probe attempt {attempt} failed ({diag}); "
+              f"{remaining:.0f}s budget left", file=sys.stderr)
+        if time.monotonic() + backoff >= deadline:
+            break
+        time.sleep(backoff)
+        backoff = min(backoff * 2, 60)
+    return None, f"{diag} ({attempt} attempts over {total_budget_s}s)", attempt
 
 
 def load_scaled_income(target_rows: int) -> pd.DataFrame:
@@ -82,36 +121,47 @@ def pandas_reference_psi(src: pd.DataFrame, tgt: pd.DataFrame, bin_size: int) ->
     return out
 
 
-def main() -> None:
-    # ---- bounded-time backend selection (never hang, never traceback) ---
-    platform, diag = probe_backend(PROBE_TIMEOUT)
-    if platform is None:
-        os.environ["JAX_PLATFORMS"] = "cpu"
+def compute_baseline() -> dict:
+    """Pandas reference loop (backend-independent) — run ONCE by the parent
+    and handed to every measured child via BENCH_REF_FILE, so TPU retries and
+    the CPU fallback don't each repay minutes of identical host compute."""
+    df = load_scaled_income(TARGET_ROWS)
+    n = len(df)
+    src_pd = df.iloc[: n // 2].reset_index(drop=True)
+    tgt_pd = df.iloc[n // 2 :].reset_index(drop=True)
+    t0 = time.perf_counter()
+    ref = pandas_reference_psi(src_pd, tgt_pd, BIN_SIZE)
+    t_ref = time.perf_counter() - t0
+    return {"t_ref": t_ref, "ref": ref}
+
+
+def measure() -> None:
+    """Child-process entry: run the actual measurement on whatever backend
+    JAX_PLATFORMS selects, print one JSON line on stdout."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     df = load_scaled_income(TARGET_ROWS)
     n = len(df)
     src_pd = df.iloc[: n // 2].reset_index(drop=True)
     tgt_pd = df.iloc[n // 2 :].reset_index(drop=True)
 
-    # ---- pandas reference loop (measured baseline) ----------------------
-    t0 = time.perf_counter()
-    ref = pandas_reference_psi(src_pd, tgt_pd, BIN_SIZE)
-    t_ref = time.perf_counter() - t0
-
-    # ---- anovos_tpu ------------------------------------------------------
-    import jax  # noqa: E402  (after env decided above)
-
-    if platform is None:
-        # sitecustomize may have imported jax already; env alone isn't enough
-        jax.config.update("jax_platforms", "cpu")
-        backend_note = f"cpu-fallback ({diag})"
+    ref_file = os.environ.get("BENCH_REF_FILE")
+    if ref_file and os.path.exists(ref_file):
+        with open(ref_file) as f:
+            blob = json.load(f)
+        ref, t_ref = blob["ref"], blob["t_ref"]
     else:
-        backend_note = platform
+        blob = compute_baseline()
+        ref, t_ref = blob["ref"], blob["t_ref"]
 
     from anovos_tpu.shared import Table, init_runtime
     from anovos_tpu.drift_stability import statistics
 
     init_runtime()
+    backend = jax.default_backend()
     src = Table.from_pandas(src_pd)
     tgt = Table.from_pandas(tgt_pd)
 
@@ -132,25 +182,161 @@ def main() -> None:
 
     # sanity: PSI values must agree with the reference loop
     ours = dict(zip(odf["attribute"], odf["PSI"]))
-    for col, v in ref.items():
-        if col in ours and abs(ours[col] - v) > 0.05:
-            print(f"WARNING: PSI mismatch on {col}: {ours[col]} vs {v}", file=sys.stderr)
+    mismatches = [c for c, v in ref.items() if c in ours and abs(ours[c] - v) > 0.05]
+    for col in mismatches:
+        print(f"WARNING: PSI mismatch on {col}: {ours[col]} vs {ref[col]}", file=sys.stderr)
 
-    rows_per_sec = n / t_tpu
-    print(
-        json.dumps(
-            {
-                "metric": "psi_drift_rows_per_sec",
-                "value": round(rows_per_sec, 1),
-                "unit": f"rows/s ({n} rows, {len(ref)} cols, wall {t_tpu:.3f}s on {backend_note}; "
-                        f"pandas-loop baseline {t_ref:.3f}s)",
-                "vs_baseline": round(t_ref / t_tpu, 3),
-            }
+    print(json.dumps({
+        "metric": "psi_drift_rows_per_sec",
+        "value": round(n / t_tpu, 1),
+        "unit": f"rows/s ({n} rows, {len(ref)} cols, wall {t_tpu:.3f}s; "
+                f"pandas-loop baseline {t_ref:.3f}s)",
+        "vs_baseline": round(t_ref / t_tpu, 3),
+        "backend": backend,
+        "psi_ok": not mismatches,
+    }))
+
+
+E2E_CONFIG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "config", "configs_full.yaml")
+E2E_ROWS = 32561  # income dataset
+
+
+def e2e_cold_warm() -> dict:
+    """configs_full end-to-end, cold then warm in ONE process so the warm
+    pass reuses every compiled program — that is the framework's actual
+    steady-state claim (cold wall is a remote-compile environment artifact;
+    see PERF.md).  Shared with perf_report.py."""
+    import tempfile
+
+    import jax
+
+    from anovos_tpu import workflow
+
+    out = {}
+    cwd = os.getcwd()
+    for label in ("cold", "warm"):
+        with tempfile.TemporaryDirectory() as d:
+            os.chdir(d)
+            try:
+                t0 = time.perf_counter()
+                workflow.run(E2E_CONFIG, "local")
+                out[label] = round(time.perf_counter() - t0, 1)
+            finally:
+                os.chdir(cwd)
+    return {
+        "e2e_cold_s": out["cold"],
+        "e2e_warm_s": out["warm"],
+        "e2e_warm_rows_per_sec_per_chip": round(E2E_ROWS / out["warm"], 1),
+        "e2e_backend": jax.default_backend(),
+    }
+
+
+def measure_e2e() -> None:
+    """Child-process entry wrapping e2e_cold_warm."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    print(json.dumps(e2e_cold_warm()))
+
+
+def _last_json_line(text: str):
+    for line in reversed((text or "").strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _run_child(mode: str, platforms: str, timeout_s: int):
+    """Run this file in --measure/--measure-e2e mode under a hard timeout.
+
+    Returns (parsed_json | None, diagnostic | None).
+    """
+    env = {**os.environ}
+    if platforms:
+        env["JAX_PLATFORMS"] = platforms
+    # platforms="" → inherit the caller's env untouched, so an explicit
+    # JAX_PLATFORMS=cpu from the user still governs the measured run
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), mode],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
         )
-    )
+    except subprocess.TimeoutExpired:
+        return None, f"measured run timed out after {timeout_s}s"
+    got = _last_json_line(r.stdout)
+    if got is not None:
+        return got, None
+    err = (r.stderr or "").strip().splitlines()
+    return None, "measured run failed: " + (err[-1][-300:] if err else f"rc={r.returncode}")
+
+
+def main() -> None:
+    import tempfile
+
+    # ---- bounded-time backend selection (never hang, never traceback) ---
+    platform, diag, attempts = probe_backend(PROBE_TOTAL, PROBE_ATTEMPT)
+
+    # pandas baseline once, shared with every measured child
+    ref_fd, ref_path = tempfile.mkstemp(suffix=".json")
+    with os.fdopen(ref_fd, "w") as f:
+        json.dump(compute_baseline(), f)
+    os.environ["BENCH_REF_FILE"] = ref_path
+
+    result, note = None, None
+    if platform is not None and platform != "cpu":  # tpu OR the axon plugin name
+        # two bounded attempts on the chip before surrendering to CPU: the
+        # tunnel that just answered the probe can still die mid-run
+        for attempt in (1, 2):
+            result, err = _run_child("--measure", "", RUN_TIMEOUT)
+            if result is not None and str(result.get("backend")) == "cpu":
+                # the child's jax silently fell back to CPU mid-init — that is
+                # NOT an accelerator number; treat it as a failed attempt
+                err, result = "child silently fell back to cpu", None
+            if result is not None:
+                break
+            print(f"bench: TPU measured run attempt {attempt} failed ({err})",
+                  file=sys.stderr)
+            note = err
+    elif platform is not None:
+        result, note = _run_child("--measure", "", RUN_TIMEOUT)
+
+    if result is None:
+        fallback_diag = note or diag or "no accelerator backend"
+        result, err = _run_child("--measure", "cpu", RUN_TIMEOUT)
+        if result is None:
+            raise RuntimeError(f"CPU fallback also failed: {err}")
+        result["backend"] = f"cpu-fallback ({fallback_diag})"
+    result.setdefault("backend", platform or "cpu")
+    result["probe_attempts"] = attempts
+
+    # ---- optional second headline: configs_full e2e (BASELINE.md:22) ----
+    if os.environ.get("BENCH_E2E", "0") == "1":
+        plat = "cpu" if str(result["backend"]).startswith("cpu") else ""
+        e2e, err = _run_child("--measure-e2e", plat, E2E_TIMEOUT)
+        if e2e is not None:
+            result.update(e2e)
+        else:
+            result["e2e_error"] = err
+
+    try:
+        os.unlink(ref_path)
+    except OSError:
+        pass
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure":
+        measure()
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure-e2e":
+        measure_e2e()
+        sys.exit(0)
     try:
         main()
     except Exception:  # never exit without the JSON line (round-1 rc=1 lesson)
@@ -162,6 +348,7 @@ if __name__ == "__main__":
                     "value": 0.0,
                     "unit": "rows/s (FAILED: " + (tb[-1][-300:] if tb else "unknown") + ")",
                     "vs_baseline": 0.0,
+                    "backend": "none",
                 }
             )
         )

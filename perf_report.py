@@ -23,9 +23,17 @@ import numpy as np
 import pandas as pd
 
 ROWS = int(os.environ.get("PERF_ROWS", 4_000_000))
-# peak specs for utilization estimates (per chip)
+# peak specs for utilization estimates (per chip), keyed by generation; the
+# axon tunnel exposes the gen via PALLAS_AXON_TPU_GEN (v5e here).  Round 2
+# reported AE MFU against v4's 137 f32 peak — on the actual v5e chip
+# (197 bf16 / ~98 f32 TFLOP/s, 819 GB/s HBM) that understated utilization.
+TPU_PEAKS = {
+    "v4": {"hbm_gbps": 1228.0, "bf16_tflops": 275.0, "f32_tflops": 137.0},
+    "v5e": {"hbm_gbps": 819.0, "bf16_tflops": 197.0, "f32_tflops": 98.5},
+    "v5p": {"hbm_gbps": 2765.0, "bf16_tflops": 459.0, "f32_tflops": 229.5},
+}
 PEAKS = {
-    "tpu": {"hbm_gbps": 1200.0, "bf16_tflops": 275.0, "f32_tflops": 137.0},  # v4-ish
+    "tpu": TPU_PEAKS.get(os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"), TPU_PEAKS["v5e"]),
     "cpu": {"hbm_gbps": 20.0, "bf16_tflops": 0.2, "f32_tflops": 0.2},
 }
 
@@ -120,7 +128,7 @@ def bench_ae_mfu() -> dict:
         n_inputs, batch = 256, 65536
     else:
         n_inputs, batch = 64, 4096
-    ae = AutoEncoder(n_inputs, n_inputs // 4, seed=0)
+    ae = AutoEncoder(n_inputs, n_inputs // 4, seed=0)  # "auto" → bf16 on TPU
     params = ae.init_params()
     x = jnp.asarray(np.random.default_rng(0).normal(size=(batch, n_inputs)), jnp.float32)
     opt = optax.adam(1e-3)
@@ -142,30 +150,22 @@ def bench_ae_mfu() -> dict:
         "step_s": round(wall, 4),
         "tflops": round(flops / wall / 1e12, 2),
         "shape": f"{batch}x{n_inputs}",
+        "compute": "bf16" if ae.compute_dtype is not None else "f32",
     }
 
 
 def bench_e2e() -> dict:
-    import subprocess
-    import tempfile
+    """Delegates to bench.py's shared cold+warm harness (single source of
+    truth for the configs_full path and row count)."""
+    import bench
 
-    with tempfile.TemporaryDirectory() as d:
-        t0 = time.perf_counter()
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import sys; sys.path.insert(0, '/root/repo'); "
-             "from anovos_tpu import workflow; "
-             "workflow.run('/root/repo/config/configs_full.yaml', 'local')"],
-            cwd=d, capture_output=True, text=True, timeout=1800,
-        )
-        wall = time.perf_counter() - t0
-        ok = r.returncode == 0
-    rows = 32561
+    r = bench.e2e_cold_warm()
     return {
-        "ok": ok,
-        "wall_s": round(wall, 1),
-        "rows_per_sec_per_chip": round(rows / wall, 1),
-        "tail": "" if ok else (r.stderr or "")[-400:],
+        "ok": True,
+        "wall_s": r["e2e_cold_s"],
+        "warm_wall_s": r["e2e_warm_s"],
+        "rows_per_sec_per_chip": round(bench.E2E_ROWS / r["e2e_cold_s"], 1),
+        "warm_rows_per_sec_per_chip": r["e2e_warm_rows_per_sec_per_chip"],
     }
 
 
@@ -238,8 +238,9 @@ def main() -> None:
     results["hist_pallas_vs_xla"] = _run_section("hist")
     results["ae_train"] = _run_section("ae")
     if "tflops" in results["ae_train"]:
+        peak_key = "bf16_tflops" if results["ae_train"].get("compute") == "bf16" else "f32_tflops"
         results["ae_train"]["mfu_pct"] = round(
-            100 * results["ae_train"]["tflops"] / peaks["f32_tflops"], 1
+            100 * results["ae_train"]["tflops"] / peaks[peak_key], 1
         )
     if os.environ.get("PERF_E2E", "1") == "1":
         results["configs_full_e2e"] = _run_section("e2e")
@@ -280,8 +281,9 @@ def _write_md(r: dict) -> None:
             )
         else:
             lines += [
-                f"| AE train step ({ae.get('shape', '?')} batch) | step time | {ae['step_s']} s |",
-                f"| | throughput | {ae['tflops']} TFLOP/s ({mfu}% MFU) |",
+                f"| AE train step ({ae.get('shape', '?')} batch, {ae.get('compute', 'f32')}) "
+                f"| step time | {ae['step_s']} s |",
+                f"| | throughput | {ae['tflops']} TFLOP/s ({mfu}% MFU vs {ae.get('compute', 'f32')} peak) |",
             ]
     else:
         lines.append(f"| AE train step | error | {ae.get('error', '?')[:100]} |")
@@ -296,8 +298,11 @@ def _write_md(r: dict) -> None:
         lines.append(f"| fused histogram | error | {h.get('error', '?')[:100]} |")
     e = r.get("configs_full_e2e")
     if e and "wall_s" in e:
-        lines.append(f"| configs_full e2e (32,561 rows) | wall | {e['wall_s']} s |")
-        lines.append(f"| | rows/sec/chip | {e['rows_per_sec_per_chip']} |")
+        lines.append(f"| configs_full e2e (32,561 rows) | cold wall | {e['wall_s']} s |")
+        lines.append(f"| | cold rows/sec/chip | {e['rows_per_sec_per_chip']} |")
+        if "warm_wall_s" in e:
+            lines.append(f"| | warm wall | {e['warm_wall_s']} s |")
+            lines.append(f"| | warm rows/sec/chip (headline) | {e['warm_rows_per_sec_per_chip']} |")
     elif e:
         lines.append(f"| configs_full e2e | error | {e.get('error', '?')[:100]} |")
     lines += [
