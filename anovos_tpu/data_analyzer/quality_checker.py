@@ -60,7 +60,7 @@ def duplicate_detection(
     sub = idf.select(cols)
     def _hashable(c):
         col = sub.columns[c]
-        if col.is_wide_int:
+        if col.is_wide:
             return [col.wide_hi, col.wide_lo]  # exact pair, no f32 collisions
         if col.kind == "cat" or col.data.dtype != jnp.float32:
             return [col.data.astype(jnp.int32)]

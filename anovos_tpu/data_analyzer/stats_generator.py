@@ -187,18 +187,15 @@ def measures_of_counts(
 def mode_computation(
     idf: Table, list_of_cols="all", drop_cols=[], print_impact=False
 ) -> pd.DataFrame:
-    """[attribute, mode, mode_rows] over discrete (cat + integer) columns
-    (reference :328-421).  mode is string-typed for schema parity."""
-    discrete_all = [
-        c
-        for c in idf.col_names
-        if idf.columns[c].kind == "cat"
-        or (idf.columns[c].kind == "num" and idf.columns[c].dtype_name in _INT_DTYPES)
-    ]
+    """[attribute, mode, mode_rows] (reference :328-421).  mode is
+    string-typed for schema parity.  The reference computes a mode for EVERY
+    column — floats included (groupBy value counts) — so no discreteness
+    filter here; the sorted longest-run kernel handles continuous values."""
+    all_cols = [c for c in idf.col_names if idf.columns[c].kind in ("cat", "num")]
     cols = parse_cols(
-        list_of_cols if list_of_cols != "all" else discrete_all, idf.col_names, drop_cols
+        list_of_cols if list_of_cols != "all" else all_cols, idf.col_names, drop_cols
     )
-    cols = [c for c in cols if c in discrete_all]
+    cols = [c for c in cols if c in all_cols]
     if not cols:
         import warnings
 
@@ -219,7 +216,14 @@ def mode_computation(
         else:
             j = ni[c]
             v = num_out["mode_value"][j]
-            modes.append(None if np.isnan(v) else str(int(v)))
+            if np.isnan(v):
+                modes.append(None)
+            elif idf.columns[c].dtype_name in _INT_DTYPES:
+                modes.append(str(int(v)))
+            else:
+                # float column: string-format the value itself ("36.0"), the
+                # way the reference's string-typed mode schema renders it
+                modes.append(str(float(v)))
             counts.append(int(num_out["mode_count"][j]))
     odf = pd.DataFrame({"attribute": cols, "mode": modes, "mode_rows": counts})
     if print_impact:
@@ -298,7 +302,7 @@ def uniqueCount_computation(
         # ids) to float32 would collapse ~64 consecutive values into one
         def _exact_bits(c):
             col = idf.columns[c]
-            if col.is_wide_int:
+            if col.is_wide:
                 # mix the exact (hi, lo) pair into one int32 lane (golden-ratio
                 # multiply; collision rate 2^-32 ≪ rsd)
                 return col.wide_hi ^ (col.wide_lo * jnp.int32(-1640531527))

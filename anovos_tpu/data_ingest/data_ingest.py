@@ -244,9 +244,9 @@ def _concat_columns(cols: List[Column], nrows: List[int], name: str) -> Column:
         for c, n, cm in zip(cols, nrows, lookups):
             h = np.asarray(jax.device_get(c.data))[:n]
             hosts.append(np.where(h >= 0, cm[np.clip(h, 0, len(cm) - 1)], -1).astype(np.int32))
-    elif any(c.is_wide_int for c in cols):
-        # wide int64 in any slice: keep the exact (hi, lo) pair — nulls ride
-        # the mask, so nullable slices must NOT degrade ids to f32 silently
+    elif any(c.is_wide for c in cols):
+        # wide (exact int64 OR exact float64) in any slice: keep exactness —
+        # nulls ride the mask, so nullable slices must NOT degrade silently
         from anovos_tpu.shared.table import wide_int_parts
 
         total = sum(nrows)
@@ -255,9 +255,9 @@ def _concat_columns(cols: List[Column], nrows: List[int], name: str) -> Column:
             [np.asarray(jax.device_get(c.mask))[:n] for c, n in zip(cols, nrows)]
         )
         int_ok = all(c.is_wide_int or c.data.dtype == jnp.int32 for c in cols)
-        if not int_ok:  # genuinely mixed with float slices: float64 semantics
+        if not int_ok:  # float-wide or mixed with float slices: float64 semantics
             parts = [
-                c.exact_host(n).astype(np.float64) if c.is_wide_int
+                c.exact_host(n).astype(np.float64) if c.is_wide
                 else np.asarray(jax.device_get(c.data))[:n].astype(np.float64)
                 for c, n in zip(cols, nrows)
             ]
@@ -343,6 +343,10 @@ def _host_keys(t: Table, join_cols: List[str]) -> pd.DataFrame:
         elif col.is_wide_int:
             # id-like int64 keys must match exactly — the f32 view collides
             out[c] = pd.arrays.IntegerArray(col.exact_host(t.nrows), ~mask)
+        elif col.is_wide:  # exact float64 keys
+            vals = col.exact_host(t.nrows).copy()
+            vals[~mask] = np.nan
+            out[c] = vals
         else:
             vals = data.astype(np.float64)
             vals[~mask] = np.nan
@@ -487,6 +491,12 @@ def recast_column(idf: Table, list_of_cols, list_of_dtypes, print_impact: bool =
                         np.clip(v, np.iinfo(np.int32).min, np.iinfo(np.int32).max).astype(np.int64),
                         idf.nrows, idf.pad_target(), rt,
                     )
+            elif col.is_wide and dt in ("double", "float64"):
+                # float-wide → double is a no-op recast: keep the exact pair
+                new = Column(
+                    "num", col.data, col.mask, dtype_name="double",
+                    wide_hi=col.wide_hi, wide_lo=col.wide_lo, wide_kind="float",
+                )
             else:
                 new = Column("num", col.data.astype(tgt), col.mask, dtype_name=dt if dt != "integer" else "int")
         elif dt == "string":

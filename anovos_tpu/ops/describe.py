@@ -139,11 +139,20 @@ def describe_wide_int(hi: jax.Array, lo: jax.Array, M: jax.Array) -> Dict[str, j
     }
 
 
-def _wide_pair_to_f64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
-    """Host reconstruction of the exact int64 value as float64 (exact up to
-    2^53, i.e. every realistic id)."""
+def _wide_pair_to_f64(hi: np.ndarray, lo: np.ndarray, kinds=None) -> np.ndarray:
+    """Host reconstruction of the exact value as float64.  kinds is a
+    per-column list over the LAST axis: "int" pairs are the int64 value
+    (exact up to 2^53, i.e. every realistic id); "float" pairs are the
+    order-preserving key of a float64 bit pattern (table.float_order_key)."""
     v = (hi.astype(np.int64) << 32) + (lo.astype(np.int64) + (1 << 31))
-    return v.astype(np.float64)
+    out = v.astype(np.float64)
+    if kinds is not None:
+        from anovos_tpu.shared.table import float_from_order_key
+
+        for j, kind in enumerate(kinds):
+            if kind == "float":
+                out[..., j] = float_from_order_key(v[..., j])
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("max_vocab",))
@@ -187,16 +196,18 @@ def table_describe(idf: Table, num_cols: List[str], cat_cols: List[str]) -> Tupl
     if num_cols:
         X, M = idf.numeric_block(num_cols)
         num_out = {k: np.asarray(v) for k, v in describe_numeric(X, M).items()}
-        wide = [c for c in num_cols if idf.columns[c].is_wide_int]
+        wide = [c for c in num_cols if idf.columns[c].is_wide]
         if wide:
             # overwrite the f32-approximate order stats with exact values
-            # from the (hi, lo) int32-pair kernel (moments stay f32-approx)
+            # from the (hi, lo) int32-pair kernel (moments stay f32-approx);
+            # the lexicographic sort is order-correct for BOTH wide kinds
             Hi = jnp.stack([idf.columns[c].wide_hi for c in wide], axis=1)
             Lo = jnp.stack([idf.columns[c].wide_lo for c in wide], axis=1)
             Mw = jnp.stack([idf.columns[c].mask for c in wide], axis=1)
             w = {kk: np.asarray(v) for kk, v in describe_wide_int(Hi, Lo, Mw).items()}
-            pctl = _wide_pair_to_f64(w["pctl_hi"], w["pctl_lo"])  # (nq, kw)
-            mode = _wide_pair_to_f64(w["mode_hi"], w["mode_lo"])
+            kinds = [idf.columns[c].wide_kind for c in wide]
+            pctl = _wide_pair_to_f64(w["pctl_hi"], w["pctl_lo"], kinds)  # (nq, kw)
+            mode = _wide_pair_to_f64(w["mode_hi"], w["mode_lo"], kinds)
             num_out = {kk: v.copy() for kk, v in num_out.items()}
             for kk in ("percentiles", "min", "max", "mode_value"):
                 num_out[kk] = num_out[kk].astype(np.float64)

@@ -59,27 +59,41 @@ class Column:
     mask: jax.Array  # bool, True = valid
     vocab: Optional[np.ndarray] = None  # host strings, cat only
     dtype_name: str = "double"  # spark-style name for reports
-    wide_hi: Optional[jax.Array] = None  # int32, v >> 32 (wide int64 only)
+    wide_hi: Optional[jax.Array] = None  # int32, v >> 32 of the wide key
     wide_lo: Optional[jax.Array] = None  # int32, (v & 0xffffffff) - 2^31
+    # "int": the wide key IS the int64 value.  "float": the key is the
+    # order-preserving int64 transform of the float64 bit pattern (see
+    # float_order_parts) — attached when a float64 column does not survive
+    # the f32 round-trip, so distinct/mode/percentiles stay exact (the same
+    # failure class as the round-1 id-column bug, but for dense floats like
+    # lat/long whose spacing is below f32 resolution).
+    wide_kind: str = "int"
 
     @property
     def padded_len(self) -> int:
         return self.data.shape[0]
 
     @property
-    def is_wide_int(self) -> bool:
+    def is_wide(self) -> bool:
         return self.wide_hi is not None
+
+    @property
+    def is_wide_int(self) -> bool:
+        return self.wide_hi is not None and self.wide_kind == "int"
 
     def astype_float(self, dtype=jnp.float32) -> jax.Array:
         return self.data.astype(dtype)
 
     def exact_host(self, nrows: Optional[int] = None) -> np.ndarray:
-        """Host values with int64 exactness preserved (wide pair → int64)."""
+        """Host values with exactness preserved (wide pair → int64/float64)."""
         n = self.data.shape[0] if nrows is None else nrows
         if self.wide_hi is not None:
             hi = np.asarray(jax.device_get(self.wide_hi))[:n].astype(np.int64)
             lo = np.asarray(jax.device_get(self.wide_lo))[:n].astype(np.int64) + (1 << 31)
-            return (hi << 32) + lo
+            key = (hi << 32) + lo
+            if self.wide_kind == "float":
+                return float_from_order_key(key)
+            return key
         return np.asarray(jax.device_get(self.data))[:n]
 
 
@@ -89,6 +103,33 @@ def wide_int_parts(v64: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     hi = (v64 >> 32).astype(np.int32)
     lo = ((v64 & 0xFFFFFFFF) - (1 << 31)).astype(np.int32)
     return hi, lo
+
+
+def float_order_key(v64: np.ndarray) -> np.ndarray:
+    """float64 → int64 key whose numeric order equals the float order.
+
+    IEEE-754 trick: negative floats flip every bit, non-negatives flip only
+    the sign bit, giving a monotonic unsigned map; re-flipping the top bit
+    recenters it to signed int64.  (-0.0 and +0.0 map to distinct keys —
+    acceptable for distinct-count semantics.)"""
+    b = np.ascontiguousarray(v64, np.float64).view(np.uint64)
+    flip = np.where(b >> np.uint64(63), np.uint64(0xFFFFFFFFFFFFFFFF),
+                    np.uint64(0x8000000000000000))
+    return (b ^ flip ^ np.uint64(0x8000000000000000)).view(np.int64)
+
+
+def float_from_order_key(key: np.ndarray) -> np.ndarray:
+    """Inverse of float_order_key."""
+    u = key.view(np.uint64) ^ np.uint64(0x8000000000000000)
+    flip = np.where(u >> np.uint64(63), np.uint64(0x8000000000000000),
+                    np.uint64(0xFFFFFFFFFFFFFFFF))
+    return (u ^ flip).view(np.float64)
+
+
+def float_order_parts(v64: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """float64 → (hi, lo) int32 pair whose signed lexicographic order equals
+    the float numeric order (same pair encoding as wide_int_parts)."""
+    return wide_int_parts(float_order_key(v64))
 
 
 def _pad_to(arr: np.ndarray, n: int, fill) -> np.ndarray:
@@ -331,7 +372,7 @@ class Table:
                 j += 2
             cols[name] = Column(
                 c.kind, data, gm[i], vocab=c.vocab, dtype_name=c.dtype_name,
-                wide_hi=whi, wide_lo=wlo,
+                wide_hi=whi, wide_lo=wlo, wide_kind=c.wide_kind,
             )
         return Table(cols, n)
 
@@ -372,8 +413,12 @@ class Table:
                 s[~mask] = pd.NaT
                 out[name] = s
             elif c.wide_hi is not None:
-                vals = c.exact_host(n)  # exact int64
-                if mask.all():
+                vals = c.exact_host(n)  # exact int64 / float64
+                if c.wide_kind == "float":
+                    vals = vals.copy()
+                    vals[~mask] = np.nan
+                    out[name] = vals
+                elif mask.all():
                     out[name] = vals
                 else:  # nullable after outer joins: pandas Int64 keeps exactness
                     out[name] = pd.arrays.IntegerArray(vals, ~mask)
@@ -451,6 +496,22 @@ def _host_to_column(arr: np.ndarray, n: int, npad: int, rt) -> Column:
         isnull = np.isnan(vals)
         host = np.where(isnull, 0.0, vals).astype(np.float32)
         fill = np.float32(0)
+        if vals.dtype.itemsize > 4:
+            v64 = np.where(isnull, 0.0, vals).astype(np.float64)
+            if not np.array_equal(host.astype(np.float64), v64):
+                # values don't survive the f32 round-trip: keep the exact
+                # order-preserving (hi, lo) pair for distinct/mode/percentiles
+                whi, wlo = float_order_parts(v64)
+                mask = rt.shard_rows(_pad_to(~isnull, npad, False))
+                return Column(
+                    "num",
+                    rt.shard_rows(_pad_to(host, npad, fill)),
+                    mask,
+                    dtype_name=dtn,
+                    wide_hi=rt.shard_rows(_pad_to(whi, npad, np.int32(0))),
+                    wide_lo=rt.shard_rows(_pad_to(wlo, npad, np.int32(-(1 << 31)))),
+                    wide_kind="float",
+                )
     else:
         isnull = np.zeros(n, dtype=bool)
         if vals.dtype.itemsize > 4:
