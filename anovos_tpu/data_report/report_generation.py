@@ -54,6 +54,33 @@ _AE_FILES = ["correlation_matrix", "IV_calculation", "IG_calculation", "variable
 
 _PLOTLY_CDN = "https://cdn.plot.ly/plotly-2.35.2.min.js"
 
+
+def _plotly_script_tag() -> str:
+    """Self-contained-report support (reference report_generation.py:4387-4413
+    bundles datapane's JS runtime): embed plotly.min.js INLINE when a copy is
+    available — ``ANOVOS_PLOTLY_JS=<path>`` or the installed plotly package's
+    bundled copy — so charts render with networking disabled.  Falls back to
+    the CDN tag otherwise (the inline SVG renderer in ``_JS`` still keeps the
+    report readable fully offline either way)."""
+    candidates = [os.environ.get("ANOVOS_PLOTLY_JS")]
+    try:
+        import plotly  # noqa: F401 — optional; provides a vendorable bundle
+
+        candidates.append(
+            os.path.join(os.path.dirname(plotly.__file__), "package_data", "plotly.min.js")
+        )
+    except ImportError:
+        pass
+    for p in candidates:
+        if p and os.path.isfile(p):
+            try:
+                with open(p, encoding="utf-8") as fh:
+                    src = fh.read()
+                return f"<script>{src}</script>"
+            except OSError:
+                continue
+    return f"<script src='{_PLOTLY_CDN}'></script>"
+
 _STABILITY_INTERPRETATION = pd.DataFrame(
     {
         "StabilityIndex": ["3.5 - 4.0", "3.0 - 3.5", "2.0 - 3.0", "1.0 - 2.0", "0.0 - 1.0"],
@@ -1208,7 +1235,7 @@ def anovos_report(
     )
     html = (
         "<!DOCTYPE html><html><head><meta charset='utf-8'><title>Anovos-TPU Report</title>"
-        f"<script src='{_PLOTLY_CDN}'></script><style>{_CSS}</style><script>{_JS}</script></head>"
+        f"{_plotly_script_tag()}<style>{_CSS}</style><script>{_JS}</script></head>"
         "<body><header><h2>Anovos-TPU — Data Report</h2></header>"
         f"<nav>{nav}</nav><main>{sections}</main></body></html>"
     )

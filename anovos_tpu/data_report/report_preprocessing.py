@@ -42,18 +42,26 @@ def save_stats(
     auth_key: str = "NA",
 ) -> pd.DataFrame:
     """Persist a stats frame as ``<master_path>/<function_name>.csv``
-    (reference :40-119; emr/ak8s artifact shuttling not applicable here)."""
-    Path(master_path).mkdir(parents=True, exist_ok=True)
-    idf.to_csv(ends_with(master_path) + function_name + ".csv", index=False)
+    (reference :40-119).  The ``run_type`` axis routes through the pluggable
+    artifact store: writes land in the store's local staging dir and are
+    pushed to the configured (possibly remote) ``master_path``."""
+    from anovos_tpu.shared.artifact_store import for_run_type
+
+    store = for_run_type(run_type, auth_key)
+    local_dir = store.staging_dir(master_path)
+    Path(local_dir).mkdir(parents=True, exist_ok=True)
+    local_file = ends_with(local_dir) + function_name + ".csv"
+    idf.to_csv(local_file, index=False)
+    store.push(local_file, master_path)
     if mlflow_config is not None:
         try:  # pragma: no cover - optional dependency
             import mlflow
 
-            mlflow.log_artifact(master_path)
+            mlflow.log_artifact(local_dir)
         except ImportError:
             pass
     if reread:
-        return pd.read_csv(ends_with(master_path) + function_name + ".csv")
+        return pd.read_csv(local_file)
     return idf
 
 
@@ -275,6 +283,10 @@ def charts_to_objects(
     **_ignored,
 ) -> None:
     """Write per-column chart JSONs + data_type.csv (reference :469-735)."""
+    from anovos_tpu.shared.artifact_store import for_run_type
+
+    store = for_run_type(run_type, auth_key)
+    dest_path, master_path = master_path, store.staging_dir(master_path)
     Path(master_path).mkdir(parents=True, exist_ok=True)
     num_all, cat_all, _ = idf.attribute_type_segregation()
     cols = parse_cols(
@@ -401,3 +413,10 @@ def charts_to_objects(
     pd.DataFrame(idf.dtypes(), columns=["attribute", "data_type"]).to_csv(
         ends_with(master_path) + "data_type.csv", index=False
     )
+
+    # publish the staged chart/manifest files to the configured destination
+    # (no-op for local; aws/azcopy per file for emr/ak8s — ref :634-710 cp's)
+    for fname in sorted(os.listdir(master_path)):
+        fpath = os.path.join(master_path, fname)
+        if os.path.isfile(fpath):
+            store.push(fpath, dest_path)

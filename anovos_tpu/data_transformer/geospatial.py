@@ -640,21 +640,90 @@ def rog_calculation(idf: Table, lat_col: str, long_col: str, id_col: str) -> pd.
     ).reset_index(drop=True)
 
 
+_GEOCODE_CACHE = {}  # resolved csv path -> (unit_xyz (C,3) np.f32, frame)
+
+
+def _geocode_table() -> tuple:
+    """Bundled offline centroid table (major world cities, every sizeable
+    country's capital included), overridable via ``ANOVOS_GEOCODE_TABLE``
+    (same csv schema: name,admin1,cc,lat,lon).  Cached per resolved path —
+    changing the env override mid-process takes effect — with precomputed
+    unit vectors for the nearest-centroid matmul."""
+    import os
+
+    path = os.environ.get("ANOVOS_GEOCODE_TABLE") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "data", "world_cities.csv"
+    )
+    if path not in _GEOCODE_CACHE:
+        # keep_default_na=False: Namibia's country code IS the string "NA"
+        cities = pd.read_csv(path, keep_default_na=False)
+        la = np.radians(cities["lat"].to_numpy(float))
+        lo = np.radians(cities["lon"].to_numpy(float))
+        xyz = np.stack(
+            [np.cos(la) * np.cos(lo), np.cos(la) * np.sin(lo), np.sin(la)], axis=1
+        ).astype(np.float32)
+        _GEOCODE_CACHE[path] = (xyz, cities)
+    return _GEOCODE_CACHE[path]
+
+
+@jax.jit
+def _nearest_city_idx(lat_deg: jax.Array, lon_deg: jax.Array, city_xyz: jax.Array) -> jax.Array:
+    """argmin great-circle distance == argmax 3D dot product with the city
+    unit vectors — one (N,3)@(3,C) MXU matmul instead of N×C haversines."""
+    la = jnp.radians(lat_deg.astype(jnp.float32))
+    lo = jnp.radians(lon_deg.astype(jnp.float32))
+    pts = jnp.stack(
+        [jnp.cos(la) * jnp.cos(lo), jnp.cos(la) * jnp.sin(lo), jnp.sin(la)], axis=1
+    )
+    return jnp.argmax(pts @ city_xyz.T, axis=1)
+
+
 def reverse_geocoding(idf: Table, lat_col: str, long_col: str, **_ignored) -> pd.DataFrame:
-    """Nearest-city lookup (reference :1335-1409 uses the offline
-    reverse_geocoder package).  Not bundled here — raises with guidance."""
-    try:  # pragma: no cover - optional dependency
+    """[lat, long, name_of_place, region, country_code] via nearest centroid
+    (reference :1335-1409; its offline ``reverse_geocoder`` package is the
+    same design — geonames centroids + NN search — so the bundled compact
+    table preserves the semantics at city granularity).  When the optional
+    package IS importable it takes precedence for its much denser database."""
+    if lat_col not in idf.columns:
+        raise TypeError("Invalid input for lat_col")
+    if long_col not in idf.columns:
+        raise TypeError("Invalid input for long_col")
+    lat, ml = _host_num(idf, lat_col)
+    lon, mo = _host_num(idf, long_col)
+    ok = ml & mo & np.isfinite(lat) & np.isfinite(lon)
+    if (~ok).any():
+        warnings.warn("Rows dropped due to null value in longitude and/or latitude values")
+    rng_ok = (lat >= -90) & (lat <= 90) & (lon >= -180) & (lon <= 180)
+    if (ok & ~rng_ok).any():
+        warnings.warn(
+            "Rows dropped due to longitude and/or latitude values being out of the valid range"
+        )
+    ok &= rng_ok
+    if not ok.any():
+        warnings.warn(
+            "No reverse_geocoding Computation - No valid latitude/longitude row(s) to compute"
+        )
+        return pd.DataFrame(columns=[lat_col, long_col, "name_of_place", "region", "country_code"])
+    la, lo = lat[ok], lon[ok]
+    try:  # pragma: no cover - optional dependency with a denser database
         import reverse_geocoder as rg
-    except ImportError as e:
-        raise ImportError(
-            "reverse_geocoding requires the optional 'reverse_geocoder' package "
-            "(offline city database); install it to enable this function"
-        ) from e
-    lat, _ = _host_num(idf, lat_col)
-    lon, _ = _host_num(idf, long_col)
-    ok = np.isfinite(lat) & np.isfinite(lon)
-    results = rg.search(list(zip(lat[ok], lon[ok])))
-    out = pd.DataFrame(results)
-    out.insert(0, lat_col, lat[ok])
-    out.insert(1, long_col, lon[ok])
-    return out
+
+        res = rg.search(list(zip(la, lo)), mode=1)
+        name = [r["name"] for r in res]
+        admin1 = [r["admin1"] for r in res]
+        cc = [r["cc"] for r in res]
+    except ImportError:
+        city_xyz, cities = _geocode_table()
+        idx = np.asarray(jax.device_get(_nearest_city_idx(jnp.asarray(la), jnp.asarray(lo), jnp.asarray(city_xyz))))
+        name = cities["name"].to_numpy()[idx]
+        admin1 = cities["admin1"].to_numpy()[idx]
+        cc = cities["cc"].to_numpy()[idx]
+    return pd.DataFrame(
+        {
+            lat_col: la,
+            long_col: lo,
+            "name_of_place": name,
+            "region": admin1,
+            "country_code": cc,
+        }
+    ).reset_index(drop=True)

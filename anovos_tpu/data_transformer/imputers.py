@@ -91,6 +91,7 @@ def imputation_sklearn(
     output_mode: str = "replace",
     stats_missing: dict = {},
     run_type: str = "local",
+    auth_key: str = "NA",
     print_impact: bool = False,
     **_ignored,
 ) -> Table:
@@ -115,8 +116,18 @@ def imputation_sklearn(
     tgt_idx = np.array([feat_cols.index(c) for c in cols])
     X, M = idf.numeric_block(feat_cols)
 
-    model_file = os.path.join(model_path, f"imputation_sklearn_{method_type}.npz") if model_path != "NA" else None
+    # model artifacts route through the run_type artifact store (reference
+    # transformers.py:1886-1950 shuttles its pickles with aws/azcopy)
+    from anovos_tpu.shared.artifact_store import for_run_type
+
+    store = for_run_type(run_type, auth_key)
+    local_model_dir = store.staging_dir(model_path) if model_path != "NA" else None
+    model_name = f"imputation_sklearn_{method_type}.npz"
+    model_file = os.path.join(local_model_dir, model_name) if local_model_dir else None
     if pre_existing_model:
+        model_file = store.pull(
+            str(model_path).rstrip("/") + "/" + model_name, model_file
+        )
         blob = np.load(model_file, allow_pickle=True)
         feat_cols = [str(c) for c in blob["feat_cols"]]
         cols = [c for c in cols if c in feat_cols]
@@ -137,15 +148,17 @@ def imputation_sklearn(
         Xs = jnp.asarray(np.asarray(jax.device_get(X))[pick])
         Ms = jnp.asarray(np.asarray(jax.device_get(M))[pick])
         if model_file:
-            os.makedirs(model_path, exist_ok=True)
+            os.makedirs(local_model_dir, exist_ok=True)
             np.savez(model_file, feat_cols=np.array(feat_cols), Xs=np.asarray(Xs), Ms=np.asarray(Ms))
+            store.push(model_file, model_path)
     else:
         means, coefs = _fit_iterative_ridge(X, M)
         if model_file:
-            os.makedirs(model_path, exist_ok=True)
+            os.makedirs(local_model_dir, exist_ok=True)
             np.savez(
                 model_file, feat_cols=np.array(feat_cols), means=np.asarray(means), coefs=np.asarray(coefs)
             )
+            store.push(model_file, model_path)
 
     if method_type == "KNN":
         filled_parts = []

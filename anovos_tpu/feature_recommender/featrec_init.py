@@ -25,27 +25,93 @@ _MODEL = None
 _VECTORIZER = None
 
 
+class _HashedProjectionEncoder:
+    """Dense-embedding stand-in with no weight files: hashed word/char-n-gram
+    features projected into a fixed-dim space by per-bucket seeded Gaussian
+    vectors (Johnson–Lindenstrauss: cosine over the projections approximates
+    cosine over the sparse n-gram space).  Deterministic across processes —
+    the hash is FNV-1a, not Python's salted ``hash``.  This drives the SAME
+    dense-vector code path as sentence-transformers (fixed-width float
+    vectors straight into ``cosine_sim_matrix``, no corpus fit), so the
+    semantic backend is exercisable in weightless environments."""
+
+    def __init__(self, dim: int = 256, buckets: int = 1 << 16):
+        self.dim = dim
+        self.buckets = buckets
+        rng = np.random.default_rng(1234567)
+        self._proj = rng.standard_normal((buckets, dim)).astype(np.float32)
+
+    @staticmethod
+    def _fnv1a(s: str) -> int:
+        h = 0xCBF29CE484222325
+        for b in s.encode("utf-8"):
+            h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+        return h
+
+    def _features(self, text: str) -> List[str]:
+        t = re.sub(r"\s+", " ", str(text).lower().strip())
+        words = t.split(" ")
+        feats = [f"w:{w}" for w in words]
+        padded = f" {t} "
+        feats += [f"c3:{padded[i:i + 3]}" for i in range(len(padded) - 2)]
+        feats += [f"c4:{padded[i:i + 4]}" for i in range(len(padded) - 3)]
+        return feats
+
+    def encode(self, texts: List[str]) -> np.ndarray:
+        out = np.zeros((len(texts), self.dim), dtype=np.float32)
+        for i, t in enumerate(texts):
+            feats = self._features(t)
+            if not feats:
+                continue
+            idx = np.fromiter(
+                (self._fnv1a(f) % self.buckets for f in feats), np.int64, len(feats)
+            )
+            # sublinear weighting of repeated n-grams
+            uniq, cnt = np.unique(idx, return_counts=True)
+            w = (1.0 + np.log(cnt)).astype(np.float32)
+            out[i] = (self._proj[uniq] * w[:, None]).sum(axis=0)
+        return out
+
+
 class _EmbeddingModel:
-    """sentence-transformers when available offline; TF-IDF otherwise."""
+    """sentence-transformers when available offline; else the hashed
+    dense projection (``FR_BACKEND=hashed``) or TF-IDF (default fallback)."""
 
     def __init__(self):
         self.backend = "tfidf"
         self.model = None
-        try:  # pragma: no cover - requires downloaded weights
-            from sentence_transformers import SentenceTransformer
-
-            # a bare model name loads cache-only: hub downloads would spend
-            # minutes in connect retries in offline envs before failing
-            path = detect_model_path()
-            self.model = SentenceTransformer(path, local_files_only=not os.path.isdir(path))
-            self.backend = "sentence-transformers"
-        except Exception:
-            from sklearn.feature_extraction.text import TfidfVectorizer
-
-            self.model = TfidfVectorizer(
-                analyzer="char_wb", ngram_range=(2, 4), min_df=1, sublinear_tf=True
+        requested = os.environ.get("FR_BACKEND", "auto")
+        if requested not in ("auto", "sentence-transformers", "hashed", "tfidf"):
+            raise ValueError(
+                f"FR_BACKEND={requested!r} unknown; use auto | sentence-transformers | hashed | tfidf"
             )
-            self._fitted = False
+        if requested in ("auto", "sentence-transformers"):
+            try:  # pragma: no cover - requires downloaded weights
+                from sentence_transformers import SentenceTransformer
+
+                # a bare model name loads cache-only: hub downloads would spend
+                # minutes in connect retries in offline envs before failing
+                path = detect_model_path()
+                self.model = SentenceTransformer(path, local_files_only=not os.path.isdir(path))
+                self.backend = "sentence-transformers"
+                return
+            except Exception as e:
+                if requested == "sentence-transformers":
+                    # explicitly requested: do NOT silently degrade
+                    raise RuntimeError(
+                        "FR_BACKEND=sentence-transformers requested but the model "
+                        "could not be loaded (missing package or weights)"
+                    ) from e
+        if requested == "hashed":
+            self.model = _HashedProjectionEncoder()
+            self.backend = "hashed"
+            return
+        from sklearn.feature_extraction.text import TfidfVectorizer
+
+        self.model = TfidfVectorizer(
+            analyzer="char_wb", ngram_range=(2, 4), min_df=1, sublinear_tf=True
+        )
+        self._fitted = False
 
     def fit_corpus(self, texts: List[str]) -> None:
         if self.backend == "tfidf":
@@ -55,6 +121,8 @@ class _EmbeddingModel:
     def encode(self, texts: List[str]) -> np.ndarray:
         if self.backend == "sentence-transformers":  # pragma: no cover
             return np.asarray(self.model.encode(texts))
+        if self.backend == "hashed":
+            return self.model.encode(texts)
         if not getattr(self, "_fitted", False):
             self.fit_corpus(texts)
         return np.asarray(self.model.transform(texts).todense())
@@ -81,6 +149,12 @@ def get_model() -> _EmbeddingModel:
     if _MODEL is None:
         _MODEL = _EmbeddingModel()
     return _MODEL
+
+
+def reset_model() -> None:
+    """Drop the cached singleton (backend switches honor FR_BACKEND again)."""
+    global _MODEL
+    _MODEL = None
 
 
 def load_corpus(corpus_path: Optional[str] = None) -> pd.DataFrame:

@@ -5,9 +5,10 @@ blocks, nested keys are function names resolved by ``getattr`` (ref ETL
 :45-61, stats :495, quality :528, transformers :745).  ``stats_args``
 (ref :91-145) injects previously-saved stats CSVs into downstream functions;
 ``save(..., reread=True)`` (ref :64-88) checkpoints intermediates.  The
-``run_type`` axis collapses to local filesystem semantics (emr/ak8s artifact
-shuttling has no analogue here); mlflow hooks activate when the package is
-importable.
+``run_type`` axis routes through the pluggable artifact store
+(``shared/artifact_store.py``): local/databricks are path mappings,
+emr/ak8s stage locally and shell out to aws/azcopy like the reference;
+mlflow hooks activate when the package is importable.
 """
 
 from __future__ import annotations
@@ -132,8 +133,15 @@ def stats_args(all_configs: dict, func: str) -> dict:
     return result
 
 
+def _auth_key(auth_key_val: dict) -> str:
+    """The SAS token is the last value of the auth dict (reference :148-157
+    sets each pair on the spark conf and keeps the last value as auth_key)."""
+    return list(auth_key_val.values())[-1] if auth_key_val else "NA"
+
+
 def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) -> None:
     start_main = timeit.default_timer()
+    auth_key = _auth_key(auth_key_val)
     df = ETL(all_configs.get("input_dataset"))
 
     write_main = all_configs.get("write_main", None)
@@ -260,7 +268,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                     start = timeit.default_timer()
                     df_stats = getattr(stats_generator, m)(df, **args["metric_args"])
                     if report_input_path:
-                        save_stats(df_stats, report_input_path, m, reread=True)
+                        save_stats(df_stats, report_input_path, m, reread=True, run_type=run_type, auth_key=auth_key)
                     else:
                         save(df_stats, write_stats, "data_analyzer/stats_generator/" + m, reread=True)
                     logger.info(f"{key}, {m}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}")
@@ -285,7 +293,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                         "data_analyzer/quality_checker/" + subkey + "/dataset", reread=True,
                     )
                     if report_input_path:
-                        save_stats(df_stats, report_input_path, subkey, reread=True)
+                        save_stats(df_stats, report_input_path, subkey, reread=True, run_type=run_type, auth_key=auth_key)
                     else:
                         save(df_stats, write_stats, "data_analyzer/quality_checker/" + subkey, reread=True)
                     logger.info(
@@ -307,7 +315,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                         df_in = df
                     df_stats = getattr(association_evaluator, subkey)(df_in, **value, **extra_args)
                     if report_input_path:
-                        save_stats(df_stats, report_input_path, subkey, reread=True)
+                        save_stats(df_stats, report_input_path, subkey, reread=True, run_type=run_type, auth_key=auth_key)
                     else:
                         save(df_stats, write_stats, "data_analyzer/association_evaluator/" + subkey, reread=True)
                     logger.info(
@@ -330,12 +338,12 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                     else:
                         continue
                     if report_input_path:
-                        save_stats(df_stats, report_input_path, subkey, reread=True)
+                        save_stats(df_stats, report_input_path, subkey, reread=True, run_type=run_type, auth_key=auth_key)
                         if subkey == "stability_index":
                             amp = value["configs"].get("appended_metric_path", "")
                             if amp:
                                 metrics = data_ingest.read_dataset(amp, "csv", {"header": True})
-                                save_stats(metrics.to_pandas(), report_input_path, "stabilityIndex_metrics")
+                                save_stats(metrics.to_pandas(), report_input_path, "stabilityIndex_metrics", run_type=run_type, auth_key=auth_key)
                     else:
                         save(df_stats, write_stats, "drift_detector/" + subkey, reread=True)
                     logger.info(
@@ -365,7 +373,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                     if subkey == "charts_to_objects" and value is not None:
                         start = timeit.default_timer()
                         extra_args = stats_args(all_configs, subkey)
-                        charts_to_objects(df, **value, **extra_args, master_path=report_input_path)
+                        charts_to_objects(df, **value, **extra_args, master_path=report_input_path, run_type=run_type, auth_key=auth_key)
                         logger.info(
                             f"{key}, {subkey}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
                         )
@@ -408,9 +416,15 @@ def run(config_path: str, run_type: str = "local", auth_key_val: dict = {}) -> N
     here ``ANOVOS_PROFILE=<dir>`` additionally wraps the run in a JAX
     profiler trace (xprof-compatible) for kernel-level timing.
     """
-    if run_type not in ("local", "emr", "databricks", "ak8s"):
-        raise ValueError("Invalid run_type")
-    with open(config_path, "r") as f:
+    from anovos_tpu.shared.artifact_store import for_run_type
+
+    store = for_run_type(run_type, _auth_key(auth_key_val))
+    if run_type == "ak8s" and not auth_key_val:
+        raise ValueError("Invalid auth key for run_type")
+    # remote configs (e.g. s3:// for emr) are pulled before reading
+    # (reference workflow.py:877 "aws s3 cp <config> config.yaml")
+    config_file = store.pull(config_path, "config.yaml")
+    with open(config_file, "r") as f:
         all_configs = yaml.load(f, yaml.SafeLoader)
     profile_dir = os.environ.get("ANOVOS_PROFILE", "")
     if profile_dir:

@@ -1,0 +1,164 @@
+"""Pluggable artifact-store tests (VERDICT r2 missing #4): the run_type
+deployment axis is a store interface invoked at save/read boundaries, not a
+silent collapse to local.  Cloud stores are exercised by capturing their
+shell commands; end-to-end movement uses a tmpdir-backed fake store."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from anovos_tpu.shared import artifact_store as ast
+
+
+# ------------------------------------------------------------ mappings ----
+def test_local_store_is_identity(tmp_path):
+    s = ast.for_run_type("local")
+    assert s.staging_dir(str(tmp_path)) == str(tmp_path)
+    assert s.pull("/a/b.csv", "x") == "/a/b.csv"
+    s.push("anything", "anywhere")  # no-op, must not touch the fs
+
+
+def test_databricks_dbfs_mapping():
+    s = ast.for_run_type("databricks")
+    assert s.staging_dir("dbfs:/mnt/out") == "/dbfs/mnt/out"
+    assert s.pull("dbfs:/cfg.yaml", "x") == "/dbfs/cfg.yaml"
+    assert s.staging_dir("plain/dir") == "plain/dir"
+
+
+def test_remote_staging_dirs_do_not_collide():
+    s = ast.for_run_type("emr")
+    a = s.staging_dir("s3://bucket/master_stats")
+    b = s.staging_dir("s3://bucket/model_artifacts")
+    assert a != b
+    assert s.staging_dir("local/dir") == "local/dir"  # non-remote passes through
+
+
+def test_invalid_run_type():
+    with pytest.raises(ValueError, match="Invalid run_type"):
+        ast.for_run_type("yarn")
+
+
+# ------------------------------------------------- shell-command shape ----
+def test_s3_store_commands(monkeypatch):
+    cmds = []
+    s = ast.for_run_type("emr")
+    monkeypatch.setattr(s, "_run", cmds.append)
+    s.push("stage/f.csv", "s3://bucket/out")
+    s.pull("s3://bucket/cfg.yaml", "config.yaml")
+    s.push("stage/f.csv", "local/out")  # non-remote dest: no shell-out
+    assert cmds == [
+        "aws s3 cp stage/f.csv s3://bucket/out/",
+        "aws s3 cp s3://bucket/cfg.yaml config.yaml",
+    ]
+
+
+def test_azure_store_commands(monkeypatch):
+    cmds = []
+    s = ast.for_run_type("ak8s", auth_key="?sig=TOKEN")
+    monkeypatch.setattr(s, "_run", cmds.append)
+    s.push("stage/f.csv", "wasbs://cont@acct.blob.core.windows.net/out")
+    # wasbs → https rewrite (reference utils.path_ak8s_modify) + SAS suffix,
+    # shell-quoted so no operand can be expanded/split by bash
+    assert cmds == [
+        "azcopy cp stage/f.csv 'https://acct.blob.core.windows.net/cont/out/?sig=TOKEN'"
+    ]
+
+
+# ------------------------------------------- tmpdir-backed fake store ----
+class TmpStore(ast.ArtifactStore):
+    """Fake 'remote': rem://<key> lives under a tmpdir; staged writes under
+    a separate staging tmpdir — movement between them is observable."""
+
+    remote_root = None  # set by fixture
+    staging_root = None
+
+    def _remote(self, path):
+        return os.path.join(self.remote_root, str(path).replace("rem://", ""))
+
+    def staging_dir(self, path):
+        if str(path).startswith("rem://"):
+            return os.path.join(self.staging_root, str(path).replace("rem://", ""))
+        return str(path)
+
+    def push(self, local_file, dest_dir):
+        if not str(dest_dir).startswith("rem://"):
+            return
+        d = self._remote(dest_dir)
+        os.makedirs(d, exist_ok=True)
+        with open(local_file, "rb") as fi, open(
+            os.path.join(d, os.path.basename(local_file)), "wb"
+        ) as fo:
+            fo.write(fi.read())
+
+    def pull(self, src, local_file):
+        if not str(src).startswith("rem://"):
+            return str(src)
+        with open(self._remote(src), "rb") as fi, open(local_file, "wb") as fo:
+            fo.write(fi.read())
+        return local_file
+
+
+@pytest.fixture
+def tmp_store(tmp_path):
+    TmpStore.remote_root = str(tmp_path / "remote")
+    TmpStore.staging_root = str(tmp_path / "staging")
+    ast.register_store("faketype", TmpStore)
+    yield TmpStore
+    ast._REGISTRY.pop("faketype", None)
+
+
+def test_save_stats_pushes_through_store(tmp_store, tmp_path):
+    from anovos_tpu.data_report.report_preprocessing import save_stats
+
+    df = pd.DataFrame({"attribute": ["a"], "metric": [1.5]})
+    out = save_stats(df, "rem://master", "global_summary", reread=True, run_type="faketype")
+    # staged locally, published remotely, reread from the staged copy
+    assert os.path.exists(os.path.join(tmp_store.staging_root, "master", "global_summary.csv"))
+    remote = os.path.join(tmp_store.remote_root, "master", "global_summary.csv")
+    assert os.path.exists(remote)
+    assert pd.read_csv(remote).equals(out.reset_index(drop=True))
+
+
+def test_imputer_model_roundtrip_through_store(tmp_store):
+    from anovos_tpu.shared import Table
+    from anovos_tpu.data_transformer.imputers import imputation_sklearn
+
+    rng = np.random.default_rng(7)
+    df = pd.DataFrame({"age": rng.normal(40, 9, 400), "fnlwgt": rng.normal(2e5, 4e4, 400)})
+    df.loc[df.sample(40, random_state=1).index, "age"] = np.nan
+    t = Table.from_pandas(df)
+    cols = ["age", "fnlwgt"]
+    fit = imputation_sklearn(
+        t, cols, method_type="regression", model_path="rem://models",
+        run_type="faketype", stats_missing={}, print_impact=False,
+    )
+    remote = os.path.join(tmp_store.remote_root, "models", "imputation_sklearn_regression.npz")
+    assert os.path.exists(remote)
+    # wipe staging: re-apply must pull the model from the fake remote
+    import shutil
+
+    shutil.rmtree(tmp_store.staging_root)
+    os.makedirs(os.path.join(tmp_store.staging_root, "models"), exist_ok=True)
+    re = imputation_sklearn(
+        t, cols, method_type="regression", model_path="rem://models",
+        pre_existing_model=True, run_type="faketype", stats_missing={}, print_impact=False,
+    )
+    a, _ = fit.numeric_block(cols)
+    b, _ = re.numeric_block(cols)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_workflow_run_pulls_remote_config(tmp_store, tmp_path, monkeypatch):
+    import anovos_tpu.workflow as wf
+
+    monkeypatch.chdir(tmp_path)
+    os.makedirs(os.path.join(tmp_store.remote_root), exist_ok=True)
+    with open(os.path.join(tmp_store.remote_root, "cfg.yaml"), "w") as f:
+        f.write("{}")
+    called = {}
+    monkeypatch.setattr(wf, "main", lambda cfgs, rt, ak: called.update(cfgs=cfgs, rt=rt))
+    wf.run("rem://cfg.yaml", "faketype")
+    assert called["rt"] == "faketype" and called["cfgs"] == {}
+    assert os.path.exists(tmp_path / "config.yaml")
