@@ -201,6 +201,67 @@ def golden_drift(src, tgt):
     return pd.DataFrame(rows)
 
 
+# ------------------------------------------------------------- quality ----
+def golden_outlier(df):
+    """outlier_detection semantics (quality_checker.py:550-1045): three
+    detectors — percentile fences, mean±3σ (sample stddev), 1.5·IQR fences —
+    voted with min_validation=2 (2nd-most-extreme candidate on each side);
+    columns with p5 == p95 excluded as skewed; counts of values strictly
+    outside [lower, upper] on the full data (no sampling at this size)."""
+    rows = []
+    for c in NUM_COLS:
+        s = df[c].dropna().to_numpy(float)
+        p5, p95 = np.quantile(s, 0.05, method="lower"), np.quantile(s, 0.95, method="lower")
+        if p5 == p95:
+            continue  # skewed
+        mean, sd = s.mean(), s.std(ddof=1)
+        q1, q3 = np.quantile(s, 0.25, method="lower"), np.quantile(s, 0.75, method="lower")
+        iqr = q3 - q1
+        lows = sorted([p5, mean - 3 * sd, q1 - 1.5 * iqr], reverse=True)
+        highs = sorted([p95, mean + 3 * sd, q3 + 1.5 * iqr])
+        lo, hi = lows[1], highs[1]  # min_validation=2
+        rows.append({
+            "attribute": c,
+            "lower_outliers": int((s < lo).sum()),
+            "upper_outliers": int((s > hi).sum()),
+        })
+    return pd.DataFrame(rows)
+
+
+def golden_duplicates(df):
+    """duplicate_detection stats (quality_checker.py:49-149).  The income
+    data has no duplicate rows, so the fixture re-appends the first 500 —
+    the dedup path must actually find them (non-degenerate by construction)."""
+    df = pd.concat([df, df.head(500)], ignore_index=True)
+    n = len(df)
+    uniq = len(df.drop_duplicates())
+    return pd.DataFrame(
+        [
+            ["rows_count", float(n)],
+            ["unique_rows_count", float(uniq)],
+            ["duplicate_rows", float(n - uniq)],
+            ["duplicate_pct", r4((n - uniq) / n)],
+        ],
+        columns=["metric", "value"],
+    )
+
+
+def golden_nullrows(df):
+    """nullRows_detection stats (quality_checker.py:152-283): per-row null
+    count distribution with flag = null_count > 0.1 * ncols (threshold 0.1
+    so BOTH flag values occur on this data — 18 cols, up to 8 nulls/row)."""
+    cnt = df.isna().sum(axis=1).to_numpy()
+    flagged = (cnt > 0.1 * df.shape[1]).astype(int)
+    g = pd.DataFrame({"null_cols_count": cnt, "flagged": flagged})
+    out = g.groupby(["null_cols_count", "flagged"], as_index=False).size().rename(
+        columns={"size": "row_count"}
+    )
+    out["row_pct"] = (out["row_count"] / len(df)).round(4)
+    return out[["null_cols_count", "row_count", "row_pct", "flagged"]].sort_values(
+        "null_cols_count"
+    ).reset_index(drop=True)
+
+
 # --------------------------------------------------------------- IV/IG ----
 def _equal_freq_keys(df, c):
     """Binned group keys for one attribute; nulls stay null (their own bin)."""
@@ -264,6 +325,9 @@ def main():
         "golden_percentiles.csv": golden_percentiles(df),
         "golden_shape.csv": golden_shape(df),
         "golden_drift.csv": golden_drift(src, tgt),
+        "golden_outlier.csv": golden_outlier(df),
+        "golden_duplicates.csv": golden_duplicates(df),
+        "golden_nullrows.csv": golden_nullrows(df),
         "golden_iv.csv": golden_iv(df),
         "golden_ig.csv": golden_ig(df),
     }

@@ -184,3 +184,57 @@ def test_golden_ig(table):
 
     ours = IG_calculation(table, label_col="income", event_label=">50K")
     _check(ours, "golden_ig.csv", {"ig": dict(rtol=5e-2, atol=2e-3)})
+
+
+# ---------------------------------------------------------------- quality --
+def test_golden_outlier(table):
+    from anovos_tpu.data_analyzer.quality_checker import outlier_detection
+
+    with np.errstate(all="ignore"):
+        import warnings as _w
+
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            _, stats = outlier_detection(
+                table, NUM_COLS, detection_side="both", sample_size=10**9
+            )
+    # counts are discrete and bound-sensitive: allow ±0.2% of rows slack for
+    # the f32 device bounds vs the oracle's f64 fences
+    g = _golden("golden_outlier.csv")
+    ours = stats.set_index("attribute").sort_index()
+    assert list(ours.index) == list(g.index), "skew-excluded attribute set differs"
+    for col in ("lower_outliers", "upper_outliers"):
+        diff = (ours[col].astype(int) - g[col].astype(int)).abs()
+        # per-attribute slack: 5% of the golden count (min 2) keeps the f32
+        # device-bound vs f64-oracle tolerance without masking a total miss
+        # on small-count attributes
+        allowed = np.maximum(2, (0.05 * g[col].astype(float)).astype(int))
+        assert (diff <= allowed).all(), f"{col}: {diff[diff > allowed]}"
+
+
+def test_golden_duplicates(income):
+    from anovos_tpu.data_analyzer.quality_checker import duplicate_detection
+
+    # same construction as the oracle: first 500 rows re-appended, so the
+    # dedup path must actually find 500 duplicates (non-degenerate)
+    dup = Table.from_pandas(pd.concat([income, income.head(500)], ignore_index=True))
+    _, stats = duplicate_detection(dup)
+    g = pd.read_csv(os.path.join(HERE, "golden_duplicates.csv"))
+    assert list(stats["metric"]) == list(g["metric"])
+    np.testing.assert_allclose(
+        stats["value"].to_numpy(float), g["value"].to_numpy(float), atol=1e-4
+    )
+
+
+def test_golden_nullrows(table):
+    from anovos_tpu.data_analyzer.quality_checker import nullRows_detection
+
+    _, stats = nullRows_detection(table, treatment_threshold=0.1)
+    g = pd.read_csv(os.path.join(HERE, "golden_nullrows.csv"))
+    pd.testing.assert_frame_equal(
+        stats.reset_index(drop=True).astype(
+            {"null_cols_count": int, "row_count": int, "flagged": int}
+        ),
+        g.astype({"null_cols_count": int, "row_count": int, "flagged": int}),
+        check_dtype=False,
+    )
