@@ -15,6 +15,8 @@ import os
 from pathlib import Path
 from typing import List, Optional, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
@@ -23,8 +25,6 @@ from anovos_tpu.data_transformer.geo_utils import geohash_decode
 from anovos_tpu.ops.cluster import dbscan_fit, kmeans_elbow, kmeans_fit
 from anovos_tpu.shared.table import Table
 from anovos_tpu.shared.utils import ends_with
-
-import jax.numpy as jnp
 
 
 def _latlon_points(idf: Table, lat_col: str, lon_col: str, max_records: int) -> np.ndarray:
@@ -334,15 +334,28 @@ def cluster_analysis(
         # sklearn scan — and unscaled was both wrong and 6× slower)
         sub = sub[np.random.default_rng(2).choice(len(sub), grid_cap, replace=False)]
     frac = len(sub) / max(len(pts), 1)
-    from anovos_tpu.ops.cluster import dbscan_grid, neighbor_counts
+    from anovos_tpu.ops.cluster import dbscan_grid, dbscan_host_grid, neighbor_counts, pairwise_d2
 
     ms_values = list(range(m0, m1 + 1, mstep))
     ms_eff = [max(2, int(round(m * frac))) for m in ms_values]
+    # the squared-distance matrix is eps-independent: ONE device matmul
+    # serves the entire (eps × min_samples) grid, with thresholding + CC on
+    # host.  ANOVOS_DBSCAN_HOST_CC_MAX bounds the host memory (n² f32 +
+    # transient edge lists); samples above it — a grid cap RAISED beyond the
+    # 4096 default — use the tiled on-device propagation path instead.
+    D2 = None
+    if len(sub) <= int(os.environ.get("ANOVOS_DBSCAN_HOST_CC_MAX", 6144)):
+        Xc = np.asarray(sub, np.float32)
+        Xc = Xc - Xc.mean(axis=0, keepdims=True)  # f32 bits follow the spread
+        D2 = np.asarray(jax.device_get(pairwise_d2(jnp.asarray(Xc))))
     for e in np.arange(e0, e1 + 1e-9, estep):
-        # one neighbor-count pass per eps; all min_samples labeled in ONE
-        # batched device program (fixed shapes — one compile for the grid)
-        counts = neighbor_counts(sub, float(e))
-        labels_b = dbscan_grid(sub, float(e), ms_eff, counts=counts)
+        if D2 is not None:
+            labels_b = dbscan_host_grid(D2, float(e), ms_eff)
+        else:
+            # one neighbor-count pass per eps; all min_samples labeled in ONE
+            # batched device program (fixed shapes — one compile for the grid)
+            counts = neighbor_counts(sub, float(e))
+            labels_b = dbscan_grid(sub, float(e), ms_eff, counts=counts)
         for m, labels in zip(ms_values, labels_b):
             n_clusters = len(set(labels[labels >= 0]))
             score = _silhouette(sub, labels) if n_clusters >= 2 else -1.0

@@ -461,9 +461,22 @@ def biasedness_detection(
     cols = _discrete_cols(idf, list_of_cols, drop_cols)
     treatment = _check_bool(treatment)
     treatment_threshold = float(treatment_threshold)
-    ct = sg.measures_of_centralTendency(idf, cols)
+    if stats_mode:
+        # pre-computed mode stats CSV (reference :1305-1309 reads the saved
+        # measures_of_centralTendency output filtered to list_of_cols —
+        # columns absent from the cache drop out, NO recompute: a full
+        # describe on the by-now treatment-mutated table is exactly the cost
+        # stats_mode exists to avoid)
+        from anovos_tpu.data_ingest.data_ingest import read_dataset
+
+        ct = read_dataset(**stats_mode).to_pandas()
+        ct = ct[ct["attribute"].isin(cols)].reset_index(drop=True)
+    else:
+        ct = sg.measures_of_centralTendency(idf, cols)
     stats = ct[["attribute", "mode", "mode_rows", "mode_pct"]].copy()
-    stats["flagged"] = ((stats["mode_pct"].astype(float) >= treatment_threshold)).fillna(False).astype(int)
+    # null mode_pct is flagged too (reference :1311-1316 isNull() → 1)
+    pct = pd.to_numeric(stats["mode_pct"], errors="coerce")
+    stats["flagged"] = ((pct >= treatment_threshold) | pct.isna()).astype(int)
     odf = idf
     if treatment:
         rm = list(stats.loc[stats["flagged"] == 1, "attribute"])

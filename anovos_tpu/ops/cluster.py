@@ -307,6 +307,45 @@ def _dbscan_batch(
     return jnp.moveaxis(out, 0, 1).reshape(B, n), done
 
 
+@jax.jit
+def pairwise_d2(X: jax.Array) -> jax.Array:
+    """Full (n, n) squared-distance matrix — ONE MXU program.  The matrix is
+    eps-independent, so a hyperparameter grid computes it once and derives
+    every (eps × min_samples) combo's adjacency host-side by thresholding."""
+    return (X**2).sum(1, keepdims=True) - 2 * jnp.matmul(X, X.T, precision=_HI) + (X**2).sum(1)[None, :]
+
+
+def dbscan_host_grid(D2: np.ndarray, eps: float, min_samples_list: "list[int]") -> np.ndarray:
+    """DBSCAN labels for every min_samples at one eps from a precomputed
+    squared-distance matrix: scipy connected-components over the core graph
+    + nearest-core border adoption.  Semantics identical to ``dbscan_grid``
+    (dense int labels, −1 noise); intended for grid-search sample sizes
+    (n ≤ ~8k) where one device matmul + host CC beats the on-device
+    propagation loop by an order of magnitude in wall time and dispatches."""
+    from scipy.sparse import csr_matrix
+    from scipy.sparse.csgraph import connected_components
+
+    n = len(D2)
+    adj = D2 <= eps * eps
+    counts = adj.sum(axis=1)
+    out = np.full((len(min_samples_list), n), -1, np.int64)
+    for b, ms in enumerate(min_samples_list):
+        core = counts >= ms
+        ci = np.nonzero(core)[0]
+        if len(ci) == 0:
+            continue
+        sub = adj[np.ix_(ci, ci)]
+        _, comp = connected_components(csr_matrix(sub), directed=False)
+        out[b, ci] = comp
+        bi = np.nonzero(~core)[0]
+        if len(bi):
+            Db = np.where(adj[np.ix_(bi, ci)], D2[np.ix_(bi, ci)], np.inf)
+            j = np.argmin(Db, axis=1)
+            hit = np.isfinite(Db[np.arange(len(bi)), j])
+            out[b, bi[hit]] = comp[j[hit]]
+    return out
+
+
 def dbscan_grid(
     X: np.ndarray,
     eps: float,
