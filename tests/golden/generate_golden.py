@@ -262,6 +262,48 @@ def golden_nullrows(df):
     ).reset_index(drop=True)
 
 
+# ---------------------------------------------------------- transformers --
+def golden_binning(df):
+    """attribute_binning semantics (transformers.py:87-291): equal_range
+    cutoffs lo + j*(hi-lo)/10; equal_frequency cutoffs at j/10 quantiles;
+    label = searchsorted(cutoffs, x, 'left') + 1; per-bin row counts."""
+    rows = []
+    for c in NUM_COLS:
+        v = df[c].to_numpy(float)
+        nn = v[~np.isnan(v)]
+        for method in ("equal_range", "equal_frequency"):
+            if method == "equal_range":
+                lo, hi = nn.min(), nn.max()
+                cuts = [lo + j * (hi - lo) / BIN_SIZE for j in range(1, BIN_SIZE)]
+            else:
+                cuts = np.quantile(nn, [j / BIN_SIZE for j in range(1, BIN_SIZE)], method="lower").tolist()
+            b = np.searchsorted(cuts, nn, side="left") + 1
+            counts = np.bincount(b, minlength=BIN_SIZE + 1)[1:]
+            rows.append({
+                "attribute": c, "method": method,
+                **{f"cut_{j}": r4(cuts[j - 1]) for j in range(1, BIN_SIZE)},
+                **{f"bin_{j}": int(counts[j - 1]) for j in range(1, BIN_SIZE + 1)},
+            })
+    return pd.DataFrame(rows)
+
+
+def golden_scalers(df):
+    """z_standardization (mean, sample stddev — transformers.py:965-1100)
+    and IQR_standardization (median, Q3−Q1 — :1102-1232) fit parameters."""
+    rows = []
+    for c in NUM_COLS:
+        s = df[c].dropna().to_numpy(float)
+        q25, q50, q75 = np.quantile(s, [0.25, 0.5, 0.75], method="lower")
+        rows.append({
+            "attribute": c,
+            "mean": r4(s.mean()),
+            "stddev": r4(s.std(ddof=1)),
+            "median": r4(q50),
+            "IQR": r4(q75 - q25),
+        })
+    return pd.DataFrame(rows)
+
+
 # --------------------------------------------------------------- IV/IG ----
 def _equal_freq_keys(df, c):
     """Binned group keys for one attribute; nulls stay null (their own bin)."""
@@ -326,6 +368,8 @@ def main():
         "golden_shape.csv": golden_shape(df),
         "golden_drift.csv": golden_drift(src, tgt),
         "golden_outlier.csv": golden_outlier(df),
+        "golden_binning.csv": golden_binning(df),
+        "golden_scalers.csv": golden_scalers(df),
         "golden_duplicates.csv": golden_duplicates(df),
         "golden_nullrows.csv": golden_nullrows(df),
         "golden_iv.csv": golden_iv(df),

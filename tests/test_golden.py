@@ -238,3 +238,57 @@ def test_golden_nullrows(table):
         g.astype({"null_cols_count": int, "row_count": int, "flagged": int}),
         check_dtype=False,
     )
+
+
+# ----------------------------------------------------------- transformers --
+def test_golden_binning(table):
+    from anovos_tpu.data_transformer.transformers import attribute_binning
+    from anovos_tpu.data_transformer.model_io import load_model_df
+
+    g = _golden("golden_binning.csv").reset_index()
+    for method in ("equal_range", "equal_frequency"):
+        with tempfile.TemporaryDirectory() as d:
+            odf = attribute_binning(
+                table, NUM_COLS, method_type=method, bin_size=10,
+                bin_dtype="numerical", model_path=d, output_mode="append",
+            )
+            model = load_model_df(d, "attribute_binning").set_index("attribute")
+        sub = g[g["method"] == method].set_index("attribute")
+        for c in NUM_COLS:
+            cuts = np.asarray([float(x) for x in model.loc[c, "parameters"]], float)
+            want = sub.loc[c, [f"cut_{j}" for j in range(1, 10)]].to_numpy(float)
+            np.testing.assert_allclose(cuts, want, rtol=5e-3, atol=1e-3,
+                                       err_msg=f"{method}:{c} cutoffs")
+            binned = odf.columns[c + "_binned"]
+            # padding rows carry mask=False, so mask-only indexing is right
+            # on every topology (multi-host padding is interleaved, not
+            # trailing — an nrows slice would drop real rows there)
+            codes = np.asarray(binned.data)[np.asarray(binned.mask)]
+            counts = np.bincount(codes.astype(int), minlength=11)[1:]
+            want_counts = sub.loc[c, [f"bin_{j}" for j in range(1, 11)]].to_numpy(int)
+            # cutoffs are f32 on device: rows exactly ON a boundary may land
+            # one bin over — allow 0.5% of rows to shift between bins
+            assert np.abs(counts - want_counts).sum() <= max(4, int(0.01 * table.nrows)), (
+                f"{method}:{c} bin distribution {counts} vs {want_counts}"
+            )
+
+
+def test_golden_scalers(table):
+    from anovos_tpu.data_transformer.transformers import (
+        IQR_standardization,
+        z_standardization,
+    )
+    from anovos_tpu.data_transformer.model_io import load_model_df
+
+    g = _golden("golden_scalers.csv")
+    with tempfile.TemporaryDirectory() as d:
+        z_standardization(table, NUM_COLS, model_path=d)
+        mz = load_model_df(d, "z_standardization").set_index("attribute")
+    with tempfile.TemporaryDirectory() as d:
+        IQR_standardization(table, NUM_COLS, model_path=d)
+        mi = load_model_df(d, "IQR_standardization").set_index("attribute")
+    for c in NUM_COLS:
+        np.testing.assert_allclose(float(mz.loc[c, "mean"]), g.loc[c, "mean"], rtol=1e-3, err_msg=f"mean:{c}")
+        np.testing.assert_allclose(float(mz.loc[c, "stddev"]), g.loc[c, "stddev"], rtol=1e-3, err_msg=f"stddev:{c}")
+        np.testing.assert_allclose(float(mi.loc[c, "median"]), g.loc[c, "median"], rtol=1e-3, atol=1e-3, err_msg=f"median:{c}")
+        np.testing.assert_allclose(float(mi.loc[c, "iqr"]), g.loc[c, "IQR"], rtol=1e-3, atol=1e-3, err_msg=f"IQR:{c}")
