@@ -1,0 +1,22 @@
+#!/bin/bash
+# One-shot TPU measurement capture for the flaky-tunnel environment: run the
+# moment a probe succeeds.  Produces tpu_capture_<ts>.json files and prints a
+# summary; PERF.md is updated by hand from these (perf_report.py --no-md).
+set -u
+cd "$(dirname "$0")/.."
+TS=$(date +%s)
+OUT="tpu_capture_${TS}"
+echo "== probe =="
+if ! timeout 150 python -c "import jax; assert jax.default_backend() != 'cpu'; print(jax.devices())"; then
+  echo "tunnel down; aborting"; exit 1
+fi
+echo "== AE MFU (bf16 mixed precision) =="
+timeout 580 python perf_report.py --section ae > "${OUT}_ae.json" 2> "${OUT}_ae.err"
+tail -1 "${OUT}_ae.json"
+echo "== bench.py (PSI + e2e, TPU) =="
+timeout 3500 env BENCH_TPU_PROBE_TIMEOUT=300 python bench.py > "${OUT}_bench.json" 2> "${OUT}_bench.err"
+tail -1 "${OUT}_bench.json"
+echo "== Pallas compiled attempt =="
+timeout 580 env ANOVOS_USE_PALLAS=1 python perf_report.py --section hist > "${OUT}_pallas.json" 2> "${OUT}_pallas.err"
+tail -1 "${OUT}_pallas.json"
+echo "== done: ${OUT}_*.json =="
