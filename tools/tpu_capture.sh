@@ -1,22 +1,42 @@
 #!/bin/bash
 # One-shot TPU measurement capture for the flaky-tunnel environment: run the
-# moment a probe succeeds.  Produces tpu_capture_<ts>.json files and prints a
-# summary; PERF.md is updated by hand from these (perf_report.py --no-md).
+# moment a probe succeeds.  Produces tpu_capture_<ts>_*.json files; update
+# the curated PERF.md by hand from sections whose probe_before AND
+# probe_after both say "tpu-ok" (a mid-run tunnel drop makes perf_report
+# silently fall back to CPU — the bracketing probes catch that).
 set -u
 cd "$(dirname "$0")/.."
 TS=$(date +%s)
 OUT="tpu_capture_${TS}"
-echo "== probe =="
-if ! timeout 150 python -c "import jax; assert jax.default_backend() != 'cpu'; print(jax.devices())"; then
-  echo "tunnel down; aborting"; exit 1
-fi
-echo "== AE MFU (bf16 mixed precision) =="
-timeout 580 python perf_report.py --section ae > "${OUT}_ae.json" 2> "${OUT}_ae.err"
-tail -1 "${OUT}_ae.json"
-echo "== bench.py (PSI + e2e, TPU) =="
-timeout 3500 env BENCH_TPU_PROBE_TIMEOUT=300 python bench.py > "${OUT}_bench.json" 2> "${OUT}_bench.err"
-tail -1 "${OUT}_bench.json"
-echo "== Pallas compiled attempt =="
-timeout 580 env ANOVOS_USE_PALLAS=1 python perf_report.py --section hist > "${OUT}_pallas.json" 2> "${OUT}_pallas.err"
-tail -1 "${OUT}_pallas.json"
+
+probe() {  # prints tpu-ok | down
+  if timeout 150 python -c "import jax; assert jax.default_backend() != 'cpu'" >/dev/null 2>&1; then
+    echo "tpu-ok"
+  else
+    echo "down"
+  fi
+}
+
+section() {  # name, timeout, cmd...
+  local name="$1" to="$2"; shift 2
+  echo "== ${name} =="
+  local before after
+  before=$(probe)
+  if [ "$before" != "tpu-ok" ]; then
+    echo "{\"section\": \"${name}\", \"skipped\": \"tunnel down before section\"}" > "${OUT}_${name}.json"
+    cat "${OUT}_${name}.json"; return
+  fi
+  timeout "$to" "$@" > "${OUT}_${name}.json" 2> "${OUT}_${name}.err"
+  after=$(probe)
+  echo "{\"probe_before\": \"${before}\", \"probe_after\": \"${after}\"}" >> "${OUT}_${name}.json"
+  tail -2 "${OUT}_${name}.json"
+  if [ "$after" != "tpu-ok" ]; then
+    echo "WARNING: tunnel dropped during ${name} — numbers may be CPU fallback"
+  fi
+}
+
+if [ "$(probe)" != "tpu-ok" ]; then echo "tunnel down; aborting"; exit 1; fi
+section ae 580 python perf_report.py --section ae
+section bench 3500 env BENCH_TPU_PROBE_TIMEOUT=300 python bench.py
+section pallas 580 env ANOVOS_USE_PALLAS=1 python perf_report.py --section hist
 echo "== done: ${OUT}_*.json =="
