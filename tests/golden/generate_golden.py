@@ -304,6 +304,55 @@ def golden_scalers(df):
     return pd.DataFrame(rows)
 
 
+# ------------------------------------------------------------ stability ----
+def _si_score(cv):
+    """CV → SI score map (reference validations.py:97-126):
+    [0.03, 0.1, 0.2, 0.5] → 4..0."""
+    acv = abs(cv)
+    for score, thr in zip((4, 3, 2, 1), (0.03, 0.1, 0.2, 0.5)):
+        if acv < thr:
+            return score
+    return 0
+
+
+def golden_stability():
+    """stability_index_computation semantics (reference stability.py:15-334)
+    on a DETERMINISTIC synthetic 3-dataset history (seeded; the test rebuilds
+    the same datasets): per-dataset mean/stddev/kurtosis(+3), CV of each
+    metric across datasets (SAMPLE stddev ddof=1 — Spark's F.stddev), CV→SI
+    map, weighted SI with the 50/30/20 default weights."""
+    rng = np.random.default_rng(99)
+    datasets = [
+        pd.DataFrame({
+            "steady": rng.normal(100.0, 5.0, 2000),
+            "drifty": rng.normal(100.0 + 40.0 * i, 5.0 + 3.0 * i, 2000),
+        })
+        for i in range(3)
+    ]
+    rows = []
+    for c in ("steady", "drifty"):
+        means, stds, kurts = [], [], []
+        for d in datasets:
+            v = d[c].to_numpy(float)
+            m = v.mean()
+            m2 = ((v - m) ** 2).mean()
+            m4 = ((v - m) ** 4).mean()
+            means.append(m)
+            stds.append(v.std(ddof=1))
+            kurts.append(m4 / m2**2)  # kurtosis + 3 (reference adds 3)
+        cvs = [np.std(x, ddof=1) / abs(np.mean(x)) for x in (means, stds, kurts)]
+        sis = [_si_score(cv) for cv in cvs]
+        si = 0.5 * sis[0] + 0.3 * sis[1] + 0.2 * sis[2]
+        rows.append({
+            "attribute": c,
+            "mean_cv": r4(cvs[0]), "stddev_cv": r4(cvs[1]), "kurtosis_cv": r4(cvs[2]),
+            "mean_si": sis[0], "stddev_si": sis[1], "kurtosis_si": sis[2],
+            "stability_index": r4(si),
+            "flagged": int(si < 1),
+        })
+    return pd.DataFrame(rows)
+
+
 # --------------------------------------------------------------- IV/IG ----
 def _equal_freq_keys(df, c):
     """Binned group keys for one attribute; nulls stay null (their own bin)."""
@@ -370,6 +419,7 @@ def main():
         "golden_outlier.csv": golden_outlier(df),
         "golden_binning.csv": golden_binning(df),
         "golden_scalers.csv": golden_scalers(df),
+        "golden_stability.csv": golden_stability(),
         "golden_duplicates.csv": golden_duplicates(df),
         "golden_nullrows.csv": golden_nullrows(df),
         "golden_iv.csv": golden_iv(df),

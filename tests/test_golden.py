@@ -292,3 +292,32 @@ def test_golden_scalers(table):
         np.testing.assert_allclose(float(mz.loc[c, "stddev"]), g.loc[c, "stddev"], rtol=1e-3, err_msg=f"stddev:{c}")
         np.testing.assert_allclose(float(mi.loc[c, "median"]), g.loc[c, "median"], rtol=1e-3, atol=1e-3, err_msg=f"median:{c}")
         np.testing.assert_allclose(float(mi.loc[c, "iqr"]), g.loc[c, "IQR"], rtol=1e-3, atol=1e-3, err_msg=f"IQR:{c}")
+
+
+# -------------------------------------------------------------- stability --
+def test_golden_stability():
+    from anovos_tpu.drift_stability.stability import stability_index_computation
+
+    # same deterministic construction as the oracle (generate_golden.py)
+    rng = np.random.default_rng(99)
+    tables = [
+        Table.from_pandas(pd.DataFrame({
+            "steady": rng.normal(100.0, 5.0, 2000),
+            "drifty": rng.normal(100.0 + 40.0 * i, 5.0 + 3.0 * i, 2000),
+        }))
+        for i in range(3)
+    ]
+    ours = stability_index_computation(*tables).set_index("attribute").sort_index()
+    g = _golden("golden_stability.csv")
+    assert list(ours.index) == list(g.index)
+    for col in ("mean_cv", "stddev_cv", "kurtosis_cv"):
+        np.testing.assert_allclose(
+            ours[col].astype(float), g[col].astype(float), rtol=2e-3, atol=1e-4,
+            err_msg=col,
+        )
+    for col in ("mean_si", "stddev_si", "kurtosis_si", "flagged"):
+        assert list(ours[col].astype(int)) == list(g[col].astype(int)), col
+    np.testing.assert_allclose(
+        ours["stability_index"].astype(float), g["stability_index"].astype(float),
+        atol=1e-4, err_msg="stability_index",
+    )
