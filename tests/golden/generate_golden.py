@@ -304,6 +304,74 @@ def golden_scalers(df):
     return pd.DataFrame(rows)
 
 
+# ------------------------------------------------------- invalid entries ---
+_IE_NULL_VOCAB = [
+    "", " ", "nan", "null", "na", "inf", "n/a", "not defined", "none",
+    "undefined", "blank", "unknown",
+]
+_IE_SPECIAL = list("&$;:.,*#@_?%!^()-/'")
+
+
+def _ie_invalid(e) -> bool:
+    """Reference quality_checker.py:1504-1568 'auto' rules: lowercased
+    trimmed membership in the null/special vocab, the repeated-chars regex,
+    and whole-string strictly-consecutive ordinal runs of length >= 3."""
+    import re as _re
+
+    e = str(e).lower().strip()
+    if e in _IE_NULL_VOCAB + _IE_SPECIAL:
+        return True
+    if _re.search(r"\b([a-zA-Z0-9])\1\1+\b", e):
+        return True
+    if len(e) >= 3 and all(ord(e[i]) - ord(e[i - 1]) == 1 for i in range(1, len(e))):
+        return True
+    return False
+
+
+def _ie_frame() -> pd.DataFrame:
+    """Deterministic synthetic frame covering every 'auto' rule class plus
+    clean lookalikes (the test rebuilds the same frame)."""
+    return pd.DataFrame({
+        "nullish": ["ok", "NA", "  none ", "Unknown", "n/a", "fine", "nano", "infinite"],
+        "special": [":", "-", "a-b", "x", "&", "(", "val", "9.5"],
+        "repeats": ["aaa", "xaaax", "aab", "1111", "good", "zz", "999", "normal"],
+        "ordinal": ["abc", "xyz", "123", "12", "acb", "wxyz", "cba", "hi"],
+        "clean": ["alpha", "beta", "gamma", "delta", "x1", "y2", "z3", "w4"],
+    })
+
+
+def golden_invalid_entries():
+    df = _ie_frame()
+    rows = []
+    for c in df.columns:
+        bad = sorted({str(v).lower().strip() for v in df[c] if _ie_invalid(v)})
+        n_bad = int(sum(_ie_invalid(v) for v in df[c]))
+        rows.append({
+            "attribute": c,
+            "invalid_entries": "|".join(bad),
+            "invalid_count": n_bad,
+            "invalid_pct": r4(n_bad / len(df)),
+        })
+    return pd.DataFrame(rows)
+
+
+# ----------------------------------------------------------- correlation ---
+def golden_correlation(df):
+    """Pearson correlation over the numeric block (reference
+    association_evaluator.py:38-141 — MLlib Correlation.corr), pairwise on
+    rows where BOTH columns are non-null is NOT the reference semantics:
+    the assembler drops any row with a null in the selected block, so the
+    oracle uses complete-case rows only."""
+    sub = df[NUM_COLS].dropna()
+    corr = sub.corr(method="pearson")
+    ordered = sorted(NUM_COLS)  # reference sorts the column axis (:128-133)
+    corr = corr.loc[ordered, ordered]
+    out = corr.reset_index().rename(columns={"index": "attribute"})
+    for c in ordered:
+        out[c] = out[c].map(r4)
+    return out
+
+
 # ------------------------------------------------------------ stability ----
 def _si_score(cv):
     """CV → SI score map (reference validations.py:97-126):
@@ -420,6 +488,8 @@ def main():
         "golden_binning.csv": golden_binning(df),
         "golden_scalers.csv": golden_scalers(df),
         "golden_stability.csv": golden_stability(),
+        "golden_invalid_entries.csv": golden_invalid_entries(),
+        "golden_correlation.csv": golden_correlation(df),
         "golden_duplicates.csv": golden_duplicates(df),
         "golden_nullrows.csv": golden_nullrows(df),
         "golden_iv.csv": golden_iv(df),

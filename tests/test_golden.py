@@ -321,3 +321,39 @@ def test_golden_stability():
         ours["stability_index"].astype(float), g["stability_index"].astype(float),
         atol=1e-4, err_msg="stability_index",
     )
+
+
+# --------------------------------------------------- invalid entries -------
+def test_golden_invalid_entries():
+    from anovos_tpu.data_analyzer.quality_checker import invalidEntries_detection
+
+    import tests.golden.generate_golden as gg
+
+    t = Table.from_pandas(gg._ie_frame())
+    _, stats = invalidEntries_detection(t)
+    g = pd.read_csv(
+        os.path.join(HERE, "golden_invalid_entries.csv"), keep_default_na=False
+    ).set_index("attribute").sort_index()
+    ours = stats.set_index("attribute").sort_index()
+    assert list(ours.index) == list(g.index)
+    for c in g.index:
+        assert int(ours.loc[c, "invalid_count"]) == int(g.loc[c, "invalid_count"]), c
+        # the framework lists entries in their ORIGINAL form; the oracle in
+        # the rule-matching (lowercased/trimmed) form — compare normalized
+        got = {s.lower().strip() for s in str(ours.loc[c, "invalid_entries"]).split("|")} - {""}
+        want = set(str(g.loc[c, "invalid_entries"]).split("|")) - {""}
+        assert got == want, f"{c}: {got} vs {want}"
+        np.testing.assert_allclose(
+            float(ours.loc[c, "invalid_pct"]), float(g.loc[c, "invalid_pct"]), atol=1e-4
+        )
+
+
+# -------------------------------------------------------- correlation -----
+def test_golden_correlation(table):
+    from anovos_tpu.data_analyzer.association_evaluator import correlation_matrix
+
+    _check(
+        correlation_matrix(table, NUM_COLS),
+        "golden_correlation.csv",
+        {c: dict(atol=2e-3) for c in sorted(NUM_COLS)},
+    )
