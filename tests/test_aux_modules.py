@@ -398,3 +398,41 @@ def test_reverse_geocoding_offline():
     # validation errors
     with pytest.raises(TypeError):
         reverse_geocoding(t, "nope", "lon")
+
+
+def test_datetime_wrapper_contracts(ts_table):
+    """Direct pandas-oracle checks for the wrappers only exercised
+    transitively: timezone_conversion, timestamp_to_string, time_diff,
+    time_elapsed, start_of_year, end_of_quarter (reference datetime.py
+    :272-520, :624-771, :923-1511)."""
+    from anovos_tpu.data_transformer import datetime as dtm
+
+    ref = ts_table.to_pandas()
+
+    # tz conversion: UTC → UTC+5:30 shifts wall time by 5.5h
+    tz = dtm.timezone_conversion(ts_table, ["ts"], "UTC", "Asia/Kolkata").to_pandas()
+    shift = (tz["ts"] - ref["ts"]).dt.total_seconds()
+    assert (shift == 5.5 * 3600).all()
+
+    # string render round-trips through the requested strftime format
+    s = dtm.timestamp_to_string(ts_table, ["ts"], output_format="%Y/%m/%d").to_pandas()
+    want = ref["ts"].dt.strftime("%Y/%m/%d")
+    assert (s["ts"].astype(str) == want).all()
+
+    # diff of a column with itself is 0; elapsed is non-negative vs now
+    two = dtm.adding_timeUnits(ts_table, ["ts"], unit="hours", unit_value=36, output_mode="append")
+    d = dtm.time_diff(two, "ts_adjusted", "ts", unit="hours").to_pandas()
+    np.testing.assert_allclose(d[d.columns[-1]], 36.0, rtol=1e-5)
+    el = dtm.time_elapsed(ts_table, ["ts"], unit="days").to_pandas()
+    oracle_days = (pd.Timestamp.now() - ref["ts"]).dt.total_seconds() / 86400
+    np.testing.assert_allclose(
+        el[el.columns[-1]].to_numpy(float), oracle_days.to_numpy(float),
+        atol=0.1,  # the two 'now' calls are moments apart
+    )
+
+    # period boundaries against the pandas oracle
+    sy = dtm.start_of_year(ts_table, ["ts"]).to_pandas()["ts"]
+    assert (pd.to_datetime(sy).dt.month == 1).all() and (pd.to_datetime(sy).dt.day == 1).all()
+    eq = dtm.end_of_quarter(ts_table, ["ts"]).to_pandas()["ts"]
+    oracle = ref["ts"].dt.to_period("Q").dt.end_time.dt.date
+    assert (pd.to_datetime(eq).dt.date == oracle).all()

@@ -1,0 +1,63 @@
+"""Direct contracts for the recommender explorer/mapper variants that were
+only exercised transitively (reference feature_explorer.py:61-230,
+feature_mapper.py:322-464): usecase-axis listings, pair listing, and
+find_attr_by_relevance."""
+
+import pandas as pd
+
+from anovos_tpu.feature_recommender.feature_explorer import (
+    list_all_pair,
+    list_all_usecase,
+    list_feature_by_pair,
+    list_feature_by_usecase,
+    list_industry_by_usecase,
+    list_usecase_by_industry,
+    list_all_industry,
+)
+from anovos_tpu.feature_recommender.feature_mapper import find_attr_by_relevance
+
+
+def test_usecase_axis_listings():
+    ucs = list_all_usecase()
+    assert len(ucs) > 3 and list(ucs.columns) == ["Usecase"]
+    pairs = list_all_pair()
+    assert {"Industry", "Usecase"} <= set(pairs.columns)
+    ind = list_all_industry()["Industry"].iloc[0]
+    uc_for_ind = list_usecase_by_industry(ind, semantic=False)
+    assert len(uc_for_ind) >= 1
+    uc = uc_for_ind["Usecase"].iloc[0]
+    back = list_industry_by_usecase(uc, semantic=False)
+    # the industry we started from must appear among that usecase's industries
+    assert ind.lower() in set(back["Industry"].str.lower())
+
+
+def test_feature_listings_by_usecase_and_pair():
+    ind = list_all_industry()["Industry"].iloc[0]
+    uc = list_usecase_by_industry(ind, semantic=False)["Usecase"].iloc[0]
+    by_uc = list_feature_by_usecase(uc, num_of_feat=5, semantic=False)
+    assert 1 <= len(by_uc) <= 5 and "Feature Name" in by_uc.columns
+    by_pair = list_feature_by_pair(ind, uc, num_of_feat=5, semantic=False)
+    assert len(by_pair) <= len(by_uc) or len(by_pair) <= 5
+    # the pair listing is a subset of the usecase listing's corpus rows
+    assert set(by_pair["Usecase"].str.lower().unique()) <= {uc.lower()}
+
+
+def test_find_attr_by_relevance_contract():
+    out = find_attr_by_relevance(
+        {"cust_age": "age of the customer", "txn_amt": "transaction amount"},
+        building_corpus=["customer age in years", "number of logins"],
+        threshold=0.0,
+    )
+    assert list(out.columns) == [
+        "Input Feature Desc",
+        "Recommended Input Attribute",
+        "Input Attribute Similarity Score",
+    ]
+    # self-evident match: 'customer age in years' ranks cust_age first
+    top = (
+        out[out["Input Feature Desc"] == "customer age in years"]
+        .sort_values("Input Attribute Similarity Score", ascending=False)
+        .iloc[0]
+    )
+    assert top["Recommended Input Attribute"] == "cust_age"
+    assert (out["Input Attribute Similarity Score"] >= 0.0).all()
