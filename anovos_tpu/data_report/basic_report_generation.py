@@ -8,7 +8,6 @@ compact HTML through the same renderer as the full report.
 from __future__ import annotations
 
 import os
-from pathlib import Path
 from typing import Optional
 
 import pandas as pd
@@ -37,7 +36,8 @@ def anovos_basic_report(
     from anovos_tpu.data_analyzer import quality_checker as qc
     from anovos_tpu.data_analyzer import stats_generator as sg
 
-    Path(output_path).mkdir(parents=True, exist_ok=True)
+    # no mkdir here: save_stats / charts_to_objects / anovos_report each
+    # resolve + create the store's staging dir for output_path themselves
     drop = [c for c in [id_col] if c]
 
     for fn in (
@@ -50,7 +50,7 @@ def anovos_basic_report(
         "measures_of_shape",
     ):
         try:
-            save_stats(getattr(sg, fn)(idf, drop_cols=drop), output_path, fn)
+            save_stats(getattr(sg, fn)(idf, drop_cols=drop), output_path, fn, run_type=run_type, auth_key=auth_key)
         except TypeError as e:
             logging.getLogger(__name__).warning("basic report: %s skipped (%s)", fn, e)
 
@@ -65,7 +65,7 @@ def anovos_basic_report(
     ):
         try:
             _, stats = getattr(qc, fn)(idf, drop_cols=drop, treatment=False)
-            save_stats(stats, output_path, fn)
+            save_stats(stats, output_path, fn, run_type=run_type, auth_key=auth_key)
         except TypeError as e:
             logging.getLogger(__name__).warning("basic report: %s skipped (%s)", fn, e)
 
@@ -73,7 +73,7 @@ def anovos_basic_report(
         try:
             num_cols = idf.attribute_type_segregation()[0]
             corr = ae.correlation_matrix(idf, [c for c in num_cols if c != id_col])
-            save_stats(corr, output_path, "correlation_matrix")
+            save_stats(corr, output_path, "correlation_matrix", run_type=run_type, auth_key=auth_key)
         except TypeError as e:
             logging.getLogger(__name__).warning("basic report: correlation_matrix skipped (%s)", e)
     if label_col:
@@ -82,17 +82,20 @@ def anovos_basic_report(
                 ae.IV_calculation(idf, drop_cols=drop, label_col=label_col, event_label=event_label),
                 output_path,
                 "IV_calculation",
+                run_type=run_type, auth_key=auth_key,
             )
             save_stats(
                 ae.IG_calculation(idf, drop_cols=drop, label_col=label_col, event_label=event_label),
                 output_path,
                 "IG_calculation",
+                run_type=run_type, auth_key=auth_key,
             )
         except TypeError as e:
             logging.getLogger(__name__).warning("basic report: IV/IG skipped (%s)", e)
 
     charts_to_objects(
-        idf, drop_cols=drop, label_col=label_col or None, event_label=event_label, master_path=output_path
+        idf, drop_cols=drop, label_col=label_col or None, event_label=event_label,
+        master_path=output_path, run_type=run_type, auth_key=auth_key,
     )
     return anovos_report(
         master_path=output_path,
@@ -100,4 +103,5 @@ def anovos_basic_report(
         label_col=label_col,
         final_report_path=output_path,
         run_type=run_type,
+        auth_key=auth_key,
     )

@@ -1184,10 +1184,26 @@ def anovos_report(
     metricDict_path: str = "NA",
     final_report_path: str = ".",
     run_type: str = "local",
+    auth_key: str = "NA",
     **_ignored,
 ) -> str:
-    """Assemble ``ml_anovos_report.html`` from the master_path contract."""
+    """Assemble ``ml_anovos_report.html`` from the master_path contract.
+
+    Remote ``run_type`` paths resolve through the artifact store: stats are
+    READ from the store's local staging of ``master_path`` (where
+    save_stats/charts_to_objects staged them) and the finished HTML is
+    pushed to the configured ``final_report_path``."""
+    from anovos_tpu.shared.artifact_store import for_run_type
+
+    store = for_run_type(run_type, auth_key)
+    master_path = store.staging_dir(master_path)
+    report_dest, final_report_path = final_report_path, store.staging_dir(final_report_path)
     Path(final_report_path).mkdir(parents=True, exist_ok=True)
+    # remote dictionary CSVs are fetched before the wiki tab reads them
+    if dataDict_path != "NA":
+        dataDict_path = store.pull(dataDict_path, os.path.join(final_report_path, "_data_dictionary.csv"))
+    if metricDict_path != "NA":
+        metricDict_path = store.pull(metricDict_path, os.path.join(final_report_path, "_metric_dictionary.csv"))
     _table_seq[0] = 0
     tabs: List[tuple] = []
 
@@ -1242,4 +1258,5 @@ def anovos_report(
     out = ends_with(final_report_path) + "ml_anovos_report.html"
     with open(out, "w") as f:
         f.write(html)
+    store.push(out, report_dest)
     return out

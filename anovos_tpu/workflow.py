@@ -256,7 +256,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
 
             if key == "anovos_basic_report" and args is not None and args.get("basic_report", False):
                 start = timeit.default_timer()
-                anovos_basic_report(df, **args.get("report_args", {}), run_type=run_type)
+                anovos_basic_report(df, **args.get("report_args", {}), run_type=run_type, auth_key=auth_key)
                 logger.info(f"Basic Report: execution time (in secs) = {round(timeit.default_timer() - start, 4)}")
                 continue
 
@@ -380,7 +380,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
 
             if key == "report_generation" and args is not None:
                 start = timeit.default_timer()
-                anovos_report(**args, run_type=run_type)
+                anovos_report(**args, run_type=run_type, auth_key=auth_key)
                 logger.info(
                     f"{key}, full_report: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
                 )

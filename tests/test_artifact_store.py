@@ -162,3 +162,25 @@ def test_workflow_run_pulls_remote_config(tmp_store, tmp_path, monkeypatch):
     wf.run("rem://cfg.yaml", "faketype")
     assert called["rt"] == "faketype" and called["cfgs"] == {}
     assert os.path.exists(tmp_path / "config.yaml")
+
+
+def test_report_html_published_through_store(tmp_store, tmp_path):
+    from anovos_tpu.shared import Table
+    from anovos_tpu.data_report.report_preprocessing import save_stats
+    from anovos_tpu.data_report.report_generation import anovos_report
+    from anovos_tpu.data_analyzer import stats_generator as sg
+
+    rng = np.random.default_rng(3)
+    t = Table.from_pandas(pd.DataFrame({
+        "x": rng.normal(size=200), "c": rng.choice(["a", "b"], 200),
+    }))
+    save_stats(sg.global_summary(t), "rem://master", "global_summary", run_type="faketype")
+    out = anovos_report(
+        master_path="rem://master", final_report_path="rem://report", run_type="faketype"
+    )
+    # stats were READ from staging; the finished HTML was pushed to the
+    # fake remote destination
+    assert os.path.exists(out)
+    remote_html = os.path.join(tmp_store.remote_root, "report", "ml_anovos_report.html")
+    assert os.path.exists(remote_html)
+    assert "Executive Summary" in open(remote_html).read()
