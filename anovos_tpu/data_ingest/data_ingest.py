@@ -497,6 +497,20 @@ def recast_column(idf: Table, list_of_cols, list_of_dtypes, print_impact: bool =
                     "num", col.data, col.mask, dtype_name="double",
                     wide_hi=col.wide_hi, wide_lo=col.wide_lo, wide_kind="float",
                 )
+            elif col.is_wide and tgt == jnp.int32:
+                # float-wide → integer must truncate the EXACT double — the
+                # values the (hi,lo) pair exists to keep exact — not the f32
+                # approximation (the reference casts the exact double)
+                v = np.nan_to_num(col.exact_host(idf.nrows), nan=0.0)
+                v = np.trunc(v)
+                if dt in ("int", "integer", "smallint"):
+                    v = np.clip(v, np.iinfo(np.int32).min, np.iinfo(np.int32).max)
+                else:
+                    v = np.clip(v, -(2.0**63), 2.0**63 - 1024)
+                new = _host_to_column(v.astype(np.int64), idf.nrows, idf.pad_target(), rt)
+                new = Column(new.kind, new.data, new.mask & col.mask[: new.mask.shape[0]],
+                             dtype_name=dt if dt != "integer" else "int",
+                             wide_hi=new.wide_hi, wide_lo=new.wide_lo, wide_kind=new.wide_kind)
             else:
                 new = Column("num", col.data.astype(tgt), col.mask, dtype_name=dt if dt != "integer" else "int")
         elif dt == "string":

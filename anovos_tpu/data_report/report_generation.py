@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import glob
 import json
+import logging
 import os
 from html import escape
 from pathlib import Path
@@ -30,6 +31,8 @@ import numpy as np
 import pandas as pd
 
 from anovos_tpu.shared.utils import ends_with
+
+logger = logging.getLogger("anovos_tpu.report_generation")
 
 # stats files per tab (reference report_generation.py:4111-4136 tab lists)
 _SG_FILES = [
@@ -1196,7 +1199,18 @@ def anovos_report(
     from anovos_tpu.shared.artifact_store import for_run_type
 
     store = for_run_type(run_type, auth_key)
+    configured_master = master_path
     master_path = store.staging_dir(master_path)
+    # A standalone report run over stats produced by an EARLIER job finds an
+    # empty staging dir — pull the remote master_path contents down first
+    # (reference report_generation.py:4053-4080 'aws s3 cp --recursive').
+    if master_path != configured_master and not (
+        os.path.isdir(master_path) and os.listdir(master_path)
+    ):
+        try:
+            master_path = store.pull_dir(configured_master, master_path)
+        except Exception as e:  # nothing remote: the tabs degrade per-section
+            logger.warning("stats pull from %s failed (%s); using staging", configured_master, e)
     report_dest, final_report_path = final_report_path, store.staging_dir(final_report_path)
     Path(final_report_path).mkdir(parents=True, exist_ok=True)
     # remote dictionary CSVs are fetched before the wiki tab reads them

@@ -28,6 +28,7 @@ with ``compute_dtype=`` ("bf16" | "f32" | "auto") or ``ANOVOS_AE_COMPUTE``.
 from __future__ import annotations
 
 import functools
+import logging
 import os
 from typing import Dict, NamedTuple, Optional, Tuple
 
@@ -112,6 +113,14 @@ class AutoEncoder:
         """Resolved lazily so constructing an AE never forces backend init."""
         if self._compute_dtype_cache == ():
             self._compute_dtype_cache = _resolve_compute_dtype(self._requested_dtype)
+            # 'auto' silently picks bf16 on TPU, so CPU and TPU runs of the
+            # same config can differ in the last bits — make the choice
+            # visible once per model so that drift is attributable
+            logging.getLogger("anovos_tpu.autoencoder").info(
+                "autoencoder compute dtype resolved to %s (requested=%r, backend=%s)",
+                "bfloat16+f32-accum" if self._compute_dtype_cache is not None else "float32",
+                self._requested_dtype, jax.default_backend(),
+            )
         return self._compute_dtype_cache
 
     # -- parameters ------------------------------------------------------

@@ -52,6 +52,13 @@ class ArtifactStore:
         """Fetch ``src`` for local reading; returns the readable path."""
         return str(src)
 
+    def pull_dir(self, src_dir: str, local_dir: str) -> str:
+        """Fetch a whole remote directory into ``local_dir`` for reading
+        (reference report_generation.py:4053-4080 does the recursive
+        ``aws s3 cp``/``azcopy`` into report_stats before reading).
+        Returns the readable directory."""
+        return str(src_dir)
+
 
 class DatabricksStore(ArtifactStore):
     """dbfs:/ paths are fuse-mounted at /dbfs (reference utils.output_to_local)."""
@@ -69,6 +76,9 @@ class DatabricksStore(ArtifactStore):
 
     def pull(self, src: str, local_file: str) -> str:
         return self._map(src)
+
+    def pull_dir(self, src_dir: str, local_dir: str) -> str:
+        return self._map(src_dir)
 
 
 class _ShellStore(ArtifactStore):
@@ -113,6 +123,16 @@ class S3Store(_ShellStore):
         self._run(f"aws s3 cp {shlex.quote(src)} {shlex.quote(local_file)}")
         return local_file
 
+    def pull_dir(self, src_dir: str, local_dir: str) -> str:
+        if not _is_remote(src_dir):
+            return str(src_dir)
+        os.makedirs(local_dir, exist_ok=True)
+        self._run(
+            f"aws s3 cp --recursive {shlex.quote(src_dir.rstrip('/') + '/')} "
+            f"{shlex.quote(local_dir)}"
+        )
+        return local_dir
+
 
 class AzureStore(_ShellStore):
     """ak8s: ``azcopy`` with the SAS auth token appended
@@ -145,6 +165,21 @@ class AzureStore(_ShellStore):
             f"azcopy cp {shlex.quote(self._https(src) + self.auth_key)} {shlex.quote(local_file)}"
         )
         return local_file
+
+    def pull_dir(self, src_dir: str, local_dir: str) -> str:
+        if not _is_remote(src_dir):
+            return str(src_dir)
+        os.makedirs(local_dir, exist_ok=True)
+        # '/*' copies the directory CONTENTS into local_dir — bare azcopy
+        # places the source dir as a CHILD of the destination (unlike
+        # 'aws s3 cp --recursive'), which would bury the staged CSVs one
+        # level too deep for the readers
+        self._run(
+            f"azcopy cp --recursive "
+            f"{shlex.quote(self._https(src_dir.rstrip('/')) + '/*' + self.auth_key)} "
+            f"{shlex.quote(local_dir)}"
+        )
+        return local_dir
 
 
 _REGISTRY: Dict[str, Type[ArtifactStore]] = {

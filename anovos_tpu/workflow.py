@@ -88,8 +88,15 @@ def _clean_read_cfg(cfg):
     return cfg
 
 
-def stats_args(all_configs: dict, func: str) -> dict:
-    """Wire cached stats CSVs into downstream kwargs (reference :91-145)."""
+def stats_args(
+    all_configs: dict, func: str, run_type: str = "local", auth_key: str = "NA"
+) -> dict:
+    """Wire cached stats CSVs into downstream kwargs (reference :91-145).
+
+    The configured ``master_path`` may be remote (s3://, wasbs://) on
+    emr/ak8s, but the consumers read with the local reader — so the path is
+    resolved through the run_type store's staging dir, which is exactly
+    where ``save_stats`` just wrote the same CSV."""
     stats_configs = all_configs.get("stats_generator", None)
     write_configs = all_configs.get("write_stats", None)
     report_configs = all_configs.get("report_preprocessing", None)
@@ -116,6 +123,22 @@ def stats_args(all_configs: dict, func: str) -> dict:
         "stats_mode": "measures_of_centralTendency",
         "stats_missing": "measures_of_counts",
     }
+    if report_input_path:
+        from anovos_tpu.shared.artifact_store import for_run_type
+
+        store = for_run_type(run_type, auth_key)
+        configured = report_input_path
+        report_input_path = store.staging_dir(report_input_path)
+        # split-job runs (stats produced by an EARLIER job on another
+        # cluster) find an empty staging dir — pull the remote contents
+        # down before handing consumers a local path
+        if report_input_path != configured and not (
+            os.path.isdir(report_input_path) and os.listdir(report_input_path)
+        ):
+            try:
+                report_input_path = store.pull_dir(configured, report_input_path)
+            except Exception as e:  # nothing remote yet: same-process flow
+                logger.warning("stats pull from %s failed (%s); using staging", configured, e)
     for arg in mainfunc_to_args.get(func, []):
         if report_input_path:
             result[arg] = {
@@ -278,7 +301,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                     if value is None:
                         continue
                     start = timeit.default_timer()
-                    extra_args = stats_args(all_configs, subkey)
+                    extra_args = stats_args(all_configs, subkey, run_type, auth_key)
                     if subkey == "nullColumns_detection":
                         # upstream treatments invalidate cached missing stats (ref :552-566)
                         if (args.get("invalidEntries_detection") or {}).get("treatment"):
@@ -305,7 +328,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                     if value is None:
                         continue
                     start = timeit.default_timer()
-                    extra_args = stats_args(all_configs, subkey)
+                    extra_args = stats_args(all_configs, subkey, run_type, auth_key)
                     if subkey == "correlation_matrix":
                         cat_params = all_configs.get("cat_to_num_transformer", None)
                         df_in = (
@@ -358,7 +381,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                         if value2 is None:
                             continue
                         start = timeit.default_timer()
-                        extra_args = stats_args(all_configs, subkey2)
+                        extra_args = stats_args(all_configs, subkey2, run_type, auth_key)
                         f = getattr(transformers, subkey2)
                         df = f(df, **value2, **extra_args)
                         df = save(
@@ -372,7 +395,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                 for subkey, value in args.items():
                     if subkey == "charts_to_objects" and value is not None:
                         start = timeit.default_timer()
-                        extra_args = stats_args(all_configs, subkey)
+                        extra_args = stats_args(all_configs, subkey, run_type, auth_key)
                         charts_to_objects(df, **value, **extra_args, master_path=report_input_path, run_type=run_type, auth_key=auth_key)
                         logger.info(
                             f"{key}, {subkey}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"

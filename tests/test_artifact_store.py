@@ -41,16 +41,31 @@ def test_invalid_run_type():
 
 
 # ------------------------------------------------- shell-command shape ----
-def test_s3_store_commands(monkeypatch):
+def test_s3_store_commands(monkeypatch, tmp_path):
     cmds = []
     s = ast.for_run_type("emr")
     monkeypatch.setattr(s, "_run", cmds.append)
     s.push("stage/f.csv", "s3://bucket/out")
     s.pull("s3://bucket/cfg.yaml", "config.yaml")
     s.push("stage/f.csv", "local/out")  # non-remote dest: no shell-out
+    s.pull_dir("s3://bucket/master", str(tmp_path / "stage"))
+    assert s.pull_dir("local/master", "x") == "local/master"  # non-remote passes through
     assert cmds == [
         "aws s3 cp stage/f.csv s3://bucket/out/",
         "aws s3 cp s3://bucket/cfg.yaml config.yaml",
+        f"aws s3 cp --recursive s3://bucket/master/ {tmp_path / 'stage'}",
+    ]
+
+
+def test_azure_pull_dir_command(monkeypatch, tmp_path):
+    cmds = []
+    s = ast.for_run_type("ak8s", auth_key="?sig=TOKEN")
+    monkeypatch.setattr(s, "_run", cmds.append)
+    s.pull_dir("wasbs://cont@acct.blob.core.windows.net/master", str(tmp_path / "stage"))
+    # '/*' is load-bearing: bare azcopy would land master/ as a CHILD of the
+    # staging dir, burying the CSVs one level too deep for the readers
+    assert cmds == [
+        f"azcopy cp --recursive 'https://acct.blob.core.windows.net/cont/master/*?sig=TOKEN' {tmp_path / 'stage'}"
     ]
 
 
@@ -98,6 +113,14 @@ class TmpStore(ast.ArtifactStore):
         with open(self._remote(src), "rb") as fi, open(local_file, "wb") as fo:
             fo.write(fi.read())
         return local_file
+
+    def pull_dir(self, src_dir, local_dir):
+        if not str(src_dir).startswith("rem://"):
+            return str(src_dir)
+        import shutil
+
+        shutil.copytree(self._remote(src_dir), local_dir, dirs_exist_ok=True)
+        return local_dir
 
 
 @pytest.fixture
@@ -184,3 +207,67 @@ def test_report_html_published_through_store(tmp_store, tmp_path):
     remote_html = os.path.join(tmp_store.remote_root, "report", "ml_anovos_report.html")
     assert os.path.exists(remote_html)
     assert "Executive Summary" in open(remote_html).read()
+
+
+def test_standalone_report_pulls_remote_stats(tmp_store, tmp_path):
+    """A report-only run over stats produced by an EARLIER job (empty local
+    staging) must pull the remote master_path down before reading
+    (reference report_generation.py:4053-4080)."""
+    import shutil
+
+    from anovos_tpu.shared import Table
+    from anovos_tpu.data_report.report_preprocessing import save_stats
+    from anovos_tpu.data_report.report_generation import anovos_report
+    from anovos_tpu.data_analyzer import stats_generator as sg
+
+    rng = np.random.default_rng(5)
+    t = Table.from_pandas(pd.DataFrame({
+        "x": rng.normal(size=150), "c": rng.choice(["u", "v"], 150),
+    }))
+    save_stats(sg.global_summary(t), "rem://master2", "global_summary", run_type="faketype")
+    shutil.rmtree(tmp_store.staging_root)  # fresh process on another machine
+    out = anovos_report(
+        master_path="rem://master2", final_report_path=str(tmp_path / "rep"),
+        run_type="faketype",
+    )
+    html = open(out).read()
+    assert "no global summary found" not in html
+
+
+def test_stats_args_resolves_remote_master_path_to_staging(tmp_store):
+    """stats_mode/unique/missing consumers read with the LOCAL reader, so a
+    remote master_path must resolve to the store's staging dir — exactly
+    where save_stats just wrote the CSV (ADVICE r3 medium #2)."""
+    from anovos_tpu.workflow import stats_args
+
+    cfgs = {
+        "stats_generator": {"metric": ["measures_of_centralTendency"]},
+        "report_preprocessing": {"master_path": "rem://master3"},
+    }
+    out = stats_args(cfgs, "biasedness_detection", run_type="faketype")
+    fp = out["stats_mode"]["file_path"]
+    assert fp == os.path.join(
+        tmp_store.staging_root, "master3", "measures_of_centralTendency.csv"
+    )
+
+
+def test_stats_args_pulls_for_split_job(tmp_store):
+    """Job A wrote stats to the remote master_path from another cluster; a
+    fresh process's stats_args must pull them into staging before handing
+    consumers a local path (code-review r4 finding #2)."""
+    import shutil
+
+    from anovos_tpu.workflow import stats_args
+
+    remote_master = os.path.join(tmp_store.remote_root, "master4")
+    os.makedirs(remote_master, exist_ok=True)
+    pd.DataFrame({"attribute": ["x"], "mode": [1]}).to_csv(
+        os.path.join(remote_master, "measures_of_centralTendency.csv"), index=False
+    )
+    shutil.rmtree(tmp_store.staging_root, ignore_errors=True)
+    cfgs = {
+        "stats_generator": {"metric": ["measures_of_centralTendency"]},
+        "report_preprocessing": {"master_path": "rem://master4"},
+    }
+    out = stats_args(cfgs, "biasedness_detection", run_type="faketype")
+    assert os.path.exists(out["stats_mode"]["file_path"])

@@ -111,6 +111,24 @@ def test_recast_cat_to_num():
     assert np.isnan(df["s"][2]) and np.isnan(df["s"][3])
 
 
+def test_recast_wide_float_to_int_is_exact():
+    """float-wide → integer truncates the EXACT double, not the f32
+    approximation (ADVICE r3 low #1): these values differ from their f32
+    round-trip by more than 1, so an approximate cast would be visibly off."""
+    vals = np.array([123456789.75, 2**30 + 0.5, -987654321.25, 16777217.0])
+    assert not np.array_equal(vals.astype(np.float32).astype(np.float64), vals)
+    t = Table.from_pandas(pd.DataFrame({"w": vals}))
+    assert t["w"].is_wide
+    out = recast_column(t, ["w"], ["bigint"])
+    got = out["w"].exact_host(t.nrows)
+    np.testing.assert_array_equal(got, np.trunc(vals).astype(np.int64))
+    out32 = recast_column(t, ["w"], ["int"])
+    got32 = out32["w"].exact_host(t.nrows)
+    np.testing.assert_array_equal(
+        got32, np.clip(np.trunc(vals), -(2**31), 2**31 - 1).astype(np.int64)
+    )
+
+
 def test_recast_num_to_string():
     t = Table.from_pandas(pd.DataFrame({"n": [1, 2, 3]}))
     out = recast_column(t, ["n"], ["string"])
