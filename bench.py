@@ -186,7 +186,7 @@ def measure() -> None:
     for col in mismatches:
         print(f"WARNING: PSI mismatch on {col}: {ours[col]} vs {ref[col]}", file=sys.stderr)
 
-    print(json.dumps({
+    headline = {
         "metric": "psi_drift_rows_per_sec",
         "value": round(n / t_tpu, 1),
         "unit": f"rows/s ({n} rows, {len(ref)} cols, wall {t_tpu:.3f}s; "
@@ -194,12 +194,71 @@ def measure() -> None:
         "vs_baseline": round(t_ref / t_tpu, 3),
         "backend": backend,
         "psi_ok": not mismatches,
-    }))
+    }
+    # the headline is SAFE now: if the tunnel wedges during the steady-state
+    # section below, the parent rescues this line from the killed child's
+    # partial stdout instead of forfeiting a successful measurement
+    print(json.dumps(headline), flush=True)
+
+    # ---- device-resident steady state (VERDICT r3 weak #2) ----------------
+    # The inclusive wall above includes host→device upload and Python
+    # orchestration; the kernel itself has ~100× headroom under that.  Time
+    # drift_side_full over data ALREADY on device for N iterations with one
+    # trailing barrier (single device ⇒ programs retire in order), and report
+    # the implied effective bandwidth for the roofline comparison.
+    steady = {}
+    try:
+        from anovos_tpu.drift_stability.drift_detector import drift_device_args
+        from anovos_tpu.ops.drift_kernels import drift_side_full
+
+        args_t, args_s = drift_device_args(tgt, src, BIN_SIZE)
+        import jax as _jax
+
+        _jax.device_get((drift_side_full(*args_t), drift_side_full(*args_s)))  # compile
+        iters = int(os.environ.get("BENCH_STEADY_ITERS", 10))
+        t0 = time.perf_counter()
+        outs = None
+        for _ in range(iters):
+            outs = (drift_side_full(*args_t), drift_side_full(*args_s))
+        _jax.device_get(outs)
+        t_steady = (time.perf_counter() - t0) / iters
+        # bytes the kernel must touch per iteration: f32/int32 data (4 B) +
+        # bool mask (1 B) per row per column, both sides
+        bytes_iter = sum(
+            sum(d.shape[0] * 5 for d in a[0]) + sum(d.shape[0] * 5 for d in a[3])
+            for a in (args_t, args_s)
+        )
+        steady = {
+            "psi_steady_rows_per_sec": round(n / t_steady, 1),
+            "psi_steady_wall_s": round(t_steady, 4),
+            "psi_steady_gbps": round(bytes_iter / t_steady / 1e9, 2),
+        }
+    except Exception as e:  # steady state must never sink the headline
+        steady = {"psi_steady_error": str(e)[-200:]}
+
+    print(json.dumps({**headline, **steady}), flush=True)
 
 
 E2E_CONFIG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "config", "configs_full.yaml")
-E2E_ROWS = 32561  # income dataset
+
+
+def _e2e_rows() -> int:
+    """Row count of the e2e config's input dataset, derived from the run's
+    own config (a hardwired 32561 would silently misreport the day the
+    config changes — VERDICT r3 weak #8)."""
+    import yaml
+
+    with open(E2E_CONFIG) as f:
+        cfg = yaml.safe_load(f)
+    read = cfg["input_dataset"]["read_dataset"]
+    path, ftype = read["file_path"], read.get("file_type", "csv")
+    if ftype == "parquet":
+        import pyarrow.dataset as pads
+
+        return sum(f.count_rows() for f in pads.dataset(path, format="parquet").get_fragments())
+    files = glob.glob(os.path.join(path, "*")) if os.path.isdir(path) else [path]
+    return sum(len(pd.read_csv(f)) for f in files)
 
 
 def e2e_cold_warm() -> dict:
@@ -224,10 +283,15 @@ def e2e_cold_warm() -> dict:
                 out[label] = round(time.perf_counter() - t0, 1)
             finally:
                 os.chdir(cwd)
+    try:
+        n_rows = _e2e_rows()
+    except Exception:
+        n_rows = 32561  # income dataset fallback
     return {
         "e2e_cold_s": out["cold"],
         "e2e_warm_s": out["warm"],
-        "e2e_warm_rows_per_sec_per_chip": round(E2E_ROWS / out["warm"], 1),
+        "e2e_rows": n_rows,
+        "e2e_warm_rows_per_sec_per_chip": round(n_rows / out["warm"], 1),
         "e2e_backend": jax.default_backend(),
     }
 
@@ -239,6 +303,63 @@ def measure_e2e() -> None:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     print(json.dumps(e2e_cold_warm()))
+
+
+def _attested_capture():
+    """Most recent tpu_capture bench section whose bracketing probes BOTH
+    passed (tools/tpu_capture.sh writes probe_before/probe_after as a
+    trailing JSON line).  A wedged gate window must not erase a real
+    measurement taken during an earlier tunnel-up window this round
+    (VERDICT r3 next-round #1) — but only a bracketed TPU capture counts;
+    anything else stays a CPU fallback.
+
+    Returns (result_dict, timestamp, filename) or None.
+    """
+    here = os.environ.get("BENCH_CAPTURE_DIR") or os.path.dirname(os.path.abspath(__file__))
+    # only captures from THIS round count: the capture timestamp must be
+    # within the age window (default 14h ≳ one 12h round), else a stale
+    # file from a previous round would be re-stamped as current
+    max_age = int(os.environ.get("BENCH_CAPTURE_MAX_AGE_S", 14 * 3600))
+    best = None
+    for path in glob.glob(os.path.join(here, "tpu_capture_*_bench.json")):
+        try:
+            ts = int(os.path.basename(path).split("_")[2])
+        except (IndexError, ValueError):
+            continue
+        if time.time() - ts > max_age:
+            continue
+        bench_line, bracket = None, None
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line.startswith("{"):
+                        continue
+                    try:
+                        obj = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if "metric" in obj:
+                        bench_line = obj
+                    if "probe_before" in obj:
+                        bracket = obj
+        except OSError:
+            continue
+        if bench_line is None or bracket is None:
+            continue
+        if bracket.get("probe_before") != "tpu-ok" or bracket.get("probe_after") != "tpu-ok":
+            continue
+        backend = str(bench_line.get("backend", ""))
+        if backend.startswith("cpu") or backend in ("", "none"):
+            continue
+        if "attested" in backend:
+            # a capture that itself adopted an older capture is not a live
+            # measurement; adopting it would chain re-attestation under
+            # ever-newer timestamps
+            continue
+        if best is None or ts > best[1]:
+            best = (bench_line, ts, os.path.basename(path))
+    return best
 
 
 def _last_json_line(text: str):
@@ -266,7 +387,15 @@ def _run_child(mode: str, platforms: str, timeout_s: int):
             [sys.executable, os.path.abspath(__file__), mode],
             capture_output=True, text=True, timeout=timeout_s, env=env,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # the child flushes the headline JSON before optional trailing
+        # sections (steady state) — rescue it rather than forfeit a
+        # successful measurement to a late hang
+        partial = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        got = _last_json_line(partial)
+        if got is not None:
+            got["truncated"] = f"child killed after {timeout_s}s (trailing section hung)"
+            return got, None
         return None, f"measured run timed out after {timeout_s}s"
     got = _last_json_line(r.stdout)
     if got is not None:
@@ -307,15 +436,29 @@ def main() -> None:
 
     if result is None:
         fallback_diag = note or diag or "no accelerator backend"
-        result, err = _run_child("--measure", "cpu", RUN_TIMEOUT)
-        if result is None:
-            raise RuntimeError(f"CPU fallback also failed: {err}")
-        result["backend"] = f"cpu-fallback ({fallback_diag})"
+        # before surrendering the record to CPU, adopt a bracketed capture
+        # from an earlier tunnel-up window this round (probe_before AND
+        # probe_after both tpu-ok — tools/tpu_capture.sh)
+        attested = _attested_capture()
+        if attested is not None:
+            result, ts, fname = attested
+            iso = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+            result["backend"] = f"tpu (attested capture {iso})"
+            result["attested_capture_file"] = fname
+            result["live_probe_diag"] = fallback_diag
+        else:
+            result, err = _run_child("--measure", "cpu", RUN_TIMEOUT)
+            if result is None:
+                raise RuntimeError(f"CPU fallback also failed: {err}")
+            result["backend"] = f"cpu-fallback ({fallback_diag})"
     result.setdefault("backend", platform or "cpu")
     result["probe_attempts"] = attempts
 
     # ---- optional second headline: configs_full e2e (BASELINE.md:22) ----
-    if os.environ.get("BENCH_E2E", "1") == "1":  # on by default: BASELINE.md
+    if "attested_capture_file" in result:
+        pass  # the capture already carries its own e2e fields; the live
+        # tunnel is known-down, so a fresh e2e attempt would only hang
+    elif os.environ.get("BENCH_E2E", "1") == "1":  # on by default: BASELINE.md
         # names TWO metrics (PSI wall AND configs_full rows/sec/chip) and the
         # driver gate is the round's record — opt out with BENCH_E2E=0
         plat = "cpu" if str(result["backend"]).startswith("cpu") else ""

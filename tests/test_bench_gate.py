@@ -1,0 +1,112 @@
+"""Bench-gate robustness: the attested-capture adoption path and the
+steady-state device-resident PSI metric (VERDICT r3 next-round #1/#3).
+
+A wedged tunnel during the driver's gate window must not erase a real TPU
+measurement captured earlier in the round — but ONLY a capture whose
+bracketing probes both passed may be adopted.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+_spec = importlib.util.spec_from_file_location(
+    "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+)
+bench = importlib.util.module_from_spec(_spec)
+sys.modules["bench"] = bench
+_spec.loader.exec_module(bench)
+
+
+def _write_capture(d, ts, backend="tpu", before="tpu-ok", after="tpu-ok", metric=True):
+    lines = []
+    if metric:
+        lines.append(json.dumps({
+            "metric": "psi_drift_rows_per_sec", "value": 9.7e6, "unit": "rows/s",
+            "vs_baseline": 5.8, "backend": backend, "psi_ok": True,
+            "e2e_warm_s": 80.0, "e2e_backend": backend,
+        }))
+    lines.append(json.dumps({"probe_before": before, "probe_after": after}))
+    p = os.path.join(d, f"tpu_capture_{ts}_bench.json")
+    with open(p, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return p
+
+
+def test_adopts_most_recent_bracketed_capture(tmp_path, monkeypatch):
+    import time
+
+    monkeypatch.setenv("BENCH_CAPTURE_DIR", str(tmp_path))
+    t1, t2 = int(time.time()) - 7200, int(time.time()) - 3600
+    _write_capture(tmp_path, t1)
+    _write_capture(tmp_path, t2)
+    got = bench._attested_capture()
+    assert got is not None
+    result, ts, fname = got
+    assert ts == t2 and fname == f"tpu_capture_{t2}_bench.json"
+    assert result["value"] == 9.7e6
+
+
+def test_rejects_unbracketed_or_cpu_captures(tmp_path, monkeypatch):
+    import time
+
+    monkeypatch.setenv("BENCH_CAPTURE_DIR", str(tmp_path))
+    now = int(time.time())
+    _write_capture(tmp_path, now - 100, after="down")       # tunnel died mid-run
+    _write_capture(tmp_path, now - 200, backend="cpu")      # silent CPU fallback
+    _write_capture(tmp_path, now - 300, before="down")      # skipped section
+    _write_capture(tmp_path, now - 400, metric=False)       # no bench line at all
+    assert bench._attested_capture() is None
+
+
+def test_rejects_stale_and_chained_captures(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_CAPTURE_DIR", str(tmp_path))
+    # a capture from a PREVIOUS round (older than the age window) must not
+    # be re-stamped as this round's record ...
+    stale_ts = int(__import__("time").time()) - 15 * 3600
+    _write_capture(tmp_path, stale_ts)
+    # ... and a capture that itself adopted an older capture must not chain
+    fresh_ts = int(__import__("time").time()) - 60
+    _write_capture(tmp_path, fresh_ts, backend="tpu (attested capture 2026-01-01T00:00:00Z)")
+    assert bench._attested_capture() is None
+
+
+def test_capture_dir_without_files(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_CAPTURE_DIR", str(tmp_path))
+    assert bench._attested_capture() is None
+
+
+def test_e2e_rows_derived_from_config():
+    # configs_full reads the income parquet: the derived count must match
+    # the dataset, not a hardwired constant
+    assert bench._e2e_rows() == 32561
+
+
+def test_steady_state_args_shapes():
+    """drift_device_args must hand drift_side_full the same column layout
+    statistics uses: one lane per column, padded masks, a (k, nbins-1)
+    cutoff matrix, and a LUT covering every categorical vocab."""
+    from anovos_tpu.shared import Table
+    from anovos_tpu.drift_stability.drift_detector import drift_device_args
+    from anovos_tpu.ops.drift_kernels import drift_side_full
+
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({
+        "x": rng.normal(size=300), "y": rng.gamma(2.0, size=300),
+        "c": rng.choice(["a", "b", "c"], 300),
+    })
+    src = Table.from_pandas(df.iloc[:150].reset_index(drop=True))
+    tgt = Table.from_pandas(df.iloc[150:].reset_index(drop=True))
+    args_t, args_s = drift_device_args(tgt, src, bin_size=10)
+    assert len(args_t[0]) == 2 and len(args_t[3]) == 1
+    assert args_t[2].shape == (2, 9)
+    num_h, cat_h = map(np.asarray, drift_side_full(*args_t))
+    assert num_h.shape == (2, 10) and cat_h.shape[0] == 1
+    # histogram mass equals the (unpadded) row count per side
+    assert num_h.sum(axis=1).tolist() == [150.0, 150.0]
+    assert cat_h.sum() == 150.0
