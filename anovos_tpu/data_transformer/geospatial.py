@@ -640,23 +640,45 @@ def rog_calculation(idf: Table, lat_col: str, long_col: str, id_col: str) -> pd.
     ).reset_index(drop=True)
 
 
-_GEOCODE_CACHE = {}  # resolved csv path -> (unit_xyz (C,3) np.f32, frame)
+_GEOCODE_CACHE = {}  # resolved path -> (unit_xyz (C,3) np.f32, frame)
 
 
 def _geocode_table() -> tuple:
-    """Bundled offline centroid table (major world cities, every sizeable
-    country's capital included), overridable via ``ANOVOS_GEOCODE_TABLE``
-    (same csv schema: name,admin1,cc,lat,lon).  Cached per resolved path —
-    changing the env override mid-process takes effect — with precomputed
-    unit vectors for the nearest-centroid matmul."""
+    """Offline centroid table with precomputed unit vectors for the
+    nearest-centroid matmul, cached per resolved path (changing the env
+    override mid-process takes effect).  Resolution order:
+
+    1. ``ANOVOS_GEOCODE_TABLE`` — a ``.csv`` (name,admin1,cc,lat,lon) or a
+       ``.npz`` packed by ``tools/build_geonames_table.py`` (geonames
+       cities1000-scale: ~50-150k rows in ~1-2 MB);
+    2. bundled ``data/cities.npz`` when present (drop the geonames build
+       there the first time an environment with the source file appears);
+    3. bundled ``data/world_cities.csv`` fallback (573 cities: world
+       capitals + majors + the zoneinfo city list — coarse: nearest-
+       centroid errors reach hundreds of km off the city list).
+    """
     import os
 
-    path = os.environ.get("ANOVOS_GEOCODE_TABLE") or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "data", "world_cities.csv"
-    )
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    path = os.environ.get("ANOVOS_GEOCODE_TABLE")
+    if not path:
+        npz = os.path.join(d, "cities.npz")
+        path = npz if os.path.exists(npz) else os.path.join(d, "world_cities.csv")
     if path not in _GEOCODE_CACHE:
-        # keep_default_na=False: Namibia's country code IS the string "NA"
-        cities = pd.read_csv(path, keep_default_na=False)
+        if path.endswith(".npz"):
+            z = np.load(path, allow_pickle=False)
+            cities = pd.DataFrame(
+                {
+                    "name": z["name"].astype(str),
+                    "admin1": z["admin1"].astype(str),
+                    "cc": z["cc"].astype(str),
+                    "lat": z["lat"].astype(np.float64),
+                    "lon": z["lon"].astype(np.float64),
+                }
+            )
+        else:
+            # keep_default_na=False: Namibia's country code IS the string "NA"
+            cities = pd.read_csv(path, keep_default_na=False)
         la = np.radians(cities["lat"].to_numpy(float))
         lo = np.radians(cities["lon"].to_numpy(float))
         xyz = np.stack(
@@ -667,15 +689,44 @@ def _geocode_table() -> tuple:
 
 
 @jax.jit
-def _nearest_city_idx(lat_deg: jax.Array, lon_deg: jax.Array, city_xyz: jax.Array) -> jax.Array:
+def _nearest_city_chunk(lat_deg: jax.Array, lon_deg: jax.Array, city_xyz: jax.Array) -> jax.Array:
     """argmin great-circle distance == argmax 3D dot product with the city
-    unit vectors — one (N,3)@(3,C) MXU matmul instead of N×C haversines."""
+    unit vectors — one (n,3)@(3,C) MXU matmul instead of n×C haversines."""
     la = jnp.radians(lat_deg.astype(jnp.float32))
     lo = jnp.radians(lon_deg.astype(jnp.float32))
     pts = jnp.stack(
         [jnp.cos(la) * jnp.cos(lo), jnp.cos(la) * jnp.sin(lo), jnp.sin(la)], axis=1
     )
     return jnp.argmax(pts @ city_xyz.T, axis=1)
+
+
+_GEOCODE_CHUNK = 8192
+
+
+def _nearest_city_idx(lat: np.ndarray, lon: np.ndarray, city_xyz: np.ndarray) -> np.ndarray:
+    """Tiled nearest-centroid search: queries go through in fixed-size
+    chunks (last one padded) so a geonames-scale table (C ≈ 150k) never
+    materializes an (N, C) score matrix for the whole query set, and every
+    chunk reuses ONE compiled shape."""
+    n = len(lat)
+    cx = jnp.asarray(city_xyz)
+    if n <= _GEOCODE_CHUNK:
+        # next power of two: bounded compile count across varying batch sizes
+        pad = min(_GEOCODE_CHUNK, 1 << max(n - 1, 1).bit_length())
+        la = np.zeros(pad, np.float32)
+        lo = np.zeros(pad, np.float32)
+        la[:n], lo[:n] = lat, lon
+        return np.asarray(jax.device_get(_nearest_city_chunk(jnp.asarray(la), jnp.asarray(lo), cx)))[:n]
+    out = np.empty(n, np.int64)
+    for s in range(0, n, _GEOCODE_CHUNK):
+        e = min(s + _GEOCODE_CHUNK, n)
+        la = np.zeros(_GEOCODE_CHUNK, np.float32)
+        lo = np.zeros(_GEOCODE_CHUNK, np.float32)
+        la[: e - s], lo[: e - s] = lat[s:e], lon[s:e]
+        out[s:e] = np.asarray(
+            jax.device_get(_nearest_city_chunk(jnp.asarray(la), jnp.asarray(lo), cx))
+        )[: e - s]
+    return out
 
 
 def reverse_geocoding(idf: Table, lat_col: str, long_col: str, **_ignored) -> pd.DataFrame:
@@ -714,7 +765,7 @@ def reverse_geocoding(idf: Table, lat_col: str, long_col: str, **_ignored) -> pd
         cc = [r["cc"] for r in res]
     except ImportError:
         city_xyz, cities = _geocode_table()
-        idx = np.asarray(jax.device_get(_nearest_city_idx(jnp.asarray(la), jnp.asarray(lo), jnp.asarray(city_xyz))))
+        idx = _nearest_city_idx(la.astype(np.float32), lo.astype(np.float32), city_xyz)
         name = cities["name"].to_numpy()[idx]
         admin1 = cities["admin1"].to_numpy()[idx]
         cc = cities["cc"].to_numpy()[idx]

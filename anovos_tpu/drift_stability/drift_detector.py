@@ -118,7 +118,7 @@ def statistics(
 
     count_target = idf_target.nrows
     from anovos_tpu.data_transformer.model_io import load_model_df, save_model_df
-    from anovos_tpu.ops.drift_kernels import drift_side_full, fit_cutoffs
+    from anovos_tpu.ops.drift_kernels import drift_side_full
     from anovos_tpu.shared.runtime import get_runtime
 
     # single-device meshes have no collectives, so the cutoff-fit and both

@@ -1,0 +1,95 @@
+"""Pack a geonames cities file into the compressed ``cities.npz`` the
+offline reverse geocoder consumes (geospatial._geocode_table).
+
+The reference's offline path resolves against the ``reverse_geocoder``
+package's geonames-derived table (~144k cities; reference geospatial.py:1335,
+requirements.txt).  This environment has zero egress, so the geonames source
+cannot be fetched here — run this the FIRST time an environment with the
+file (or network) appears and drop the output at
+``anovos_tpu/data_transformer/data/cities.npz``:
+
+    python tools/build_geonames_table.py cities1000.txt \
+        --admin1 admin1CodesASCII.txt \
+        --out anovos_tpu/data_transformer/data/cities.npz
+
+Inputs (download.geonames.org/export/dump/):
+  * ``cities1000.txt`` / ``cities500.txt`` / ``cities15000.txt`` — tab-
+    separated, 19 columns: geonameid, name, asciiname, alternatenames,
+    latitude, longitude, feature class, feature code, country code, cc2,
+    admin1 code, admin2, admin3, admin4, population, elevation, dem,
+    timezone, modification date.
+  * ``admin1CodesASCII.txt`` (optional) — ``CC.ADM1<tab>name<tab>ascii
+    <tab>geonameid``; maps admin1 codes to their display names the way
+    ``reverse_geocoder`` does.
+
+Output npz keys: name (unicode), admin1 (unicode), cc (U2), lat (f32),
+lon (f32).  f32 coordinates + savez_compressed keep ~150k rows in ~2 MB.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import sys
+
+import numpy as np
+
+
+def load_admin1_names(path: str) -> dict:
+    out = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) >= 2:
+                out[parts[0]] = parts[1]
+    return out
+
+
+def build(cities_path: str, out_path: str, admin1_path: str = None,
+          min_population: int = 0) -> int:
+    admin1_names = load_admin1_names(admin1_path) if admin1_path else {}
+    names, admins, ccs, lats, lons = [], [], [], [], []
+    with open(cities_path, encoding="utf-8", newline="") as f:
+        for row in csv.reader(f, delimiter="\t", quoting=csv.QUOTE_NONE):
+            if len(row) < 15:
+                continue
+            try:
+                lat, lon = float(row[4]), float(row[5])
+                pop = int(row[14] or 0)
+            except ValueError:
+                continue
+            if pop < min_population:
+                continue
+            cc = row[8]
+            a1_code = f"{cc}.{row[10]}" if row[10] else ""
+            names.append(row[1])
+            admins.append(admin1_names.get(a1_code, row[10]))
+            ccs.append(cc)
+            lats.append(lat)
+            lons.append(lon)
+    if not names:
+        raise SystemExit(f"no rows parsed from {cities_path}")
+    np.savez_compressed(
+        out_path,
+        name=np.array(names, dtype=str),
+        admin1=np.array(admins, dtype=str),
+        cc=np.array(ccs, dtype=str),
+        lat=np.array(lats, dtype=np.float32),
+        lon=np.array(lons, dtype=np.float32),
+    )
+    return len(names)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("cities", help="geonames cities file (tab-separated dump)")
+    ap.add_argument("--admin1", default=None, help="admin1CodesASCII.txt for region names")
+    ap.add_argument("--out", default="anovos_tpu/data_transformer/data/cities.npz")
+    ap.add_argument("--min-population", type=int, default=0)
+    args = ap.parse_args(argv)
+    n = build(args.cities, args.out, args.admin1, args.min_population)
+    print(f"packed {n} cities -> {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
