@@ -17,6 +17,7 @@ block issues two dispatches total instead of ~14.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List, Tuple
 
 import jax
@@ -95,6 +96,79 @@ def describe_numeric(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
         "mode_value": jnp.where(empty, nanv, mode_val),
         "mode_count": mode_cnt,
     }
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def _chunked_chunk_moments(X: jax.Array, M: jax.Array, chunk: int) -> Dict[str, jax.Array]:
+    """Per-chunk centered moments for the compensated path: (rows, k) →
+    dict of (c, k) f32 arrays, one device dispatch.  Each chunk is centered
+    on its OWN mean, so the f32 error of every partial stays bounded by the
+    chunk length instead of the full row count; the cross-chunk combination
+    happens on host in float64 (Chan et al., ops/streaming._combine).
+    The per-chunk body IS streaming's ``_chunk_stats`` vmapped over the
+    chunk axis — one copy of the moment math, one merge contract."""
+    from anovos_tpu.ops.streaming import _chunk_stats
+
+    rows, k = X.shape
+    c = -(-rows // chunk)
+    pad = c * chunk - rows
+    Xp = jnp.pad(X.astype(jnp.float32), ((0, pad), (0, 0)))
+    Mp = jnp.pad(M, ((0, pad), (0, 0)))
+    return jax.vmap(_chunk_stats)(Xp.reshape(c, chunk, k), Mp.reshape(c, chunk, k))
+
+
+_COMPENSATED_CHUNK = 1 << 16
+
+
+def compensated_moments(X: jax.Array, M: jax.Array, chunk: int = _COMPENSATED_CHUNK) -> Dict[str, np.ndarray]:
+    """Chunked-Chan compensated moments (SURVEY §7 hard-part 7): f32 error
+    stops growing with the row count because each 2^16-row chunk is centered
+    locally on device and the chunk partials merge pairwise on host in
+    float64.  Returns float64 host arrays: count/mean/variance/stddev/
+    skewness/kurtosis (sample variance, Fisher kurtosis — describe_numeric
+    conventions).  Measured tolerance vs a float64 two-pass at 10^7 rows is
+    recorded in PERF.md."""
+    from anovos_tpu.ops.streaming import _pairwise_merge
+
+    k = X.shape[1]
+    if X.shape[0] == 0:  # zero-row block: no chunks to merge
+        nank = np.full(k, np.nan)
+        return {"count": np.zeros(k, np.int64), "mean": nank.copy(),
+                "variance": nank.copy(), "stddev": nank.copy(),
+                "skewness": nank.copy(), "kurtosis": nank.copy()}
+    parts_dev = {kk: np.asarray(v, np.float64) for kk, v in _chunked_chunk_moments(X, M, chunk).items()}
+    c = parts_dev["n"].shape[0]
+    agg = _pairwise_merge([{kk: v[i] for kk, v in parts_dev.items()} for i in range(c)])
+    n = agg["n"]
+    safe_n = np.maximum(n, 1.0)
+    m2p = agg["M2"] / safe_n
+    with np.errstate(invalid="ignore", divide="ignore"):
+        var_samp = np.where(n > 1, agg["M2"] / np.maximum(n - 1.0, 1.0), np.nan)
+        skew = np.where(m2p > 0, (agg["M3"] / safe_n) / np.power(np.maximum(m2p, 1e-308), 1.5), np.nan)
+        kurt = np.where(m2p > 0, (agg["M4"] / safe_n) / np.maximum(m2p * m2p, 1e-308) - 3.0, np.nan)
+    return {
+        "count": n.astype(np.int64),
+        "mean": np.where(n > 0, agg["mean"], np.nan),
+        "variance": var_samp,
+        "stddev": np.sqrt(var_samp),
+        "skewness": np.where(n > 0, skew, np.nan),
+        "kurtosis": np.where(n > 0, kurt, np.nan),
+    }
+
+
+# 'auto' turns the compensated path on once plain-f32 tree reductions have
+# demonstrably drifting tails (≥2^24 rows the f32 significand is exhausted
+# by the count alone); '1'/'0' force it either way
+_COMPENSATED_AUTO_ROWS = 1 << 24
+
+
+def _compensated_enabled(rows: int) -> bool:
+    mode = os.environ.get("ANOVOS_COMPENSATED_MOMENTS", "auto").lower()
+    if mode in ("1", "true", "always"):
+        return True
+    if mode in ("0", "false", "never"):
+        return False
+    return rows >= _COMPENSATED_AUTO_ROWS
 
 
 @jax.jit
@@ -189,13 +263,21 @@ def table_describe(idf: Table, num_cols: List[str], cat_cols: List[str]) -> Tupl
     if cache is None:
         cache = {}
         idf._describe_cache = cache
-    key = (tuple(num_cols), tuple(cat_cols))
+    # the compensated mode is a cache INPUT: toggling the env var mid-process
+    # must not serve the other mode's moments
+    rows = idf.columns[num_cols[0]].data.shape[0] if num_cols else 0
+    compensated = bool(num_cols) and _compensated_enabled(rows)
+    key = (tuple(num_cols), tuple(cat_cols), compensated)
     if key in cache:
         return cache[key]
     num_out: dict = {}
     if num_cols:
         X, M = idf.numeric_block(num_cols)
         num_out = {k: np.asarray(v) for k, v in describe_numeric(X, M).items()}
+        if compensated:
+            comp = compensated_moments(X, M)
+            for kk in ("mean", "variance", "stddev", "skewness", "kurtosis"):
+                num_out[kk] = comp[kk]
         wide = [c for c in num_cols if idf.columns[c].is_wide]
         if wide:
             # overwrite the f32-approximate order stats with exact values
