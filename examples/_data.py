@@ -1,0 +1,100 @@
+"""Shared example-data loader.
+
+Uses the income dataset (the reference's own demo data) when a copy is
+available, else synthesizes a comparable frame so every example stays
+runnable in any environment (reference ships the same dataset under
+examples/data/income_dataset; see its demo/README.md).
+"""
+
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+
+
+def honor_jax_platforms_env() -> None:
+    """Make an explicit ``JAX_PLATFORMS`` env choice stick even on hosts
+    whose sitecustomize pre-registers an accelerator plugin (same pattern
+    as bench.py's measured child)."""
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+INCOME_GLOBS = [
+    os.environ.get("ANOVOS_EXAMPLE_DATA", ""),
+    "examples/data/income_dataset/parquet",
+    "/root/reference/examples/data/income_dataset/parquet",
+]
+
+
+def load_income() -> pd.DataFrame:
+    for d in INCOME_GLOBS:
+        if d and os.path.isdir(d):
+            files = sorted(glob.glob(os.path.join(d, "*.parquet")))
+            if files:
+                df = pd.concat([pd.read_parquet(f) for f in files], ignore_index=True)
+                return df.drop(columns=["dt_1", "dt_2", "empty", "logfnl"], errors="ignore")
+    return synthesize(32561)
+
+
+def synthesize(n: int, seed: int = 7) -> pd.DataFrame:
+    """Full income-dataset schema (same 20+ columns the real parquet has,
+    including the logfnl/empty/dt_2 columns the demo configs delete) so the
+    config-driven pipeline runs unchanged on synthesized data."""
+    rng = np.random.default_rng(seed)
+    edu = ["HS-grad", "Some-college", "Bachelors", "Masters", "Doctorate"]
+    occ = ["Tech", "Sales", "Exec", "Craft", "Service", "Farming"]
+    fnlwgt = rng.normal(1.9e5, 1.0e5, n).clip(1e4)
+    lat = rng.uniform(25.0, 48.0, n)
+    lon = rng.uniform(-122.0, -71.0, n)
+    days = rng.integers(0, 3600, n)
+    dt = pd.Timestamp("2015-01-01") + pd.to_timedelta(days, unit="D")
+    df = pd.DataFrame(
+        {
+            "ifa": [f"id{i:06d}" for i in range(n)],
+            "age": rng.integers(17, 90, n).astype(float),
+            "workclass": rng.choice(["Private", "Self-emp", "Federal-gov", "Local-gov"], n),
+            "fnlwgt": fnlwgt,
+            "logfnl": np.log(fnlwgt),
+            "education": rng.choice(edu, n, p=[0.35, 0.25, 0.2, 0.15, 0.05]),
+            "education-num": rng.integers(1, 16, n).astype(float),
+            "marital-status": rng.choice(["Married", "Never-married", "Divorced"], n),
+            "occupation": rng.choice(occ, n),
+            "relationship": rng.choice(["Husband", "Wife", "Own-child", "Unmarried"], n),
+            "race": rng.choice(["White", "Black", "Asian-Pac", "Other"], n),
+            "sex": rng.choice(["Male", "Female"], n),
+            "capital-gain": np.where(rng.random(n) < 0.08, rng.gamma(2, 5000, n), 0.0),
+            "capital-loss": np.where(rng.random(n) < 0.05, rng.gamma(2, 900, n), 0.0),
+            "hours-per-week": rng.integers(1, 99, n).astype(float),
+            "native-country": rng.choice(["United-States", "Mexico", "Philippines", "Germany"], n),
+            "income": rng.choice(["<=50K", ">50K"], n, p=[0.76, 0.24]),
+            "label": rng.integers(0, 2, n).astype(float),
+            "latitude": lat,
+            "longitude": lon,
+            "geohash": [f"9q{i % 97:02d}" for i in range(n)],
+            "empty": np.full(n, np.nan),
+            "dt_1": dt.strftime("%Y-%m-%d"),
+            "dt_2": (dt + pd.Timedelta(days=30)).strftime("%Y-%m-%d"),
+        }
+    )
+    df.loc[df.sample(frac=0.02, random_state=0).index, "age"] = np.nan
+    return df
+
+
+def materialize_income_parquet(dest_dir, n: int = 8000):
+    """Write the synthesized dataset (and its ifa-keyed join side) as
+    parquet under ``dest_dir`` — lets the config-driven demo run on hosts
+    without the reference dataset checkout.  Returns (main_dir, join_dir)."""
+    import pathlib
+
+    dest = pathlib.Path(dest_dir)
+    main_dir = dest / "parquet"
+    join_dir = dest / "join"
+    main_dir.mkdir(parents=True, exist_ok=True)
+    join_dir.mkdir(parents=True, exist_ok=True)
+    df = synthesize(n)
+    df.to_parquet(main_dir / "part-00000.parquet", index=False)
+    df[["ifa", "age", "workclass"]].to_parquet(join_dir / "part-00000.parquet", index=False)
+    return str(main_dir), str(join_dir)

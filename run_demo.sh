@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Demo entry point (reference parity: run_anovos_demo.sh builds the demo
+# image, runs the pipeline, and copies the finished report out).
+#
+#   ./run_demo.sh            # local: run the demo pipeline in-process
+#   ./run_demo.sh docker     # containerized: build image, run, copy report
+set -euo pipefail
+cd "$(dirname "$0")"
+
+if [ "${1:-local}" = "docker" ]; then
+  docker build . -t anovos-tpu-demo
+  docker rm -f anovos_tpu_demo >/dev/null 2>&1 || true
+  docker run --name anovos_tpu_demo anovos-tpu-demo:latest
+  docker cp anovos_tpu_demo:/app/report_stats/ml_anovos_report.html . \
+    || docker cp anovos_tpu_demo:/app/report_stats/basic_report.html .
+  echo "report copied to $(pwd)"
+else
+  python examples/03_full_report.py "${2:-demo_output}"
+fi
