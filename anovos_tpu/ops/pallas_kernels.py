@@ -1,14 +1,20 @@
-"""Pallas TPU kernels for the hot histogram path.
+"""Pallas TPU kernels for the hot histogram path — **EXPERIMENTAL**.
 
 ``binned_histograms_pallas`` fuses binning + counting for the drift/report
 pipeline into a hand-scheduled kernel: the row dimension streams through
 VMEM in tiles (grid), each tile does the compare-count binning and the
 lane-compare histogram entirely on the VPU, and the (k, nbins) accumulator
 lives in the output block across grid steps (initialized on the first step).
-Functionally identical to ops/drift_kernels.binned_histograms — the XLA
-version remains the default; enable with ``ANOVOS_USE_PALLAS=1``.  The
-kernel is also exercised in interpret mode by the test suite so its logic is
-verified even without TPU hardware.
+Functionally identical to ops/drift_kernels.binned_histograms.
+
+Status (PERF.md "Pallas status"): the kernels are parity-verified in
+interpret mode (tests/test_pallas_kernels.py) but have NEVER executed
+Mosaic-compiled in this environment — the remote-TPU tunnel's compile
+bridge returns HTTP 500 for Mosaic payloads — so there is no measured
+XLA-vs-Pallas comparison and **no performance claim**.  The XLA versions
+are the production default; ``ANOVOS_USE_PALLAS=1`` opts in and warns.
+``tools/tpu_capture.sh`` attempts one compiled run whenever a tunnel
+window opens; promote these kernels only after that lands a number.
 """
 
 from __future__ import annotations
@@ -165,5 +171,30 @@ def moments_pallas(X: jax.Array, M: jax.Array, interpret: bool = False) -> jax.A
     )(X.astype(jnp.float32), M)
 
 
+_WARNED = False
+
+
 def use_pallas() -> bool:
-    return _PALLAS_OK and os.environ.get("ANOVOS_USE_PALLAS", "0") == "1"
+    global _WARNED
+    if not (_PALLAS_OK and os.environ.get("ANOVOS_USE_PALLAS", "0") == "1"):
+        return False
+    import warnings
+
+    if jax.default_backend() != "tpu":
+        if not _WARNED:
+            warnings.warn(
+                "ANOVOS_USE_PALLAS=1 ignored: compiled pallas_call is "
+                "TPU-only (CPU supports interpret mode only — used by the "
+                "test suite); falling back to the XLA kernels."
+            )
+            _WARNED = True
+        return False
+    if not _WARNED:
+        warnings.warn(
+            "ANOVOS_USE_PALLAS=1: the Pallas kernels are EXPERIMENTAL — "
+            "interpret-mode parity-tested only, never executed Mosaic-"
+            "compiled in this environment, no measured perf claim (PERF.md "
+            "'Pallas status')."
+        )
+        _WARNED = True
+    return True
