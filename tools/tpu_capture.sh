@@ -36,7 +36,10 @@ section() {  # name, timeout, cmd...
 }
 
 if [ "$(probe)" != "tpu-ok" ]; then echo "tunnel down; aborting"; exit 1; fi
-section ae 580 python perf_report.py --section ae
+# the ae sweep runs 4 configs (each a remote compile); it flushes a
+# cumulative result line per config, so even a timeout keeps the finished
+# part — but give it room for the compiles
+section ae 1200 python perf_report.py --section ae
 section bench 3500 env BENCH_TPU_PROBE_TIMEOUT=300 python bench.py
 section pallas 580 env ANOVOS_USE_PALLAS=1 python perf_report.py --section hist
 echo "== done: ${OUT}_*.json =="
