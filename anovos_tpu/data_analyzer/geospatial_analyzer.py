@@ -37,22 +37,34 @@ def _latlon_points(idf: Table, lat_col: str, lon_col: str, max_records: int) -> 
     return pts
 
 
-def _silhouette(X: np.ndarray, labels: np.ndarray, sample: int = 2000) -> float:
-    """Mean silhouette on a sample (sklearn metric, computed directly)."""
+def _silhouette(
+    X: np.ndarray, labels: np.ndarray, sample: int = 2000, D_full=None
+) -> float:
+    """Mean silhouette on a sample (sklearn metric, computed directly).
+
+    ``D_full`` — a precomputed (n, n) distance matrix over ALL of X — lets a
+    hyperparameter grid skip rebuilding the sample's distance block for
+    every combo (the sample indices select the same distances)."""
     valid = labels >= 0
+    vidx = np.nonzero(valid)[0]
     X, labels = X[valid], labels[valid]
     if len(np.unique(labels)) < 2 or len(X) < 10:
         return -1.0
     if len(X) > sample:
         pick = np.random.default_rng(1).choice(len(X), sample, replace=False)
         Xs, ls = X[pick], labels[pick]
+        sel = vidx[pick]
     else:
         Xs, ls = X, labels
-    D = np.sqrt(
-        np.maximum(
-            (Xs**2).sum(1)[:, None] - 2 * Xs @ Xs.T + (Xs**2).sum(1)[None, :], 0
+        sel = vidx
+    if D_full is not None:
+        D = D_full[np.ix_(sel, sel)]
+    else:
+        D = np.sqrt(
+            np.maximum(
+                (Xs**2).sum(1)[:, None] - 2 * Xs @ Xs.T + (Xs**2).sum(1)[None, :], 0
+            )
         )
-    )
     # fully vectorized: per-cluster distance sums via one matmul
     uniq, inv = np.unique(ls, return_inverse=True)
     k = len(uniq)
@@ -334,7 +346,9 @@ def cluster_analysis(
         # sklearn scan — and unscaled was both wrong and 6× slower)
         sub = sub[np.random.default_rng(2).choice(len(sub), grid_cap, replace=False)]
     frac = len(sub) / max(len(pts), 1)
-    from anovos_tpu.ops.cluster import dbscan_grid, dbscan_host_grid, neighbor_counts, pairwise_d2
+    from anovos_tpu.ops.cluster import (
+        dbscan_grid, dbscan_host_grid_multi, neighbor_counts, pairwise_d2,
+    )
 
     ms_values = list(range(m0, m1 + 1, mstep))
     ms_eff = [max(2, int(round(m * frac))) for m in ms_values]
@@ -343,14 +357,19 @@ def cluster_analysis(
     # host.  ANOVOS_DBSCAN_HOST_CC_MAX bounds the host memory (n² f32 +
     # transient edge lists); samples above it — a grid cap RAISED beyond the
     # 4096 default — use the tiled on-device propagation path instead.
+    eps_values = [float(e) for e in np.arange(e0, e1 + 1e-9, estep)]
     D2 = None
-    if len(sub) <= int(os.environ.get("ANOVOS_DBSCAN_HOST_CC_MAX", 6144)):
+    D_full = None
+    if eps_values and len(sub) <= int(os.environ.get("ANOVOS_DBSCAN_HOST_CC_MAX", 6144)):
         Xc = np.asarray(sub, np.float32)
         Xc = Xc - Xc.mean(axis=0, keepdims=True)  # f32 bits follow the spread
         D2 = np.asarray(jax.device_get(pairwise_d2(jnp.asarray(Xc))))
-    for e in np.arange(e0, e1 + 1e-9, estep):
+        # distances reused by every combo's silhouette sample
+        D_full = np.sqrt(np.maximum(D2, 0.0))
+        all_labels = dbscan_host_grid_multi(D2, eps_values, ms_eff)
+    for a, e in enumerate(eps_values):
         if D2 is not None:
-            labels_b = dbscan_host_grid(D2, float(e), ms_eff)
+            labels_b = all_labels[a]
         else:
             # one neighbor-count pass per eps; all min_samples labeled in ONE
             # batched device program (fixed shapes — one compile for the grid)
@@ -358,7 +377,7 @@ def cluster_analysis(
             labels_b = dbscan_grid(sub, float(e), ms_eff, counts=counts)
         for m, labels in zip(ms_values, labels_b):
             n_clusters = len(set(labels[labels >= 0]))
-            score = _silhouette(sub, labels) if n_clusters >= 2 else -1.0
+            score = _silhouette(sub, labels, D_full=D_full) if n_clusters >= 2 else -1.0
             rows.append(
                 {
                     "eps": round(float(e), 4),

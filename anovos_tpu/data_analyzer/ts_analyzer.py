@@ -9,10 +9,13 @@ tabs.  Calendar decomposition is int32 epoch math in one vectorized pass.
 
 from __future__ import annotations
 
+import functools
 import os
 from pathlib import Path
 from typing import List, Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
@@ -88,8 +91,6 @@ _DOW_NAMES = ["Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun"]
 
 def _grain_buckets(tcol, grain: str):
     """Device bucket ids + host labels for hourly (daypart) / weekly (dow)."""
-    import jax.numpy as jnp
-
     from anovos_tpu.ops import datetime_kernels as dk
 
     if grain == "hourly":
@@ -102,8 +103,6 @@ def _grain_buckets(tcol, grain: str):
 def _num_viz_small_grain(idf: Table, ts_col: str, num_cols: List[str], grain: str) -> pd.DataFrame:
     """min/max/mean/median of every numeric column per daypart / weekday —
     one device segment program (reference ts_viz_data :259-406 hourly/weekly)."""
-    import jax
-
     from anovos_tpu.data_transformer.datetime import _segment_aggregate
 
     tcol = idf.columns[ts_col]
@@ -133,9 +132,6 @@ def _num_viz_small_grain(idf: Table, ts_col: str, num_cols: List[str], grain: st
 def _cat_viz(idf: Table, ts_col: str, cat_cols: List[str], n_cat: int = 10) -> pd.DataFrame:
     """Top-N + Others category counts per day per categorical column
     (reference's string branch of ts_viz_data)."""
-    import jax
-    import jax.numpy as jnp
-
     from anovos_tpu.data_transformer.datetime import _bucket_ids, _bucket_start_secs, _col_min_max
     from anovos_tpu.ops.segment import code_counts
 
@@ -167,20 +163,16 @@ def _cat_viz(idf: Table, ts_col: str, cat_cols: List[str], n_cat: int = 10) -> p
     return pd.DataFrame(rows, columns=["date", "attribute", "category", "count"])
 
 
+@functools.partial(jax.jit, static_argnames=("ndays", "ncat"))
 def _combo_counts(codes, mask, lut, day0, ndays: int, ncat: int):
-    import jax
-    import jax.numpy as jnp
-
-    @jax.jit
-    def prog(codes, mask, lut, day0):
-        valid = mask & (codes >= 0)
-        cb = lut[jnp.clip(codes, 0, lut.shape[0] - 1)]
-        seg = jnp.where(valid, day0 * ncat + cb, ndays * ncat)
-        return jax.ops.segment_sum(
-            valid.astype(jnp.float32), seg, num_segments=ndays * ncat + 1
-        )[: ndays * ncat]
-
-    return prog(codes, mask, lut, day0)
+    # module-level jit: a per-call closure jit object would discard the
+    # compile cache and re-pay ~0.1s × n_cat_cols on EVERY ts_analyzer call
+    valid = mask & (codes >= 0)
+    cb = lut[jnp.clip(codes, 0, lut.shape[0] - 1)]
+    seg = jnp.where(valid, day0 * ncat + cb, ndays * ncat)
+    return jax.ops.segment_sum(
+        valid.astype(jnp.float32), seg, num_segments=ndays * ncat + 1
+    )[: ndays * ncat]
 
 
 def ts_viz_data(
