@@ -169,12 +169,20 @@ def read_host_frame(files: List[str], file_type: str, cfg: dict) -> pd.DataFrame
         # re-coerce object columns that are numeric across ALL parts.
         for c in df.columns:
             if df[c].dtype == object or str(df[c].dtype) in ("string", "str"):
-                coerced = pd.to_numeric(df[c], errors="coerce")
                 nonnull = df[c].notna()
-                if nonnull.any() and coerced[nonnull].notna().all():
-                    df[c] = coerced
-                elif not nonnull.any():
-                    df[c] = coerced  # all-null column → numeric NaN column
+                if nonnull.any():
+                    # cheap pre-check: a genuinely-string column (the common
+                    # case) is rejected on a small head sample instead of
+                    # paying a full-column to_numeric per string column
+                    head = df[c][nonnull].iloc[:1024]
+                    if pd.to_numeric(head, errors="coerce").isna().any():
+                        continue
+                    coerced = pd.to_numeric(df[c], errors="coerce")
+                    if coerced[nonnull].notna().all():
+                        df[c] = coerced
+                else:
+                    # all-null column → numeric NaN column
+                    df[c] = pd.to_numeric(df[c], errors="coerce")
     return df
 
 

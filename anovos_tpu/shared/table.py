@@ -30,6 +30,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pandas as pd
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from anovos_tpu.shared.runtime import get_runtime
@@ -393,7 +394,6 @@ class Table:
     # host materialization
     # ------------------------------------------------------------------
     def to_pandas(self):
-        import pandas as pd
 
         out = {}
         n = self.nrows
@@ -467,9 +467,9 @@ def _host_to_column(arr: np.ndarray, n: int, npad: int, rt) -> Column:
     if arr.dtype == object or arr.dtype.kind in ("U", "S"):
         # categorical: dictionary-encode on host, codes on device
         vals = arr[:n]
-        isnull = np.array([v is None or (isinstance(v, float) and np.isnan(v)) for v in vals])
-        strs = np.array(["" if b else str(v) for v, b in zip(vals, isnull)], dtype=object)
-        vocab, codes = np.unique(strs[~isnull], return_inverse=True)
+        isnull = pd.isna(vals)
+        nn_strs = np.array([str(v) for v in vals[~isnull]], dtype=object)
+        vocab, codes = np.unique(nn_strs, return_inverse=True)
         code_arr = np.full(n, -1, dtype=np.int32)
         code_arr[~isnull] = codes.astype(np.int32)
         data = rt.shard_rows(_pad_to(code_arr, npad, -1))
