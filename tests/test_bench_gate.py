@@ -15,12 +15,18 @@ import numpy as np
 import pandas as pd
 import pytest
 
-_spec = importlib.util.spec_from_file_location(
-    "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
-)
-bench = importlib.util.module_from_spec(_spec)
-sys.modules["bench"] = bench
-_spec.loader.exec_module(bench)
+def _load_script(name):
+    """Import a repo-root script (bench.py / perf_report.py) as a module."""
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(os.path.dirname(__file__), "..", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench = _load_script("bench")
 
 
 def _write_capture(d, ts, backend="tpu", before="tpu-ok", after="tpu-ok", metric=True):
@@ -85,6 +91,28 @@ def test_e2e_rows_derived_from_config():
     # configs_full reads the income parquet: the derived count must match
     # the dataset, not a hardwired constant
     assert bench._e2e_rows() == 32561
+
+
+def test_ae_sweep_env_override_and_best_selection(monkeypatch):
+    """The capture path the round hinges on: ANOVOS_AE_SWEEP drives the
+    configs (malformed entries skipped), and the headline prefers the
+    best-MFU bf16 run over a faster-raw-TFLOPs f32 run."""
+    perf = _load_script("perf_report")
+
+    monkeypatch.setenv("ANOVOS_AE_SWEEP", "512:32:f32,garbage,256:32:bf16")
+    out = perf.bench_ae_mfu()
+    assert len(out["sweep"]) == 2  # malformed entry skipped
+    assert all("tflops" in r for r in out["sweep"])  # both real ones RAN
+    assert out["compute"] == "bf16"  # bf16 headline even if f32 ran
+
+    # _ae_best: a 62%-MFU f32 run must not displace a 30%-MFU bf16 headline
+    runs = [
+        {"tflops": 61.0, "mfu_pct": 62.0, "compute": "f32"},
+        {"tflops": 60.0, "mfu_pct": 30.0, "compute": "bf16"},
+    ]
+    assert perf._ae_best(runs)["compute"] == "bf16"
+    assert perf._ae_best([runs[0]])["compute"] == "f32"  # fallback when no bf16
+    assert perf._ae_best([{"error": "x"}]) == {}
 
 
 def test_steady_state_args_shapes():
