@@ -1,0 +1,26 @@
+"""anovos_tpu — a TPU-native feature-engineering-at-scale framework.
+
+A ground-up JAX/XLA re-design of the Anovos workflow (reference:
+/root/reference, src/main/anovos): the Spark DataFrame engine is replaced by a
+device-sharded columnar Table, Spark SQL aggregations by batched XLA
+reductions with ICI collectives, and driver-side sklearn/TF models by
+JAX-native models trained on TPU.
+
+Subpackages mirror the reference's module surface (workflow.py dispatches by
+the same YAML top-level keys):
+
+- ``shared``            runtime (mesh singleton) + Table + dtype utils
+- ``ops``               the kernel library (masked reductions, quantiles,
+                        histograms, segment ops, correlation, ALS, KNN, ...)
+- ``parallel``          mesh construction, sharding helpers, collectives
+- ``data_ingest``       read/write/concat/join/column ops/sampling/auto-detect
+- ``data_analyzer``     stats_generator, quality_checker, association_evaluator,
+                        ts_analyzer, geospatial_analyzer
+- ``drift_stability``   drift_detector, stability
+- ``data_transformer``  transformers, datetime, geospatial
+- ``data_report``       report_preprocessing + report generation (host-side)
+- ``models``            JAX/flax models (autoencoder latent features, ...)
+- ``feature_recommender`` / ``feature_store``
+"""
+
+from anovos_tpu.version import __version__  # noqa: F401

@@ -1,0 +1,8 @@
+"""``python -m anovos_tpu <config.yaml> <run_type>`` (reference: anovos/__main__.py:5)."""
+
+import sys
+
+from anovos_tpu import workflow
+
+if __name__ == "__main__":
+    workflow.run(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else "local")

@@ -1,0 +1,9 @@
+"""Analytics modules: statistics, data quality, associations, ts & geo.
+
+Mirrors the reference's ``data_analyzer/`` public surface
+(src/main/anovos/data_analyzer/) with the Spark SQL aggregation engine
+replaced by the batched kernels in :mod:`anovos_tpu.ops` — one fused XLA
+reduction per metric family instead of one Spark job per column.
+Stats results are small host pandas frames (the reference's "tiny stats
+DataFrame" analogue) written through the same CSV contract the report reads.
+"""

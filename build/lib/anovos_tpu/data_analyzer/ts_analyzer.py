@@ -1,0 +1,405 @@
+"""Time-series inspection (reference: data_analyzer/ts_analyzer.py).
+
+For each timestamp column: calendar-feature extraction (dayparts :52,
+weekday/weekend), eligibility scoring (``ts_eligiblity_check`` :160), and
+visualization data dumps at daily/hourly/weekly grain (``ts_viz_data`` :259)
+written into ``output_path`` as ``ts_*`` CSVs for the report's time-series
+tabs.  Calendar decomposition is int32 epoch math in one vectorized pass.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from pathlib import Path
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from anovos_tpu.shared.table import Table
+from anovos_tpu.shared.utils import ends_with
+
+# the ts_stats.csv schema — shared by eligibility rows and the empty case
+TS_STATS_COLUMNS = [
+    "attribute", "eligible", "reason", "span_days", "distinct_days",
+    "null_pct", "min_ts", "max_ts",
+]
+
+
+def _ts_frame(idf: Table, col: str) -> pd.Series:
+    c = idf.columns[col]
+    secs = np.asarray(c.data)[: idf.nrows].astype("int64")
+    mask = np.asarray(c.mask)[: idf.nrows]
+    ts = pd.Series(secs.view("datetime64[s]") if False else secs.astype("datetime64[s]"))
+    ts[~mask] = pd.NaT
+    return ts
+
+
+def daypart_cat(hour: pd.Series) -> pd.Series:
+    """Reference dayparts (:52): late_hours / early_hours / work_hours …"""
+    bins = pd.cut(
+        hour,
+        bins=[-1, 5, 9, 16, 20, 23],
+        labels=["late_hours", "early_hours", "work_hours", "evening_hours", "night_hours"],
+    )
+    return bins.astype(str)
+
+
+def ts_processed_feats(idf: Table, col: str) -> pd.DataFrame:
+    """Per-row calendar features for one ts column (reference :87-158)."""
+    ts = _ts_frame(idf, col)
+    out = pd.DataFrame({col: ts})
+    out["date"] = ts.dt.date
+    out["hour"] = ts.dt.hour
+    out["dayofweek"] = ts.dt.dayofweek
+    out["is_weekend"] = ts.dt.dayofweek >= 5
+    out["daypart"] = daypart_cat(ts.dt.hour)
+    out["month"] = ts.dt.month
+    out["yyyymmdd_col"] = ts.dt.strftime("%Y-%m-%d")
+    return out
+
+
+def ts_eligiblity_check(idf: Table, col: str, id_col: Optional[str] = None, max_days: int = 3600) -> dict:
+    """Eligibility stats (reference :160-257): span, distinct days, null pct."""
+    ts = _ts_frame(idf, col)
+    valid = ts.dropna()
+    if len(valid) == 0:
+        return {"attribute": col, "eligible": 0, "reason": "all null"}
+    span_days = (valid.max() - valid.min()).days
+    distinct_days = valid.dt.date.nunique()
+    return {
+        "attribute": col,
+        "eligible": int(0 < span_days <= max_days and distinct_days > 1),
+        "span_days": span_days,
+        "distinct_days": distinct_days,
+        "null_pct": round(1 - len(valid) / max(idf.nrows, 1), 4),
+        "min_ts": str(valid.min()),
+        "max_ts": str(valid.max()),
+    }
+
+
+# daypart labels per hour 0..23 (reference dayparts :52)
+_DAYPART_LUT = np.array(
+    [0] * 6 + [1] * 4 + [2] * 7 + [3] * 4 + [4] * 3, np.int32
+)
+_DAYPART_NAMES = ["late_hours", "early_hours", "work_hours", "evening_hours", "night_hours"]
+_DOW_NAMES = ["Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun"]
+
+
+def _grain_buckets(tcol, grain: str):
+    """Device bucket ids + host labels for hourly (daypart) / weekly (dow)."""
+    from anovos_tpu.ops import datetime_kernels as dk
+
+    if grain == "hourly":
+        hour = dk.extract_unit(tcol.data, "hour")
+        return jnp.asarray(_DAYPART_LUT)[jnp.clip(hour, 0, 23)], _DAYPART_NAMES
+    dow = dk.extract_unit(tcol.data, "dayofweek") - 1  # Mon=0
+    return jnp.clip(dow, 0, 6), _DOW_NAMES
+
+
+def _num_viz_small_grain(idf: Table, ts_col: str, num_cols: List[str], grain: str) -> pd.DataFrame:
+    """min/max/mean/median of every numeric column per daypart / weekday —
+    one device segment program (reference ts_viz_data :259-406 hourly/weekly)."""
+    from anovos_tpu.data_transformer.datetime import _segment_aggregate
+
+    tcol = idf.columns[ts_col]
+    ids, labels = _grain_buckets(tcol, grain)
+    V, Mv = idf.numeric_block(num_cols)
+    cnt, sm, _, mn, mx, med = jax.device_get(
+        _segment_aggregate(ids, tcol.mask, V, Mv, len(labels))
+    )
+    rows = []
+    for j, c in enumerate(num_cols):
+        for b, lbl in enumerate(labels):
+            if cnt[j][b] > 0:
+                rows.append(
+                    {
+                        "bucket": lbl,
+                        "attribute": c,
+                        "count": int(cnt[j][b]),
+                        "min": round(float(mn[j][b]), 4),
+                        "max": round(float(mx[j][b]), 4),
+                        "mean": round(float(sm[j][b] / cnt[j][b]), 4),
+                        "median": round(float(med[j][b]), 4),
+                    }
+                )
+    return pd.DataFrame(rows, columns=["bucket", "attribute", "count", "min", "max", "mean", "median"])
+
+
+def _cat_viz(idf: Table, ts_col: str, cat_cols: List[str], n_cat: int = 10) -> pd.DataFrame:
+    """Top-N + Others category counts per day per categorical column
+    (reference's string branch of ts_viz_data)."""
+    from anovos_tpu.data_transformer.datetime import _bucket_ids, _bucket_start_secs, _col_min_max
+    from anovos_tpu.ops.segment import code_counts
+
+    tcol = idf.columns[ts_col]
+    day_ids = _bucket_ids(tcol.data, "day")
+    lo, hi = _col_min_max(day_ids, tcol.mask)
+    if lo > hi:
+        return pd.DataFrame(columns=["date", "attribute", "category", "count"])
+    ndays = hi - lo + 1
+    rows = []
+    for c in cat_cols:
+        col = idf.columns[c]
+        nv = max(len(col.vocab), 1)
+        cnts = np.asarray(jax.device_get(code_counts(col.data, col.mask, nv)))
+        top = np.argsort(-cnts)[:n_cat]
+        lut = np.full(nv, n_cat, np.int32)  # → Others
+        lut[top] = np.arange(len(top), dtype=np.int32)
+        combo = _combo_counts(
+            col.data, col.mask & tcol.mask, jnp.asarray(lut), day_ids - lo, ndays, n_cat + 1
+        )
+        combo = np.asarray(jax.device_get(combo)).reshape(ndays, n_cat + 1)
+        labels = [str(col.vocab[j]) for j in top] + ["Others"]
+        day_idx, cat_idx = np.nonzero(combo)
+        dates = pd.Series(
+            _bucket_start_secs(day_idx + lo, "day").astype("datetime64[s]")
+        ).dt.strftime("%Y-%m-%d")
+        for d, k, cval in zip(dates, cat_idx, combo[day_idx, cat_idx]):
+            rows.append({"date": d, "attribute": c, "category": labels[k], "count": int(cval)})
+    return pd.DataFrame(rows, columns=["date", "attribute", "category", "count"])
+
+
+@functools.partial(jax.jit, static_argnames=("ndays", "ncat"))
+def _combo_counts(codes, mask, lut, day0, ndays: int, ncat: int):
+    # module-level jit: a per-call closure jit object would discard the
+    # compile cache and re-pay ~0.1s × n_cat_cols on EVERY ts_analyzer call
+    valid = mask & (codes >= 0)
+    cb = lut[jnp.clip(codes, 0, lut.shape[0] - 1)]
+    seg = jnp.where(valid, day0 * ncat + cb, ndays * ncat)
+    return jax.ops.segment_sum(
+        valid.astype(jnp.float32), seg, num_segments=ndays * ncat + 1
+    )[: ndays * ncat]
+
+
+def ts_viz_data(
+    idf: Table, col: str, output_path: str, output_type: str = "daily"
+) -> None:
+    """Per-column visualization data at THREE grains (reference :259-406):
+    daily (date buckets), hourly (dayparts), weekly (weekdays) — numeric
+    columns get min/max/mean/median per bucket via the device segment
+    kernels; categorical columns get top-10+Others daily counts.  Plus the
+    daily count series with seasonal decomposition and ADF/KPSS
+    stationarity (report_generation.py:1942-3208 tab suite inputs)."""
+    from anovos_tpu.data_transformer.datetime import aggregator
+
+    out = ends_with(output_path)
+    num_all, cat_all, _ = idf.attribute_type_segregation()
+    num_cols = [c for c in num_all][:20]
+    cat_cols = [c for c in cat_all][:10]
+
+    feats = ts_processed_feats(idf, col)
+    feats = feats.dropna(subset=[col])
+    daily = feats.groupby("yyyymmdd_col").size().reset_index(name="count")
+    daily.to_csv(out + f"ts_daily_{col}.csv", index=False)
+
+    # numeric viz: daily via the device groupby-aggregator, small grains via
+    # one segment program each
+    if num_cols:
+        dv = aggregator(idf, num_cols, ["count", "min", "max", "mean", "median"], col, "%Y-%m-%d")
+        long_rows = []
+        for c in num_cols:
+            sub = pd.DataFrame(
+                {
+                    "date": dv[col],
+                    "attribute": c,
+                    "count": dv[f"{c}_count"],
+                    "min": dv[f"{c}_min"].round(4),
+                    "max": dv[f"{c}_max"].round(4),
+                    "mean": dv[f"{c}_mean"].round(4),
+                    "median": dv[f"{c}_median"].round(4),
+                }
+            )
+            long_rows.append(sub[sub["count"] > 0])
+        pd.concat(long_rows, ignore_index=True).to_csv(out + f"ts_num_daily_{col}.csv", index=False)
+        _num_viz_small_grain(idf, col, num_cols, "hourly").to_csv(
+            out + f"ts_num_hourly_{col}.csv", index=False
+        )
+        _num_viz_small_grain(idf, col, num_cols, "weekly").to_csv(
+            out + f"ts_num_weekly_{col}.csv", index=False
+        )
+    if cat_cols:
+        _cat_viz(idf, col, cat_cols).to_csv(out + f"ts_cat_daily_{col}.csv", index=False)
+
+    # seasonal decomposition + stationarity of the daily count series
+    dec = seasonal_decompose_ma(daily["count"].to_numpy(), period=7)
+    if dec is not None:
+        trend, seas, resid = dec
+        pd.DataFrame(
+            {
+                "date": daily["yyyymmdd_col"],
+                "observed": daily["count"],
+                "trend": np.round(trend, 4),
+                "seasonal": np.round(seas, 4),
+                "residual": np.round(resid, 4),
+            }
+        ).to_csv(out + f"ts_decompose_{col}.csv", index=False)
+    adf = adf_test(daily["count"].to_numpy())
+    kpss = kpss_test(daily["count"].to_numpy())
+    if adf is not None or kpss is not None:
+        pd.DataFrame([{"attribute": col, **(adf or {}), **(kpss or {})}]).to_csv(
+            ends_with(output_path) + f"ts_stationarity_{col}.csv", index=False
+        )
+    hourly = feats.groupby("hour").size().reset_index(name="count")
+    hourly.to_csv(out + f"ts_hourly_{col}.csv", index=False)
+    weekly = feats.groupby("dayofweek").size().reset_index(name="count")
+    weekly.to_csv(out + f"ts_weekly_{col}.csv", index=False)
+    dayparts = feats.groupby("daypart").size().reset_index(name="count")
+    dayparts.to_csv(out + f"ts_daypart_{col}.csv", index=False)
+
+
+def seasonal_decompose_ma(series: np.ndarray, period: int = 7):
+    """Additive moving-average decomposition (the statsmodels
+    seasonal_decompose recipe the reference's report uses — statsmodels
+    itself is optional here): centered-MA trend, mean-by-phase seasonal,
+    residual."""
+    y = np.asarray(series, float)
+    n = len(y)
+    if n < 2 * period:
+        return None
+    kernel = np.ones(period) / period
+    if period % 2 == 0:  # centered MA for even periods
+        kernel = np.concatenate([[0.5], np.ones(period - 1), [0.5]]) / period
+    trend = np.convolve(y, kernel, mode="same")
+    half = len(kernel) // 2
+    trend[:half] = np.nan
+    trend[n - half :] = np.nan
+    detr = y - trend
+    seasonal = np.array([np.nanmean(detr[p::period]) for p in range(period)])
+    seasonal = seasonal - np.nanmean(seasonal)
+    seas_full = np.tile(seasonal, n // period + 1)[:n]
+    resid = y - trend - seas_full
+    return trend, seas_full, resid
+
+
+def adf_test(series: np.ndarray, max_lag: int = None):
+    """Augmented Dickey-Fuller t-statistic (constant-only regression) with
+    MacKinnon critical values — the stationarity check the reference's
+    report runs via statsmodels.adfuller."""
+    y = np.asarray(series, float)
+    y = y[~np.isnan(y)]
+    n = len(y)
+    if n < 10:
+        return None
+    if np.allclose(y, y[0]):
+        # constant series: the level/intercept regressors are collinear and
+        # the degenerate t-stat would misreport maximal stationarity as
+        # non-stationary (statsmodels raises here); report stationary
+        return {"adf_stat": float("-inf"), "stationary_1%": 1, "stationary_5%": 1, "stationary_10%": 1}
+    if max_lag is None:
+        max_lag = min(int(np.ceil(12 * (n / 100) ** 0.25)), n // 2 - 2)
+    dy = np.diff(y)
+    best = None
+    lag = max_lag
+    while lag >= 0:
+        rows = len(dy) - lag
+        if rows < 5 + lag:
+            lag -= 1
+            continue
+        Xcols = [y[lag : lag + rows], np.ones(rows)]
+        for i in range(1, lag + 1):
+            Xcols.append(dy[lag - i : lag - i + rows])
+        Xm = np.column_stack(Xcols)
+        target = dy[lag : lag + rows]
+        beta, res, rank, _ = np.linalg.lstsq(Xm, target, rcond=None)
+        resid = target - Xm @ beta
+        dof = rows - Xm.shape[1]
+        if dof <= 0:
+            lag -= 1
+            continue
+        sigma2 = resid @ resid / dof
+        cov = sigma2 * np.linalg.pinv(Xm.T @ Xm)
+        se = np.sqrt(max(cov[0, 0], 1e-300))
+        best = float(beta[0] / se)
+        break
+    if best is None:
+        return None
+    crit = {"1%": -3.43, "5%": -2.86, "10%": -2.57}
+    return {"adf_stat": round(best, 4), **{f"stationary_{k}": int(best < v) for k, v in crit.items()}}
+
+
+def kpss_test(series: np.ndarray, regression: str = "c"):
+    """KPSS level-stationarity statistic with Bartlett-window long-run
+    variance (the statsmodels kpss recipe the reference's report imports,
+    report_generation.py:54-55).  Null hypothesis: series IS stationary —
+    complements ADF, whose null is a unit root."""
+    y = np.asarray(series, float)
+    y = y[~np.isnan(y)]
+    n = len(y)
+    if n < 10 or np.allclose(y, y[0]):
+        return None
+    resid = y - y.mean()
+    S = np.cumsum(resid)
+    lags = int(np.ceil(12.0 * (n / 100.0) ** 0.25))  # statsmodels 'legacy'
+    lags = min(lags, n - 1)
+    s2 = float(resid @ resid) / n
+    for k in range(1, lags + 1):
+        w = 1.0 - k / (lags + 1.0)
+        s2 += 2.0 / n * w * float(resid[k:] @ resid[:-k])
+    if s2 <= 0:
+        return None
+    stat = float((S @ S) / (n * n * s2))
+    crit = {"1%": 0.739, "5%": 0.463, "10%": 0.347}
+    # KPSS rejects stationarity when stat EXCEEDS the critical value
+    return {"kpss_stat": round(stat, 4), **{f"kpss_stationary_{k}": int(stat < v) for k, v in crit.items()}}
+
+
+def ts_landscape(idf: Table, ts_cols: List[str], id_col: Optional[str], output_path: str) -> None:
+    """Per-ts-column landscape summary (reference ts_landscape :2636-2733):
+    span, distinct days, records/day, weekend share, top daypart."""
+    rows = []
+    for c in ts_cols:
+        feats = ts_processed_feats(idf, c).dropna(subset=[c])
+        if not len(feats):
+            continue
+        daily = feats.groupby("yyyymmdd_col").size()
+        rows.append(
+            {
+                "attribute": c,
+                "records": len(feats),
+                "distinct_days": int(daily.shape[0]),
+                "avg_records_per_day": round(float(daily.mean()), 2),
+                "max_records_per_day": int(daily.max()),
+                "weekend_pct": round(float(feats["is_weekend"].mean()), 4),
+                "top_daypart": feats["daypart"].mode().iloc[0] if len(feats) else "",
+                "start": str(feats[c].min()),
+                "end": str(feats[c].max()),
+            }
+        )
+    if rows:
+        pd.DataFrame(rows).to_csv(ends_with(output_path) + "ts_landscape.csv", index=False)
+
+
+def ts_analyzer(
+    idf: Table,
+    id_col: Optional[str] = None,
+    max_days: int = 3600,
+    output_path: str = ".",
+    output_type: str = "daily",
+    tz_offset: str = "local",
+    run_type: str = "local",
+    auth_key: str = "NA",
+    **_ignored,
+) -> None:
+    """Entry (reference :408-550): run eligibility + viz dumps for every
+    timestamp column; write ``ts_stats.csv`` summary."""
+    Path(output_path).mkdir(parents=True, exist_ok=True)
+    ts_cols = [c for c in idf.col_names if idf.columns[c].kind == "ts"]
+    rows = []
+    eligible = []
+    for c in ts_cols:
+        stats = ts_eligiblity_check(idf, c, id_col, max_days)
+        rows.append(stats)
+        if stats.get("eligible"):
+            eligible.append(c)
+            ts_viz_data(idf, c, output_path, output_type)
+    if eligible:
+        ts_landscape(idf, eligible, id_col, output_path)
+    # always emit the same headered schema — a headerless empty CSV breaks
+    # readers and per-run schema drift breaks downstream joins
+    pd.DataFrame(rows).reindex(columns=TS_STATS_COLUMNS).to_csv(
+        ends_with(output_path) + "ts_stats.csv", index=False
+    )

@@ -1,0 +1,21 @@
+"""Ingest layer: file I/O → Table, dataset combination, column ops, sampling.
+
+Re-designs the reference ``data_ingest/`` (src/main/anovos/data_ingest/
+data_ingest.py:5-12) without the Spark reader stack: pyarrow does columnar
+decode on host (CSV/Parquet/JSON), a built-in Avro container codec replaces
+the spark-avro JAR (SURVEY.md §2.9), and decoded columns are
+dictionary-encoded and uploaded row-sharded onto the device mesh.
+"""
+
+from anovos_tpu.data_ingest.data_ingest import (  # noqa: F401
+    read_dataset,
+    write_dataset,
+    concatenate_dataset,
+    join_dataset,
+    delete_column,
+    select_column,
+    rename_column,
+    recast_column,
+    recommend_type,
+)
+from anovos_tpu.data_ingest.data_sampling import data_sample  # noqa: F401

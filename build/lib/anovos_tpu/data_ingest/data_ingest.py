@@ -1,0 +1,607 @@
+"""Dataset I/O and combination (reference: data_ingest/data_ingest.py).
+
+``read_dataset`` (ref :23-51) decodes files on host via pyarrow (CSV/Parquet/
+JSON) or the built-in Avro codec, then dictionary-encodes and uploads the
+columns row-sharded across the mesh.  ``write_dataset`` (ref :99-117) mirrors
+the repartition/coalesce → n-part-files semantics.  ``concatenate_dataset``
+(ref :120-152) and ``join_dataset`` (ref :155-198) keep payload columns on
+device (vocab-union code remap + device gathers); only join-key matching runs
+host-side (SURVEY.md §2.10: "cross-shard joins via … host-side hash partition").
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import os
+import shutil
+import warnings
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from anovos_tpu.data_ingest import avro_io
+from anovos_tpu.shared.runtime import get_runtime
+from anovos_tpu.shared.table import Column, Table, _host_to_column, _pad_to
+from anovos_tpu.shared.utils import ends_with, pairwise_reduce, parse_cols
+
+_EXTENSIONS = {
+    "csv": (".csv",),
+    "parquet": (".parquet", ".pq"),
+    "avro": (".avro",),
+    "json": (".json", ".json.gz", ".jsonl"),
+}
+
+
+def _resolve_files(file_path: str, file_type: str) -> List[str]:
+    if os.path.isfile(file_path):
+        return [file_path]
+    if os.path.isdir(file_path):
+        exts = _EXTENSIONS.get(file_type, ())
+        files = sorted(
+            f
+            for f in glob.glob(os.path.join(file_path, "*"))
+            if f.endswith(exts) or (os.path.basename(f).startswith("part-") and not f.endswith((".crc", "_SUCCESS")))
+        )
+        files = [f for f in files if not os.path.basename(f).startswith((".", "_"))]
+        if files:
+            return files
+    matched = sorted(glob.glob(file_path))
+    if matched:
+        out = []
+        for m in matched:
+            out.extend(_resolve_files(m, file_type))
+        return out
+    raise FileNotFoundError(f"no {file_type} files at {file_path}")
+
+
+def shard_files_for_process(files: List[str]) -> List[str]:
+    """Per-host slice of a part-file list for EXPLICIT multi-host ingest.
+
+    Not applied automatically by read_dataset: process-local reads must be
+    assembled into one global array (jax.make_array_from_process_local_data
+    with a globally-agreed row count) before any collective runs, and
+    metadata/stats reads must stay complete on every host.  A multi-host
+    loader should read its slice, all-gather row counts, and build global
+    Tables; until that loader lands, read_dataset is global-per-process.
+    """
+    import jax as _jax
+
+    if _jax.process_count() <= 1:
+        return files
+    return files[_jax.process_index() :: _jax.process_count()]
+
+
+def _coerce_numeric_strings(decoded: dict) -> dict:
+    """Schema-inference parity for the decoded-Table path: a string column
+    whose every value parses numeric becomes numeric (the pandas route's
+    inferSchema re-coercion).  Cheap — the parse runs over the VOCAB."""
+    from anovos_tpu.shared.native import NativeEncodedStrings
+
+    out = {}
+    for name, arr in decoded.items():
+        if isinstance(arr, NativeEncodedStrings) and len(arr.vocab):
+            parsed = pd.to_numeric(pd.Series(arr.vocab.astype(str)), errors="coerce")
+            if parsed.notna().all():
+                lut = parsed.to_numpy(np.float64)
+                vals = np.full(len(arr.codes), np.nan)
+                valid = arr.codes >= 0
+                vals[valid] = lut[arr.codes[valid]]
+                out[name] = vals
+                continue
+        out[name] = arr
+    return out
+
+
+def read_dataset(file_path: str, file_type: str, file_configs: Optional[dict] = None) -> Table:
+    """Read csv/parquet/avro/json into a device Table.
+
+    ``file_configs`` mirrors the Spark reader options the reference forwards
+    (data_ingest.py:23-51): ``header``, ``delimiter``/``sep``, ``inferSchema``
+    (always on — pyarrow infers).  Multi-file (part-file) directories are
+    concatenated host-side before upload.
+    """
+    cfg = dict(file_configs or {})
+    if jax.process_count() > 1:
+        # multi-host runtime: each host reads its file slice and columns are
+        # assembled into global arrays (distributed_ingest module)
+        from anovos_tpu.data_ingest.distributed_ingest import read_dataset_distributed
+
+        return read_dataset_distributed(file_path, file_type, file_configs)
+    files = _resolve_files(file_path, file_type)
+    if file_type == "avro":
+        # native-friendly path: per-file decode straight to Tables (string
+        # columns stay dictionary codes), row-union via concatenate_dataset's
+        # vocab-union remap.  Falls through to pandas only on decode failure.
+        tables = []
+        for f in files:
+            decoded = avro_io.read_avro(f)
+            if not decoded:
+                tables = None
+                break
+            n = len(next(iter(decoded.values())))
+            tables.append(Table.from_numpy(_coerce_numeric_strings(decoded), nrows=n))
+        if tables:
+            return tables[0] if len(tables) == 1 else concatenate_dataset(*tables, method_type="name")
+    df = read_host_frame(files, file_type, cfg)
+    return Table.from_pandas(df)
+
+
+def read_host_frame(files: List[str], file_type: str, cfg: dict) -> pd.DataFrame:
+    """Host pandas frame from part files (shared by the single-process and
+    multi-host loaders)."""
+    frames = []
+    for f in files:
+        if file_type == "csv":
+            import pyarrow.csv as pacsv
+
+            delim = str(cfg.get("delimiter", cfg.get("sep", ",")))
+            header = cfg.get("header", True)
+            header = str(header).lower() in ("true", "1")
+            ropts = pacsv.ReadOptions(autogenerate_column_names=not header)
+            popts = pacsv.ParseOptions(delimiter=delim)
+            frames.append(pacsv.read_csv(f, read_options=ropts, parse_options=popts).to_pandas())
+        elif file_type == "parquet":
+            frames.append(pd.read_parquet(f))
+        elif file_type == "avro":
+            from anovos_tpu.shared.native import NativeEncodedStrings
+
+            dec = avro_io.read_avro(f)
+            dec = {
+                k: (v.to_object_array() if isinstance(v, NativeEncodedStrings) else v)
+                for k, v in dec.items()
+            }
+            frames.append(pd.DataFrame(dec))
+        elif file_type == "json":
+            opener = gzip.open if f.endswith(".gz") else open
+            with opener(f, "rt") as fh:
+                frames.append(pd.read_json(fh, lines=True))
+        else:
+            raise ValueError(f"unsupported file_type: {file_type}")
+    df = frames[0] if len(frames) == 1 else pd.concat(frames, ignore_index=True)
+    if str(cfg.get("inferSchema", True)).lower() in ("true", "1", "none"):
+        # whole-dataset schema inference (Spark inferSchema parity): per-part
+        # readers can disagree (an all-null part decodes as string/null), so
+        # re-coerce object columns that are numeric across ALL parts.
+        for c in df.columns:
+            if df[c].dtype == object or str(df[c].dtype) in ("string", "str"):
+                nonnull = df[c].notna()
+                if nonnull.any():
+                    # cheap pre-check: a genuinely-string column (the common
+                    # case) is rejected on a small head sample instead of
+                    # paying a full-column to_numeric per string column
+                    head = df[c][nonnull].iloc[:1024]
+                    if pd.to_numeric(head, errors="coerce").isna().any():
+                        continue
+                    coerced = pd.to_numeric(df[c], errors="coerce")
+                    if coerced[nonnull].notna().all():
+                        df[c] = coerced
+                else:
+                    # all-null column → numeric NaN column
+                    df[c] = pd.to_numeric(df[c], errors="coerce")
+    return df
+
+
+def write_dataset(
+    idf: Table,
+    file_path: str,
+    file_type: str,
+    file_configs: Optional[dict] = None,
+    column_order: Optional[List[str]] = None,
+) -> None:
+    """Write a Table as spark-style part files (reference :54-117).
+
+    ``repartition`` in file_configs sets the number of part files; ``mode``
+    ∈ {overwrite, append, error}.  Other keys (header/delimiter) map to the
+    writers.
+    """
+    cfg = dict(file_configs or {})
+    mode = cfg.pop("mode", "error")
+    repartition = int(cfg.pop("repartition", 1) or 1)
+    if column_order:
+        idf = idf.select(column_order)
+    if os.path.exists(file_path):
+        if mode == "overwrite":
+            shutil.rmtree(file_path) if os.path.isdir(file_path) else os.remove(file_path)
+        elif mode == "error":
+            raise FileExistsError(f"{file_path} exists (mode=error)")
+    os.makedirs(file_path, exist_ok=True)
+    df = idf.to_pandas()
+    parts = np.array_split(np.arange(len(df)), max(repartition, 1))
+    for i, part_idx in enumerate(parts):
+        part = df.iloc[part_idx]
+        stem = os.path.join(file_path, f"part-{i:05d}")
+        if file_type == "csv":
+            header = str(cfg.get("header", True)).lower() in ("true", "1")
+            part.to_csv(stem + ".csv", index=False, header=header, sep=str(cfg.get("delimiter", ",")))
+        elif file_type == "parquet":
+            part.to_parquet(stem + ".parquet", index=False)
+        elif file_type == "avro":
+            avro_io.write_avro(part, stem + ".avro")
+        elif file_type == "json":
+            part.to_json(stem + ".json", orient="records", lines=True)
+        else:
+            raise ValueError(f"unsupported file_type: {file_type}")
+    open(os.path.join(file_path, "_SUCCESS"), "w").close()
+
+
+# ----------------------------------------------------------------------
+# combination
+# ----------------------------------------------------------------------
+def _concat_columns(cols: List[Column], nrows: List[int], name: str) -> Column:
+    rt = get_runtime()
+    kinds = {c.kind for c in cols}
+    if len(kinds) > 1:
+        raise TypeError(f"column {name}: mixed kinds {kinds} across concatenated tables")
+    kind = kinds.pop()
+    # host-side assembly: concat is a stage boundary, and device-side eager
+    # concatenation of differently-sharded arrays would dispatch independent
+    # collective programs per column (rendezvous-interleave hazard — see
+    # Table.gather_rows).  device_get assembles shards without collectives.
+    if kind == "cat":
+        new_vocab = np.unique(np.concatenate([c.vocab for c in cols])).astype(object)
+        lookups = []
+        for c in cols:
+            lk = {v: i for i, v in enumerate(new_vocab)}
+            lookups.append(np.array([lk[v] for v in c.vocab], dtype=np.int32) if len(c.vocab) else np.zeros(1, np.int32))
+        hosts = []
+        for c, n, cm in zip(cols, nrows, lookups):
+            h = np.asarray(jax.device_get(c.data))[:n]
+            hosts.append(np.where(h >= 0, cm[np.clip(h, 0, len(cm) - 1)], -1).astype(np.int32))
+    elif any(c.is_wide for c in cols):
+        # wide (exact int64 OR exact float64) in any slice: keep exactness —
+        # nulls ride the mask, so nullable slices must NOT degrade silently
+        from anovos_tpu.shared.table import wide_int_parts
+
+        total = sum(nrows)
+        npad = rt.pad_rows(max(total, 1))
+        mask_h = np.concatenate(
+            [np.asarray(jax.device_get(c.mask))[:n] for c, n in zip(cols, nrows)]
+        )
+        int_ok = all(c.is_wide_int or c.data.dtype == jnp.int32 for c in cols)
+        if not int_ok:  # float-wide or mixed with float slices: float64 semantics
+            parts = [
+                c.exact_host(n).astype(np.float64) if c.is_wide
+                else np.asarray(jax.device_get(c.data))[:n].astype(np.float64)
+                for c, n in zip(cols, nrows)
+            ]
+            data_h = np.concatenate(parts)
+            data_h[~mask_h] = np.nan
+            return _host_to_column(data_h, total, npad, rt)
+        v64 = np.concatenate(
+            [
+                c.exact_host(n).astype(np.int64) if c.is_wide_int
+                else np.asarray(jax.device_get(c.data))[:n].astype(np.int64)
+                for c, n in zip(cols, nrows)
+            ]
+        )
+        v64[~mask_h] = 0  # masked lanes: any value, mask gates all consumers
+        whi, wlo = wide_int_parts(v64)
+        return Column(
+            "num",
+            rt.shard_rows(_pad_to(v64.astype(np.float32), npad, np.float32(0))),
+            rt.shard_rows(_pad_to(mask_h, npad, False)),
+            dtype_name="bigint",
+            wide_hi=rt.shard_rows(_pad_to(whi, npad, np.int32(0))),
+            wide_lo=rt.shard_rows(_pad_to(wlo, npad, np.int32(-(1 << 31)))),
+        )
+    else:
+        new_vocab = None
+        np_dtypes = {np.asarray(jax.device_get(c.data[:1])).dtype for c in cols}
+        tgt = np.float32 if len(np_dtypes) > 1 else next(iter(np_dtypes))
+        hosts = [np.asarray(jax.device_get(c.data))[:n].astype(tgt) for c, n in zip(cols, nrows)]
+    total = sum(nrows)
+    npad = rt.pad_rows(max(total, 1))
+    data_h = np.concatenate(hosts) if hosts else np.zeros(0, np.float32)
+    mask_h = np.concatenate([np.asarray(jax.device_get(c.mask))[:n] for c, n in zip(cols, nrows)])
+    data = rt.shard_rows(_pad_to(data_h, npad, data_h.dtype.type(0)))
+    mask = rt.shard_rows(_pad_to(mask_h, npad, False))
+    return Column(kind, data, mask, vocab=new_vocab, dtype_name=cols[0].dtype_name)
+
+
+def concatenate_dataset(*idfs: Table, method_type: str = "name") -> Table:
+    """Row-union of Tables (reference :120-152).
+
+    "name": columns follow the FIRST table's order; errors if any column of
+    the first table is absent elsewhere.  "index": positional, renamed to the
+    first table's names.
+    """
+    if method_type not in ("index", "name"):
+        raise TypeError("Invalid input for concatenate_dataset method")
+    first = idfs[0]
+    names = first.col_names
+    aligned = []
+    for t in idfs:
+        if method_type == "name":
+            missing = [c for c in names if c not in t.columns]
+            if missing:
+                raise ValueError(f"concatenate_dataset: columns {missing} missing")
+            aligned.append(t.select(names))
+        else:
+            if t.ncols != len(names):
+                raise ValueError("concatenate_dataset index method: column count mismatch")
+            aligned.append(t.rename(dict(zip(t.col_names, names))).select(names))
+    cols = OrderedDict(
+        (
+            name,
+            _concat_columns([t.columns[name] for t in aligned], [t.nrows for t in aligned], name),
+        )
+        for name in names
+    )
+    return Table(cols, sum(t.nrows for t in aligned))
+
+
+def _host_keys(t: Table, join_cols: List[str]) -> pd.DataFrame:
+    """Join keys as a host frame (decoded values; tiny vs payload)."""
+    out = {}
+    for c in join_cols:
+        col = t.columns[c]
+        data = np.asarray(col.data)[: t.nrows]
+        mask = np.asarray(col.mask)[: t.nrows]
+        if col.kind == "cat":
+            vals = np.empty(t.nrows, dtype=object)
+            valid = mask & (data >= 0)
+            vals[valid] = col.vocab[data[valid]]
+            vals[~valid] = None
+            out[c] = vals
+        elif col.is_wide_int:
+            # id-like int64 keys must match exactly — the f32 view collides
+            out[c] = pd.arrays.IntegerArray(col.exact_host(t.nrows), ~mask)
+        elif col.is_wide:  # exact float64 keys
+            vals = col.exact_host(t.nrows).copy()
+            vals[~mask] = np.nan
+            out[c] = vals
+        else:
+            vals = data.astype(np.float64)
+            vals[~mask] = np.nan
+            out[c] = vals
+    return pd.DataFrame(out)
+
+
+def join_dataset(*idfs: Table, join_cols: Union[str, List[str]], join_type: str) -> Table:
+    """Key join of Tables (reference :155-198).
+
+    Key matching runs host-side (hash join on the small key frame); payload
+    columns move by device gather.  join_type ∈ inner/full/left/right/
+    left_semi/left_anti.
+    """
+    if isinstance(join_cols, str):
+        join_cols = [x.strip() for x in join_cols.split("|")]
+    all_cols = [c for t in idfs for c in t.col_names]
+    nonjoin = [c for c in all_cols if c not in join_cols]
+    if len(nonjoin) != len(all_cols) - len(idfs) * len(join_cols):
+        raise ValueError("Specified join_cols do not match all the Input Dataframe(s)")
+    if len(nonjoin) != len(set(nonjoin)):
+        raise ValueError("Duplicate column(s) present in non joining column(s) in Input Dataframe(s)")
+
+    def join2(left: Table, right: Table) -> Table:
+        lk = _host_keys(left, join_cols).assign(_li=np.arange(left.nrows))
+        rk = _host_keys(right, join_cols).assign(_ri=np.arange(right.nrows))
+        how = {"full": "outer", "left_semi": "inner", "left_anti": "left"}.get(join_type, join_type)
+        merged = lk.merge(rk, on=join_cols, how=how)
+        if join_type == "left_semi":
+            li = np.unique(merged["_li"].to_numpy())
+            return left.gather_rows(li)
+        if join_type == "left_anti":
+            anti = merged[merged["_ri"].isna()]
+            li = np.unique(anti["_li"].to_numpy()).astype(np.int64)
+            return left.gather_rows(li)
+        li = merged["_li"].to_numpy()
+        ri = merged["_ri"].to_numpy()
+        lvalid = ~pd.isna(li)
+        rvalid = ~pd.isna(ri)
+        li = np.where(lvalid, li, 0).astype(np.int64)
+        ri = np.where(rvalid, ri, 0).astype(np.int64)
+        lg = left.gather_rows(li, valid=lvalid)
+        rg = right.gather_rows(ri, valid=rvalid)
+        # key columns: prefer left values, fall back to right (outer join)
+        key_frame = merged[join_cols]
+        out = OrderedDict()
+        for name in left.col_names:
+            if name in join_cols:
+                s = key_frame[name]
+                if str(s.dtype) == "Int64":  # wide-int keys from _host_keys
+                    if not s.isna().any():
+                        key_arr = s.to_numpy(dtype=np.int64)
+                    else:  # null int keys (rare): degrade to float64
+                        key_arr = s.astype("float64").to_numpy()
+                else:
+                    key_arr = np.asarray(s.to_numpy())
+                out[name] = _host_to_column(
+                    key_arr, len(merged),
+                    get_runtime().pad_rows(max(len(merged), 1)), get_runtime(),
+                )
+            else:
+                out[name] = lg.columns[name]
+        for name in right.col_names:
+            if name not in join_cols:
+                out[name] = rg.columns[name]
+        return Table(out, len(merged))
+
+    return pairwise_reduce(join2, idfs)
+
+
+# ----------------------------------------------------------------------
+# column ops (reference :201-367)
+# ----------------------------------------------------------------------
+def delete_column(idf: Table, list_of_cols, print_impact: bool = False) -> Table:
+    cols = parse_cols(list_of_cols, idf.col_names)
+    odf = idf.drop(cols)
+    if print_impact:
+        print("Before: \nNo. of Columns- ", idf.ncols, "\n", idf.col_names)
+        print("After: \nNo. of Columns- ", odf.ncols, "\n", odf.col_names)
+    return odf
+
+
+def select_column(idf: Table, list_of_cols, print_impact: bool = False) -> Table:
+    cols = parse_cols(list_of_cols, idf.col_names)
+    odf = idf.select(cols)
+    if print_impact:
+        print("Before: \nNo. of Columns- ", idf.ncols, "\n", idf.col_names)
+        print("After: \nNo. of Columns- ", odf.ncols, "\n", odf.col_names)
+    return odf
+
+
+def rename_column(idf: Table, list_of_cols, list_of_newcols, print_impact: bool = False) -> Table:
+    if isinstance(list_of_cols, str):
+        list_of_cols = [x.strip() for x in list_of_cols.split("|")]
+    if isinstance(list_of_newcols, str):
+        list_of_newcols = [x.strip() for x in list_of_newcols.split("|")]
+    odf = idf.rename(dict(zip(list_of_cols, list_of_newcols)))
+    if print_impact:
+        print("Before: \nNo. of Columns- ", idf.ncols, "\n", idf.col_names)
+        print("After: \nNo. of Columns- ", odf.ncols, "\n", odf.col_names)
+    return odf
+
+
+_NUM_TARGETS = {"int", "integer", "bigint", "long", "float", "double", "decimal", "smallint"}
+
+
+def recast_column(idf: Table, list_of_cols, list_of_dtypes, print_impact: bool = False) -> Table:
+    """Cast columns (reference :297-367).  num↔num changes storage dtype;
+    cat→num parses the vocab once on host and gathers through it on device;
+    num→string re-encodes to a dictionary."""
+    if isinstance(list_of_cols, str):
+        list_of_cols = [x.strip() for x in list_of_cols.split("|")]
+    if isinstance(list_of_dtypes, str):
+        list_of_dtypes = [x.strip() for x in list_of_dtypes.split("|")]
+    rt = get_runtime()
+    odf = idf
+    for name, dt in zip(list_of_cols, list_of_dtypes):
+        dt = dt.strip().lower()
+        col = idf.columns[name]
+        if dt in _NUM_TARGETS:
+            tgt = jnp.int32 if dt in ("int", "integer", "bigint", "long", "smallint") else jnp.float32
+            if col.kind == "cat":
+                parsed = np.full(len(col.vocab) + 1, np.nan, dtype=np.float64)
+                for i, v in enumerate(col.vocab):
+                    try:
+                        parsed[i] = float(v)
+                    except (TypeError, ValueError):
+                        pass
+                pv = jnp.asarray(parsed, jnp.float32)
+                vals = pv[jnp.clip(col.data, 0, len(col.vocab))]
+                ok = col.mask & (col.data >= 0) & ~jnp.isnan(vals)
+                data = jnp.where(ok, vals, 0.0).astype(tgt)
+                new = Column("num", data, ok, dtype_name=dt if dt != "integer" else "int")
+            elif col.is_wide_int:
+                if dt in ("bigint", "long"):
+                    new = col  # already exact int64: no-op recast keeps the pair
+                elif tgt == jnp.float32:
+                    new = Column("num", col.data, col.mask, dtype_name=dt)
+                else:  # narrowing to int32 genuinely truncates: go via exact host
+                    v = col.exact_host(idf.nrows)
+                    new = _host_to_column(
+                        np.clip(v, np.iinfo(np.int32).min, np.iinfo(np.int32).max).astype(np.int64),
+                        idf.nrows, idf.pad_target(), rt,
+                    )
+            elif col.is_wide and dt in ("double", "float64"):
+                # float-wide → double is a no-op recast: keep the exact pair
+                new = Column(
+                    "num", col.data, col.mask, dtype_name="double",
+                    wide_hi=col.wide_hi, wide_lo=col.wide_lo, wide_kind="float",
+                )
+            elif col.is_wide and tgt == jnp.int32:
+                # float-wide → integer must truncate the EXACT double — the
+                # values the (hi,lo) pair exists to keep exact — not the f32
+                # approximation (the reference casts the exact double)
+                v = np.nan_to_num(col.exact_host(idf.nrows), nan=0.0)
+                v = np.trunc(v)
+                if dt in ("int", "integer", "smallint"):
+                    v = np.clip(v, np.iinfo(np.int32).min, np.iinfo(np.int32).max)
+                else:
+                    v = np.clip(v, -(2.0**63), 2.0**63 - 1024)
+                new = _host_to_column(v.astype(np.int64), idf.nrows, idf.pad_target(), rt)
+                new = Column(new.kind, new.data, new.mask & col.mask[: new.mask.shape[0]],
+                             dtype_name=dt if dt != "integer" else "int",
+                             wide_hi=new.wide_hi, wide_lo=new.wide_lo, wide_kind=new.wide_kind)
+            else:
+                new = Column("num", col.data.astype(tgt), col.mask, dtype_name=dt if dt != "integer" else "int")
+        elif dt == "string":
+            if col.kind == "cat":
+                new = col
+            else:
+                host = col.exact_host(idf.nrows)  # wide ints render exactly
+                mask = np.asarray(col.mask)[: idf.nrows]
+                vals = np.empty(idf.nrows, dtype=object)
+                if np.issubdtype(host.dtype, np.integer):
+                    vals[:] = [str(int(v)) for v in host]
+                else:
+                    vals[:] = [repr(float(v)) for v in host]
+                vals[~mask] = None
+                new = _host_to_column(vals, idf.nrows, idf.pad_target(), rt)
+        elif dt == "timestamp":
+            host = np.asarray(col.data)[: idf.nrows]
+            mask = np.asarray(col.mask)[: idf.nrows]
+            if col.kind == "cat":
+                vals = np.empty(idf.nrows, dtype=object)
+                valid = mask & (host >= 0)
+                vals[valid] = col.vocab[host[valid]]
+                ts = pd.to_datetime(pd.Series(vals), errors="coerce")
+            else:
+                ts = pd.to_datetime(pd.Series(host.astype("int64"), dtype="int64"), unit="s", errors="coerce")
+                ts[~mask] = pd.NaT
+            new = _host_to_column(ts.to_numpy(), idf.nrows, idf.pad_target(), rt)
+        else:
+            raise ValueError(f"unsupported recast dtype: {dt}")
+        odf = odf.with_column(name, new)
+    if print_impact:
+        print("Before: ", idf.dtypes())
+        print("After: ", odf.dtypes())
+    return odf
+
+
+def recommend_type(
+    idf: Table,
+    list_of_cols="all",
+    drop_cols=[],
+    dynamic_threshold: float = 0.01,
+    static_threshold: int = 100,
+) -> pd.DataFrame:
+    """Cardinality-based form/datatype recommendation (reference :370-533):
+    unique < min(static_threshold, rows·dynamic_threshold) → categorical/
+    string, else numerical/double.  Returns the same 6-column stats frame."""
+    cols = parse_cols(list_of_cols, idf.col_names, drop_cols)
+    if not (0 < dynamic_threshold <= 1):
+        raise TypeError("Invalid input for dynamic_threshold: Value need to be between 0 and 1")
+    if not cols:
+        warnings.warn("No recommend_attributeType analysis - No column(s) to analyze")
+        return pd.DataFrame(
+            columns=[
+                "attribute",
+                "original_form",
+                "original_dataType",
+                "recommended_form",
+                "recommended_dataType",
+                "distinct_value_count",
+            ]
+        )
+    from anovos_tpu.ops.segment import masked_nunique
+
+    X, M = [], []
+    for c in cols:
+        col = idf.columns[c]
+        X.append(col.data.astype(jnp.float32))
+        M.append(col.mask & ((col.data >= 0) if col.kind == "cat" else True))
+    nu = np.asarray(masked_nunique(jnp.stack(X, 1), jnp.stack(M, 1)))
+    threshold = min(static_threshold, idf.nrows * dynamic_threshold)
+    rows = []
+    for c, u in zip(cols, nu):
+        col = idf.columns[c]
+        o_form = "categorical" if col.kind == "cat" else "numerical"
+        r_form = "categorical" if u < threshold else "numerical"
+        rows.append(
+            {
+                "attribute": c,
+                "original_form": o_form,
+                "original_dataType": col.dtype_name,
+                "recommended_form": r_form,
+                "recommended_dataType": "string" if r_form == "categorical" else "double",
+                "distinct_value_count": int(u),
+            }
+        )
+    return pd.DataFrame(rows)

@@ -1,0 +1,115 @@
+"""Random + stratified sampling (reference: data_ingest/data_sampling.py:8).
+
+Spark's ``df.sample`` / ``stat.sampleBy`` become per-stratum Bernoulli masks
+from the device RNG (ops/sampling.py) — deterministic per seed, no shuffle.
+Stratum identity (the reference's ``F.concat(*strata_cols)`` merge key,
+data_sampling.py:128-131) is a host-side factorize of the strata code tuple;
+the draw itself runs on device.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from anovos_tpu.ops.sampling import sample_mask, stratified_mask
+from anovos_tpu.ops.segment import masked_nunique
+from anovos_tpu.shared.runtime import get_runtime
+from anovos_tpu.shared.table import Table
+
+
+def data_sample(
+    idf: Table,
+    strata_cols: Union[str, List[str]] = "all",
+    drop_cols: Union[str, List[str]] = [],
+    fraction: float = 0.1,
+    method_type: str = "random",
+    stratified_type: str = "population",
+    seed_value: int = 12,
+    unique_threshold: Union[float, int] = 0.5,
+) -> Table:
+    """Sample rows.  "random": Bernoulli(fraction).  "stratified":
+    per-stratum fractions — "population" keeps fraction everywhere
+    (proportionate allocation); "balanced" scales each stratum's fraction by
+    smallest_count/count (optimum allocation, data_sampling.py:137-146).
+    Rows with null strata values are dropped (na.drop parity :128)."""
+    if not isinstance(fraction, (int, float)) or isinstance(fraction, bool):
+        raise TypeError("Invalid input for fraction")
+    if fraction <= 0 or fraction > 1:
+        raise TypeError("Invalid input for fraction: fraction value is between 0 and 1")
+    if not isinstance(seed_value, int):
+        raise TypeError("Invalid input for seed_value")
+    if method_type not in ("stratified", "random"):
+        raise TypeError("Invalid input for data_sample method_type")
+
+    if method_type == "random":
+        keep = np.asarray(sample_mask(seed_value, idf.padded_rows, fraction)).copy()
+        keep &= np.arange(idf.padded_rows) < idf.nrows
+        return idf.filter_rows(keep)
+
+    # ---- stratified ----
+    if not isinstance(unique_threshold, (int, float)) or unique_threshold <= 0:
+        raise TypeError("Invalid input for unique_threshold")
+    if unique_threshold > 1 and not isinstance(unique_threshold, int):
+        raise TypeError(
+            "Invalid input for unique_threshold: unique_threshold can only be integer if larger than 1"
+        )
+    if stratified_type not in ("population", "balanced"):
+        raise TypeError("Invalid input for stratified_type")
+    if strata_cols == "all":
+        strata_cols = idf.col_names
+    if isinstance(strata_cols, str):
+        strata_cols = [x.strip() for x in strata_cols.split("|")]
+    if isinstance(drop_cols, str):
+        drop_cols = [x.strip() for x in drop_cols.split("|")]
+    strata_cols = [c for c in dict.fromkeys(strata_cols) if c not in set(drop_cols)]
+    if not strata_cols:
+        raise TypeError("Missing strata_cols value")
+    for col in strata_cols:
+        if col not in idf.columns:
+            raise TypeError(f"Invalid input for strata_cols: {col} does not exist")
+    # high-cardinality strata columns are skipped (reference :101-121)
+    X = jnp.stack([idf.columns[c].data.astype(jnp.float32) for c in strata_cols], 1)
+    M = jnp.stack([idf.columns[c].mask for c in strata_cols], 1)
+    nu = np.asarray(masked_nunique(X, M))
+    limit = unique_threshold * idf.nrows if unique_threshold <= 1 else unique_threshold
+    skip = [c for c, u in zip(strata_cols, nu) if u > limit]
+    if skip:
+        warnings.warn("Columns dropped from strata due to high cardinality: " + ",".join(skip))
+        strata_cols = [c for c in strata_cols if c not in skip]
+    if not strata_cols:
+        warnings.warn("No Stratified Sampling Computation - No strata column(s) to sample")
+        return idf
+
+    # stratum id: host factorize over the per-column code tuple
+    n = idf.nrows
+    key_cols = []
+    valid = np.ones(n, dtype=bool)
+    for c in strata_cols:
+        col = idf.columns[c]
+        data = np.asarray(col.data)[:n]
+        mask = np.asarray(col.mask)[:n]
+        valid &= mask
+        key_cols.append(data)
+    keys = np.stack(key_cols, axis=1)
+    import pandas as pd
+
+    codes = pd.factorize(pd.Series(map(tuple, keys)))[0]
+    codes = np.where(valid, codes, -1).astype(np.int32)
+    n_strata = int(codes.max()) + 1 if (codes >= 0).any() else 0
+    if n_strata == 0:
+        warnings.warn("No Stratified Sampling Computation - all strata values null")
+        return idf
+    counts = np.bincount(codes[codes >= 0], minlength=n_strata)
+    if stratified_type == "population":
+        fracs = np.full(n_strata, fraction, dtype=np.float32)
+    else:
+        smallest = counts[counts > 0].min()
+        fracs = (fraction * smallest / np.maximum(counts, 1)).astype(np.float32)
+    rt = get_runtime()
+    codes_d = rt.shard_rows(np.concatenate([codes, np.full(idf.padded_rows - n, -1, np.int32)]))
+    keep = np.asarray(stratified_mask(seed_value, codes_d, jnp.asarray(fracs)))
+    return idf.filter_rows(keep)

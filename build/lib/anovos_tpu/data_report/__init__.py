@@ -1,0 +1,10 @@
+"""Reporting layer (reference: src/main/anovos/data_report/).
+
+Keeps the reference's master_path file contract byte-for-byte in spirit:
+``save_stats`` writes ``<master_path>/<function_name>.csv``; chart builders
+write one plotly-schema JSON per chart per column (``freqDist_<col>``,
+``eventDist_<col>``, ``drift_<col>``, ``outlier_<col>``) plus
+``data_type.csv``.  Charts are plotly-JSON dicts written directly (the
+plotly python package is not required); the final report renders them as a
+self-contained HTML via plotly.js.
+"""

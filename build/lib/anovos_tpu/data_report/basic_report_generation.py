@@ -1,0 +1,107 @@
+"""Condensed 3-tab basic report (reference: data_report/basic_report_generation.py:95).
+
+Runs the descriptive stats + quality checks + association measures itself on
+the input Table, writes their CSVs into ``output_path``, and renders a
+compact HTML through the same renderer as the full report.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import pandas as pd
+
+import logging
+
+from anovos_tpu.data_report.report_generation import anovos_report
+from anovos_tpu.data_report.report_preprocessing import charts_to_objects, save_stats
+from anovos_tpu.shared.table import Table
+
+
+def anovos_basic_report(
+    idf: Table,
+    id_col: str = "",
+    label_col: str = "",
+    event_label: str = "",
+    skip_corr_matrix: bool = False,
+    output_path: str = "report_stats",
+    run_type: str = "local",
+    auth_key: str = "NA",
+    mlflow_config=None,
+    **_ignored,
+) -> str:
+    """Compute stats → save CSVs → render basic HTML (reference :95-566)."""
+    from anovos_tpu.data_analyzer import association_evaluator as ae
+    from anovos_tpu.data_analyzer import quality_checker as qc
+    from anovos_tpu.data_analyzer import stats_generator as sg
+
+    # no mkdir here: save_stats / charts_to_objects / anovos_report each
+    # resolve + create the store's staging dir for output_path themselves
+    drop = [c for c in [id_col] if c]
+
+    for fn in (
+        "global_summary",
+        "measures_of_counts",
+        "measures_of_centralTendency",
+        "measures_of_cardinality",
+        "measures_of_dispersion",
+        "measures_of_percentiles",
+        "measures_of_shape",
+    ):
+        try:
+            save_stats(getattr(sg, fn)(idf, drop_cols=drop), output_path, fn, run_type=run_type, auth_key=auth_key)
+        except TypeError as e:
+            logging.getLogger(__name__).warning("basic report: %s skipped (%s)", fn, e)
+
+    for fn in (
+        "duplicate_detection",
+        "nullRows_detection",
+        "nullColumns_detection",
+        "IDness_detection",
+        "biasedness_detection",
+        "outlier_detection",
+        "invalidEntries_detection",
+    ):
+        try:
+            _, stats = getattr(qc, fn)(idf, drop_cols=drop, treatment=False)
+            save_stats(stats, output_path, fn, run_type=run_type, auth_key=auth_key)
+        except TypeError as e:
+            logging.getLogger(__name__).warning("basic report: %s skipped (%s)", fn, e)
+
+    if label_col and not skip_corr_matrix:
+        try:
+            num_cols = idf.attribute_type_segregation()[0]
+            corr = ae.correlation_matrix(idf, [c for c in num_cols if c != id_col])
+            save_stats(corr, output_path, "correlation_matrix", run_type=run_type, auth_key=auth_key)
+        except TypeError as e:
+            logging.getLogger(__name__).warning("basic report: correlation_matrix skipped (%s)", e)
+    if label_col:
+        try:
+            save_stats(
+                ae.IV_calculation(idf, drop_cols=drop, label_col=label_col, event_label=event_label),
+                output_path,
+                "IV_calculation",
+                run_type=run_type, auth_key=auth_key,
+            )
+            save_stats(
+                ae.IG_calculation(idf, drop_cols=drop, label_col=label_col, event_label=event_label),
+                output_path,
+                "IG_calculation",
+                run_type=run_type, auth_key=auth_key,
+            )
+        except TypeError as e:
+            logging.getLogger(__name__).warning("basic report: IV/IG skipped (%s)", e)
+
+    charts_to_objects(
+        idf, drop_cols=drop, label_col=label_col or None, event_label=event_label,
+        master_path=output_path, run_type=run_type, auth_key=auth_key,
+    )
+    return anovos_report(
+        master_path=output_path,
+        id_col=id_col,
+        label_col=label_col,
+        final_report_path=output_path,
+        run_type=run_type,
+        auth_key=auth_key,
+    )

@@ -1,0 +1,8 @@
+"""Transformation layer (reference: src/main/anovos/data_transformer/).
+
+The reference's per-row Python UDFs and driver-side sklearn/TF fits become
+jitted device kernels: binning is ``searchsorted`` against cutoff matrices,
+encoders are dictionary-code gathers, scalers are fused elementwise ops, and
+imputation/latent-feature models train natively in JAX on the sharded data
+(no 10k-row driver sample cap — SURVEY.md §2.10 "Sample-fit/distributed-apply").
+"""

@@ -1,0 +1,780 @@
+"""Geospatial transformers (reference: data_transformer/geospatial.py:6-17).
+
+Format conversion (dd/dms/radian/cartesian/geohash), distances, geohash
+precision control, country containment, centroids and radius of gyration.
+
+Device-native (round 2): per-row trig/bit math runs as jitted kernels
+(ops/geo_kernels.py); the host touches only string vocabularies (dms and
+geohash text), geojson polygon loading, and the small per-id result frames.
+Cites: geo_format_latlon :39, geo_format_cartesian :190, geo_format_geohash
+:333, location_distance :460, geohash_precision_control :653,
+location_in_country :814, centroid :975, weighted_centroid :1099,
+rog_calculation :1223, reverse_geocoding :1335.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from anovos_tpu.data_transformer import geo_utils
+from anovos_tpu.ops import geo_kernels as gk
+from anovos_tpu.shared.runtime import get_runtime
+from anovos_tpu.shared.table import Column, Table, _host_to_column
+
+EARTH_RADIUS_M = geo_utils.EARTH_RADIUS_M
+
+
+def _dev_num(idf: Table, col: str):
+    """(f32 data, mask) device pair for a numeric column."""
+    c = idf.columns[col]
+    return c.data.astype(jnp.float32), c.mask
+
+
+def _add_dev(idf: Table, name: str, vals: jax.Array, mask: jax.Array) -> Table:
+    return idf.with_column(name, Column("num", vals.astype(jnp.float32), mask, dtype_name="double"))
+
+
+def _host_num(idf: Table, col: str) -> tuple:
+    c = idf.columns[col]
+    vals = np.asarray(jax.device_get(c.data))[: idf.nrows].astype(float)
+    mask = np.asarray(jax.device_get(c.mask))[: idf.nrows]
+    vals = np.where(mask, vals, np.nan)
+    return vals, mask
+
+
+def _host_cat(idf: Table, col: str) -> np.ndarray:
+    c = idf.columns[col]
+    codes = np.asarray(jax.device_get(c.data))[: idf.nrows]
+    mask = np.asarray(jax.device_get(c.mask))[: idf.nrows] & (codes >= 0)
+    out = np.full(idf.nrows, None, dtype=object)
+    out[mask] = c.vocab[codes[mask]]
+    return out
+
+
+def _add_num(idf: Table, name: str, values: np.ndarray) -> Table:
+    rt = get_runtime()
+    return idf.with_column(
+        name, _host_to_column(np.asarray(values, float), idf.nrows, idf.pad_target(), rt)
+    )
+
+
+def _add_cat(idf: Table, name: str, values: np.ndarray) -> Table:
+    rt = get_runtime()
+    return idf.with_column(
+        name, _host_to_column(np.asarray(values, object), idf.nrows, idf.pad_target(), rt)
+    )
+
+
+def _dd_to_dms_str(v: np.ndarray) -> np.ndarray:
+    av = np.abs(v)
+    d = np.floor(av)
+    m = np.floor((av - d) * 60)
+    s = (av - d - m / 60) * 3600
+    # explicit sign prefix: int(sg*dd) would lose the '-' for values in
+    # (-1, 0) where the degree part is zero
+    out = np.array(
+        [
+            None
+            if not np.isfinite(x)
+            else f"{'-' if x < 0 else ''}{int(dd)}°{int(mm)}'{ss:.4f}\""
+            for x, dd, mm, ss in zip(v, d, m, s)
+        ],
+        dtype=object,
+    )
+    return out
+
+
+def _dms_str_to_dd(vals: np.ndarray) -> np.ndarray:
+    import re
+
+    out = np.full(len(vals), np.nan)
+    pat = re.compile(r"(-?\d+)[°d:\s]+(\d+)['m:\s]+([\d.]+)")
+    for i, v in enumerate(vals):
+        if v is None:
+            continue
+        sv = str(v).strip()
+        m = pat.search(sv)
+        if m:
+            d, mi, s = float(m.group(1)), float(m.group(2)), float(m.group(3))
+            # sign from the string, not float(d): "-0°30'" parses d as -0.0
+            neg = sv.startswith("-")
+            out[i] = (abs(d) + mi / 60 + s / 3600) * (-1 if neg else 1)
+    return out
+
+
+_BASE32 = np.array(list("0123456789bcdefghjkmnpqrstuvwxyz"))
+
+
+def _geohash_column(idf: Table, lat_d, lon_d, mask, name: str, precision: int = 9) -> Table:
+    """lat/lon → geohash string column: bit interleaving on device, base32
+    mapping of the small digit matrix on host (strings are inherently
+    host-resident vocab)."""
+    digits = np.asarray(jax.device_get(gk.geohash_digits(lat_d, lon_d, precision)))[: idf.nrows]
+    m = np.asarray(jax.device_get(mask))[: idf.nrows]
+    chars = _BASE32[digits]  # (rows, p)
+    strs = np.array(["".join(row) for row in chars], dtype=object)
+    vals = np.where(m, strs, None)
+    return _add_cat(idf, name, vals)
+
+
+def _latlon_dev_from_input(idf: Table, lat_c: str, lon_c: str, fmt: str):
+    """Input decode → (lat_dd device, lon_dd device, mask)."""
+    if fmt == "dd":
+        lat, ml = _dev_num(idf, lat_c)
+        lon, mo = _dev_num(idf, lon_c)
+        return lat, lon, ml & mo
+    if fmt == "radian":
+        lat, ml = _dev_num(idf, lat_c)
+        lon, mo = _dev_num(idf, lon_c)
+        return _rad2deg(lat), _rad2deg(lon), ml & mo
+    if fmt == "dms":  # strings: host parse, one upload
+        rt = get_runtime()
+        lat_h = _dms_str_to_dd(_host_cat(idf, lat_c))
+        lon_h = _dms_str_to_dd(_host_cat(idf, lon_c))
+        ok = np.isfinite(lat_h) & np.isfinite(lon_h)
+        npad = idf.pad_target()
+        pad = np.zeros(npad - idf.nrows)
+        lat_d = rt.shard_rows(np.concatenate([np.where(ok, lat_h, 0.0), pad]).astype(np.float32))
+        lon_d = rt.shard_rows(np.concatenate([np.where(ok, lon_h, 0.0), pad]).astype(np.float32))
+        m_d = rt.shard_rows(np.concatenate([ok, pad.astype(bool)]))
+        return lat_d, lon_d, m_d
+    raise ValueError(f"unsupported loc_input_format {fmt}")
+
+
+@jax.jit
+def _rad2deg(x):
+    return x * (180.0 / jnp.pi)
+
+
+@jax.jit
+def _deg2rad(x):
+    return x * (jnp.pi / 180.0)
+
+
+def geo_format_latlon(
+    idf: Table,
+    list_of_lat: Union[str, List[str]],
+    list_of_lon: Union[str, List[str]],
+    input_format: Optional[str] = None,
+    output_format: Optional[str] = None,
+    result_prefix="",
+    optional_configs: Optional[dict] = None,
+    output_mode: str = "append",
+    loc_input_format: str = "dd",
+    loc_output_format: str = "dms",
+) -> Table:
+    """Convert lat/lon pairs between dd / dms / radian / cartesian / geohash
+    (reference :39-188).  ``input_format``/``output_format``/``optional_configs``
+    are the reference's names; ``loc_input_format``/``loc_output_format``
+    remain as aliases."""
+    if isinstance(optional_configs, str):
+        # legacy positional call: output_mode used to sit in this slot
+        optional_configs, output_mode = None, optional_configs
+    loc_input_format = input_format or loc_input_format
+    loc_output_format = output_format or loc_output_format
+    from anovos_tpu.data_transformer.datetime import argument_checker
+
+    argument_checker("geo_format_latlon", {"output_mode": output_mode})
+    gh_precision = int((optional_configs or {}).get("geohash_precision", 9))
+    if isinstance(list_of_lat, str):
+        list_of_lat = [x.strip() for x in list_of_lat.split("|")]
+    if isinstance(list_of_lon, str):
+        list_of_lon = [x.strip() for x in list_of_lon.split("|")]
+    if isinstance(result_prefix, (list, tuple)):  # reference passes a list
+        result_prefix = "|".join(str(p) for p in result_prefix)
+    odf = idf
+    for i, (lat_c, lon_c) in enumerate(zip(list_of_lat, list_of_lon)):
+        lat, lon, mask = _latlon_dev_from_input(idf, lat_c, lon_c, loc_input_format)
+        # keep EMPTY entries: ["", "p2"] means pair 0 is unprefixed
+        prefixes = str(result_prefix).split("|") if result_prefix else []
+        pre = prefixes[i] if i < len(prefixes) else (prefixes[-1] if prefixes else "")
+        pre = pre + "_" if pre else ""
+        if loc_output_format == "dd":
+            odf = _add_dev(odf, f"{pre}{lat_c}_dd", lat, mask)
+            odf = _add_dev(odf, f"{pre}{lon_c}_dd", lon, mask)
+        elif loc_output_format == "radian":
+            odf = _add_dev(odf, f"{pre}{lat_c}_radian", _deg2rad(lat), mask)
+            odf = _add_dev(odf, f"{pre}{lon_c}_radian", _deg2rad(lon), mask)
+        elif loc_output_format == "dms":  # string output: host format
+            lat_h = np.asarray(jax.device_get(lat))[: idf.nrows].astype(float)
+            lon_h = np.asarray(jax.device_get(lon))[: idf.nrows].astype(float)
+            m = np.asarray(jax.device_get(mask))[: idf.nrows]
+            lat_h[~m] = np.nan
+            lon_h[~m] = np.nan
+            odf = _add_cat(odf, f"{pre}{lat_c}_dms", _dd_to_dms_str(lat_h))
+            odf = _add_cat(odf, f"{pre}{lon_c}_dms", _dd_to_dms_str(lon_h))
+        elif loc_output_format == "cartesian":
+            x, y, z = gk.latlon_to_cartesian(lat, lon)
+            odf = _add_dev(odf, f"{pre}{lat_c}_{lon_c}_x", x, mask)
+            odf = _add_dev(odf, f"{pre}{lat_c}_{lon_c}_y", y, mask)
+            odf = _add_dev(odf, f"{pre}{lat_c}_{lon_c}_z", z, mask)
+        elif loc_output_format == "geohash":
+            odf = _geohash_column(odf, lat, lon, mask, f"{pre}{lat_c}_{lon_c}_geohash", gh_precision)
+        else:
+            raise ValueError(f"unsupported loc_output_format {loc_output_format}")
+        if output_mode == "replace":
+            odf = odf.drop([lat_c, lon_c])
+    return odf
+
+
+def geo_format_cartesian(
+    idf: Table,
+    list_of_x,
+    list_of_y,
+    list_of_z,
+    output_format: Optional[str] = None,
+    result_prefix: str = "",
+    loc_output_format: str = "dd",
+    output_mode: str = "append",
+    **_ignored,
+) -> Table:
+    """Cartesian → dd/radian/geohash (reference :190-331), device trig.
+    ``output_format`` is the reference's name for ``loc_output_format``."""
+    from anovos_tpu.data_transformer.datetime import argument_checker
+
+    argument_checker("geo_format_cartesian", {"output_mode": output_mode})
+    loc_output_format = output_format or loc_output_format
+    if isinstance(list_of_x, str):
+        list_of_x = [v.strip() for v in list_of_x.split("|")]
+    if isinstance(list_of_y, str):
+        list_of_y = [v.strip() for v in list_of_y.split("|")]
+    if isinstance(list_of_z, str):
+        list_of_z = [v.strip() for v in list_of_z.split("|")]
+    odf = idf
+    for xc, yc, zc in zip(list_of_x, list_of_y, list_of_z):
+        x, mx = _dev_num(idf, xc)
+        y, my = _dev_num(idf, yc)
+        z, mz = _dev_num(idf, zc)
+        mask = mx & my & mz
+        lat, lon = gk.cartesian_to_latlon(x, y, z)
+        pre = (result_prefix + "_") if result_prefix else ""
+        if loc_output_format == "dd":
+            odf = _add_dev(odf, f"{pre}{xc}_{yc}_{zc}_lat", lat, mask)
+            odf = _add_dev(odf, f"{pre}{xc}_{yc}_{zc}_lon", lon, mask)
+        elif loc_output_format == "radian":
+            odf = _add_dev(odf, f"{pre}{xc}_{yc}_{zc}_lat_radian", _deg2rad(lat), mask)
+            odf = _add_dev(odf, f"{pre}{xc}_{yc}_{zc}_lon_radian", _deg2rad(lon), mask)
+        elif loc_output_format == "geohash":
+            odf = _geohash_column(odf, lat, lon, mask, f"{pre}{xc}_{yc}_{zc}_geohash")
+        else:
+            raise ValueError(f"unsupported loc_output_format {loc_output_format}")
+        if output_mode == "replace":
+            odf = odf.drop([xc, yc, zc])
+    return odf
+
+
+def geo_format_geohash(
+    idf: Table,
+    list_of_geohash,
+    output_format: Optional[str] = None,
+    result_prefix: str = "",
+    loc_output_format: str = "dd",
+    output_mode: str = "append",
+    **_ignored,
+) -> Table:
+    """Geohash → lat/lon: decode once per DISTINCT hash on host (dictionary
+    discipline), then a device gather maps codes → coordinates
+    (reference :333-458).  ``output_format`` is the reference's name for
+    ``loc_output_format``."""
+    from anovos_tpu.data_transformer.datetime import argument_checker
+
+    argument_checker("geo_format_geohash", {"output_mode": output_mode})
+    loc_output_format = output_format or loc_output_format
+    if isinstance(list_of_geohash, str):
+        list_of_geohash = [v.strip() for v in list_of_geohash.split("|")]
+    odf = idf
+    for c in list_of_geohash:
+        col = idf.columns[c]
+        decoded = np.array(
+            [geo_utils.geohash_decode(str(v)) if v else (np.nan, np.nan) for v in col.vocab]
+        )
+        if len(decoded) == 0:
+            decoded = np.full((1, 2), np.nan)
+        ok_v = np.isfinite(decoded).all(axis=1)
+        lat_v = jnp.asarray(np.where(ok_v, decoded[:, 0], 0.0), jnp.float32)
+        lon_v = jnp.asarray(np.where(ok_v, decoded[:, 1], 0.0), jnp.float32)
+        lat_d, lon_d, mask = _gather_decoded(col.data, col.mask, lat_v, lon_v, jnp.asarray(ok_v))
+        pre = (result_prefix + "_") if result_prefix else ""
+        if loc_output_format == "radian":
+            lat_d, lon_d = _deg2rad(lat_d), _deg2rad(lon_d)
+        odf = _add_dev(odf, f"{pre}{c}_latitude", lat_d, mask)
+        odf = _add_dev(odf, f"{pre}{c}_longitude", lon_d, mask)
+        if output_mode == "replace":
+            odf = odf.drop([c])
+    return odf
+
+
+@jax.jit
+def _gather_decoded(codes, mask, lat_v, lon_v, ok_v):
+    nv = lat_v.shape[0]
+    safe = jnp.clip(codes, 0, nv - 1)
+    ok = mask & (codes >= 0) & ok_v[safe]
+    return lat_v[safe], lon_v[safe], ok
+
+
+def location_distance(
+    idf: Table,
+    list_of_lat=None,
+    list_of_lon=None,
+    distance_type: str = "haversine",
+    unit: str = "m",
+    result_prefix: str = "",
+    list_of_cols_loc1=None,
+    list_of_cols_loc2=None,
+    loc_format: str = "dd",
+    **_ignored,
+) -> Table:
+    """Pairwise distance between two locations — one device program
+    (reference :460-651).  Two calling conventions: the reference's
+    ``list_of_cols_loc1=["lat1","lon1"], list_of_cols_loc2=["lat2","lon2"]``
+    with a ``loc_format`` (dd/radian — radians convert on device), or this
+    framework's ``list_of_lat=["lat1","lat2"], list_of_lon=["lon1","lon2"]``."""
+    if (list_of_cols_loc1 is None) != (list_of_cols_loc2 is None):
+        raise TypeError("list_of_cols_loc1 and list_of_cols_loc2 must be given together")
+    if list_of_cols_loc1 is not None and list_of_cols_loc2 is not None:
+        if isinstance(list_of_cols_loc1, str):
+            list_of_cols_loc1 = [v.strip() for v in list_of_cols_loc1.split("|")]
+        if isinstance(list_of_cols_loc2, str):
+            list_of_cols_loc2 = [v.strip() for v in list_of_cols_loc2.split("|")]
+        list_of_lat = [list_of_cols_loc1[0], list_of_cols_loc2[0]]
+        list_of_lon = [list_of_cols_loc1[1], list_of_cols_loc2[1]]
+    if isinstance(list_of_lat, str):
+        list_of_lat = [v.strip() for v in list_of_lat.split("|")]
+    if isinstance(list_of_lon, str):
+        list_of_lon = [v.strip() for v in list_of_lon.split("|")]
+    if len(list_of_lat) != 2 or len(list_of_lon) != 2:
+        raise ValueError("location_distance expects exactly two lat and two lon columns")
+    lat1, m1 = _dev_num(idf, list_of_lat[0])
+    lat2, m2 = _dev_num(idf, list_of_lat[1])
+    lon1, m3 = _dev_num(idf, list_of_lon[0])
+    lon2, m4 = _dev_num(idf, list_of_lon[1])
+    if loc_format == "radian":
+        lat1, lat2, lon1, lon2 = map(_rad2deg, (lat1, lat2, lon1, lon2))
+    elif loc_format != "dd":
+        raise ValueError(f"unsupported loc_format {loc_format} (dd/radian)")
+    fn = {"haversine": gk.haversine, "vincenty": gk.vincenty, "euclidean": gk.equirectangular}.get(
+        distance_type
+    )
+    if fn is None:
+        raise ValueError(f"unsupported distance_type {distance_type}")
+    d = fn(lat1, lon1, lat2, lon2)
+    if unit == "km":
+        d = d / 1000.0
+    pre = (result_prefix + "_") if result_prefix else ""
+    return _add_dev(idf, f"{pre}distance_{distance_type}", d, m1 & m2 & m3 & m4)
+
+
+def geohash_precision_control(
+    idf: Table,
+    list_of_geohash,
+    output_precision: Optional[int] = None,
+    km_max_error: Optional[float] = None,
+    output_mode: str = "replace",
+    **_ignored,
+) -> Table:
+    """Truncate geohashes to a target precision — pure VOCAB operation:
+    distinct strings truncate on host, codes remap on device via a small LUT
+    (reference :653-812).  ``output_precision`` is the reference's primary
+    parameter (default 8); ``km_max_error`` derives the precision from an
+    error-radius bound instead when given."""
+    if isinstance(list_of_geohash, str):
+        list_of_geohash = [v.strip() for v in list_of_geohash.split("|")]
+    err_km = [2500, 630, 78, 20, 2.4, 0.61, 0.076, 0.019, 0.0024, 0.0006, 0.000074]
+    if km_max_error is not None:
+        precision = next((i + 1 for i, e in enumerate(err_km) if e <= km_max_error), len(err_km))
+    else:
+        precision = int(output_precision if output_precision is not None else 8)
+    odf = idf
+    for c in list_of_geohash:
+        col = idf.columns[c]
+        if col.kind != "cat" or len(col.vocab) == 0:
+            continue
+        trunc = np.array([str(v)[:precision] for v in col.vocab], dtype=object)
+        new_vocab, inv = np.unique(trunc, return_inverse=True)
+        lut = jnp.asarray(inv.astype(np.int32))
+        data = _remap_codes(col.data, lut)
+        name = c if output_mode == "replace" else c + "_precision"
+        odf = odf.with_column(
+            name, Column("cat", data, col.mask, vocab=new_vocab.astype(object), dtype_name="string")
+        )
+    return odf
+
+
+@jax.jit
+def _remap_codes(codes, lut):
+    nv = lut.shape[0]
+    safe = jnp.clip(codes, 0, nv - 1)
+    return jnp.where(codes >= 0, lut[safe], -1)
+
+
+def location_in_country(
+    idf: Table,
+    list_of_lat,
+    list_of_lon,
+    country: str = "US",
+    country_shapefile_path: Optional[str] = None,
+    method_type: str = "approx",
+    result_prefix: str = "",
+    **_ignored,
+) -> Table:
+    """Flag rows inside a country (reference :814-973): "approx" compares
+    against the bounding-box table on device; "exact" ray-casts against the
+    geojson polygons on device (edges padded into one kernel; country
+    polygons are disjoint so whole-set parity equals per-polygon OR)."""
+    if isinstance(list_of_lat, str):
+        list_of_lat = [v.strip() for v in list_of_lat.split("|")]
+    if isinstance(list_of_lon, str):
+        list_of_lon = [v.strip() for v in list_of_lon.split("|")]
+    odf = idf
+    for lat_c, lon_c in zip(list_of_lat, list_of_lon):
+        lat, ml = _dev_num(idf, lat_c)
+        lon, mo = _dev_num(idf, lon_c)
+        mask = ml & mo
+        if method_type == "approx" or not country_shapefile_path:
+            key = country.upper()
+            bbox = None
+            for code, (name, bb) in geo_utils.COUNTRY_BOUNDING_BOXES.items():
+                if key == code or key == name.upper():
+                    bbox = bb
+                    break
+            if bbox is None:
+                raise ValueError(f"unknown country for approx containment: {country}")
+            inside = _bbox_program(lat, lon, *map(float, bbox))
+        else:
+            ex1, ey1, ex2, ey2, pid, n_poly = _geojson_edges(country_shapefile_path)
+            inside = gk.point_in_polygon_set(lat, lon, ex1, ey1, ex2, ey2, pid, n_poly)
+        pre = (result_prefix + "_") if result_prefix else ""
+        odf = _add_dev(odf, f"{pre}{lat_c}_{lon_c}_in_{country}", inside.astype(jnp.float32), mask)
+    return odf
+
+
+@jax.jit
+def _bbox_program(lat, lon, lo_lon, lo_lat, hi_lon, hi_lat):
+    return (lat >= lo_lat) & (lat <= hi_lat) & (lon >= lo_lon) & (lon <= hi_lon)
+
+
+def location_in_polygon(
+    idf: Table,
+    list_of_lat,
+    list_of_lon,
+    polygon: dict,
+    result_prefix=(),
+    output_mode: str = "append",
+    **_ignored,
+) -> Table:
+    """Flag rows inside a GeoJSON object — Polygon, MultiPolygon, Feature or
+    FeatureCollection (reference :727-812).  The rings are flattened into one
+    padded edge set and every lat-lon pair ray-casts against it in a single
+    device program per pair."""
+    if isinstance(list_of_lat, str):
+        list_of_lat = [v.strip() for v in list_of_lat.split("|")]
+    if isinstance(list_of_lon, str):
+        list_of_lon = [v.strip() for v in list_of_lon.split("|")]
+    if isinstance(result_prefix, str):
+        result_prefix = [v.strip() for v in result_prefix.split("|")]
+    missing = [c for c in list(list_of_lat) + list(list_of_lon) if c not in idf.col_names]
+    if missing:
+        raise TypeError(f"Invalid input for list_of_lat or list_of_lon: {missing}")
+    if len(list_of_lat) != len(list_of_lon):
+        raise TypeError("list_of_lat and list_of_lon must have the same length")
+    if result_prefix and len(result_prefix) != len(list_of_lat):
+        raise TypeError("result_prefix must have the same length as list_of_lat")
+    ex1, ey1, ex2, ey2, pid, n_poly = _geojson_obj_edges(polygon)
+    odf = idf
+    for i, (lat_c, lon_c) in enumerate(zip(list_of_lat, list_of_lon)):
+        lat, ml = _dev_num(idf, lat_c)
+        lon, mo = _dev_num(idf, lon_c)
+        inside = gk.point_in_polygon_set(lat, lon, ex1, ey1, ex2, ey2, pid, n_poly)
+        name = (result_prefix[i] if result_prefix else f"{lat_c}_{lon_c}") + "_in_poly"
+        odf = _add_dev(odf, name, inside.astype(jnp.float32), ml & mo)
+        if output_mode == "replace":
+            odf = odf.drop([lat_c, lon_c])
+    return odf
+
+
+def _geojson_edges(path: str):
+    """Host: flatten all rings of a geojson file into padded edge arrays."""
+    import json
+
+    with open(path) as f:
+        return _geojson_obj_edges(json.load(f))
+
+
+def _geojson_obj_edges(gj: dict):
+    """Flatten all rings of a parsed geojson object into edge arrays plus a
+    per-edge polygon id: rings of one polygon (outer + holes) share an id so
+    even-odd parity runs per polygon, and overlapping polygons union instead
+    of cancelling.  Returns (ex1, ey1, ex2, ey2, poly_id, n_poly)."""
+    feats = gj["features"] if gj.get("type") == "FeatureCollection" else [gj]
+    x1s, y1s, x2s, y2s, pids = [], [], [], [], []
+    n_poly = 0
+    for feat in feats:
+        geom = feat.get("geometry", feat)
+        polys = geom["coordinates"] if geom["type"] == "MultiPolygon" else [geom["coordinates"]]
+        for poly in polys:
+            for ring in poly:
+                pts = np.asarray(ring, float)
+                nxt = np.roll(pts, -1, axis=0)
+                x1s.append(pts[:, 0])
+                y1s.append(pts[:, 1])
+                x2s.append(nxt[:, 0])
+                y2s.append(nxt[:, 1])
+                pids.append(np.full(len(pts), n_poly, np.int32))
+            n_poly += 1
+    return (
+        jnp.asarray(np.concatenate(x1s), jnp.float32),
+        jnp.asarray(np.concatenate(y1s), jnp.float32),
+        jnp.asarray(np.concatenate(x2s), jnp.float32),
+        jnp.asarray(np.concatenate(y2s), jnp.float32),
+        jnp.asarray(np.concatenate(pids)),
+        n_poly,
+    )
+
+
+def _id_codes(idf: Table, id_col: str):
+    """(codes device, valid device, labels host) for a grouping column."""
+    col = idf.columns[id_col]
+    if col.kind == "cat":
+        return col.data, col.mask & (col.data >= 0), col.vocab
+    # numeric ids: device unique-compaction → searchsorted codes
+    from anovos_tpu.data_analyzer.quality_checker import _member_mask, _unique_compact  # noqa: F401
+
+    buf, nu_d = _unique_compact(col.data, col.mask)
+    nu = int(nu_d)
+    # full fixed-shape buffer through the program + host-side slice: a
+    # per-nu device slice re-specialized XLA for every distinct count
+    codes = _codes_via_search(col.data, buf, nu_d)
+    return codes, col.mask, np.asarray(jax.device_get(buf))[:nu]
+
+
+@jax.jit
+def _codes_via_search(data, buf, nu):
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, buf.dtype)
+    uniq = jnp.where(jnp.arange(buf.shape[0]) < nu, buf, big)
+    x = data.astype(buf.dtype)
+    idx = jnp.clip(jnp.searchsorted(uniq, x), 0, buf.shape[0] - 1)
+    return idx.astype(jnp.int32)
+
+
+def centroid(idf: Table, lat_col: str, long_col: str, id_col: Optional[str] = None) -> pd.DataFrame:
+    """Per-id (or global) centroid via cartesian mean on device
+    (reference :975-1097).  Returns [id?, <lat>_centroid, <long>_centroid]."""
+    lat, ml = _dev_num(idf, lat_col)
+    lon, mo = _dev_num(idf, long_col)
+    x, y, z = gk.latlon_to_cartesian(lat, lon)
+    if id_col:
+        seg, valid, labels = _id_codes(idf, id_col)
+        if len(labels) == 0:  # all-null id column: empty result frame
+            return pd.DataFrame(columns=[id_col, lat_col + "_centroid", long_col + "_centroid"])
+        nseg = len(labels)
+        clat, clon, cnt = jax.device_get(
+            gk.segment_centroid(x, y, z, seg, valid & ml & mo, nseg)
+        )
+        keep = cnt > 0
+        out = pd.DataFrame(
+            {
+                id_col: np.asarray(labels)[keep],
+                lat_col + "_centroid": np.round(clat[keep].astype(float), 6),
+                long_col + "_centroid": np.round(clon[keep].astype(float), 6),
+            }
+        )
+        return out.reset_index(drop=True)
+    seg = jnp.zeros(idf.padded_rows, jnp.int32)
+    clat, clon, cnt = jax.device_get(gk.segment_centroid(x, y, z, seg, ml & mo, 1))
+    return pd.DataFrame(
+        {
+            lat_col + "_centroid": np.round(clat.astype(float), 6),
+            long_col + "_centroid": np.round(clon.astype(float), 6),
+        }
+    )
+
+
+def weighted_centroid(
+    idf: Table, lat_col: str, long_col: str, id_col: str, weight_col: str
+) -> pd.DataFrame:
+    """Weight-averaged centroid per id on device (reference :1099-1221)."""
+    lat, ml = _dev_num(idf, lat_col)
+    lon, mo = _dev_num(idf, long_col)
+    w, mw = _dev_num(idf, weight_col)
+    x, y, z = gk.latlon_to_cartesian(lat, lon)
+    seg, valid, labels = _id_codes(idf, id_col)
+    if len(labels) == 0:
+        return pd.DataFrame(
+            columns=[id_col, lat_col + "_weighted_centroid", long_col + "_weighted_centroid"]
+        )
+    nseg = len(labels)
+    clat, clon, sw = jax.device_get(
+        gk.segment_weighted_centroid(x, y, z, w, seg, valid & ml & mo & mw, nseg)
+    )
+    keep = sw != 0
+    out = pd.DataFrame(
+        {
+            id_col: np.asarray(labels)[keep],
+            lat_col + "_weighted_centroid": np.round(clat[keep].astype(float), 6),
+            long_col + "_weighted_centroid": np.round(clon[keep].astype(float), 6),
+        }
+    )
+    return out.reset_index(drop=True)
+
+
+def rog_calculation(idf: Table, lat_col: str, long_col: str, id_col: str) -> pd.DataFrame:
+    """Radius of gyration per id: RMS haversine distance to the centroid —
+    centroid, distances and per-id mean in ONE device program
+    (reference :1223-1333)."""
+    lat, ml = _dev_num(idf, lat_col)
+    lon, mo = _dev_num(idf, long_col)
+    seg, valid, labels = _id_codes(idf, id_col)
+    if len(labels) == 0:
+        return pd.DataFrame(columns=[id_col, "rog"])
+    nseg = len(labels)
+    rog, cnt = jax.device_get(gk.segment_rog(lat, lon, seg, valid & ml & mo, nseg))
+    keep = cnt > 0
+    return pd.DataFrame(
+        {id_col: np.asarray(labels)[keep], "rog": rog[keep].astype(float)}
+    ).reset_index(drop=True)
+
+
+_GEOCODE_CACHE = {}  # resolved path -> (unit_xyz (C,3) np.f32, frame)
+
+
+def _geocode_table() -> tuple:
+    """Offline centroid table with precomputed unit vectors for the
+    nearest-centroid matmul, cached per resolved path (changing the env
+    override mid-process takes effect).  Resolution order:
+
+    1. ``ANOVOS_GEOCODE_TABLE`` — a ``.csv`` (name,admin1,cc,lat,lon) or a
+       ``.npz`` packed by ``tools/build_geonames_table.py`` (geonames
+       cities1000-scale: ~50-150k rows in ~1-2 MB);
+    2. bundled ``data/cities.npz`` when present (drop the geonames build
+       there the first time an environment with the source file appears);
+    3. bundled ``data/world_cities.csv`` fallback (573 cities: world
+       capitals + majors + the zoneinfo city list — coarse: nearest-
+       centroid errors reach hundreds of km off the city list).
+    """
+    import os
+
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    path = os.environ.get("ANOVOS_GEOCODE_TABLE")
+    if not path:
+        npz = os.path.join(d, "cities.npz")
+        path = npz if os.path.exists(npz) else os.path.join(d, "world_cities.csv")
+    if path not in _GEOCODE_CACHE:
+        if path.endswith(".npz"):
+            z = np.load(path, allow_pickle=False)
+            cities = pd.DataFrame(
+                {
+                    "name": z["name"].astype(str),
+                    "admin1": z["admin1"].astype(str),
+                    "cc": z["cc"].astype(str),
+                    "lat": z["lat"].astype(np.float64),
+                    "lon": z["lon"].astype(np.float64),
+                }
+            )
+        else:
+            # keep_default_na=False: Namibia's country code IS the string "NA"
+            cities = pd.read_csv(path, keep_default_na=False)
+        la = np.radians(cities["lat"].to_numpy(float))
+        lo = np.radians(cities["lon"].to_numpy(float))
+        xyz = np.stack(
+            [np.cos(la) * np.cos(lo), np.cos(la) * np.sin(lo), np.sin(la)], axis=1
+        ).astype(np.float32)
+        _GEOCODE_CACHE[path] = (xyz, cities)
+    return _GEOCODE_CACHE[path]
+
+
+@jax.jit
+def _nearest_city_chunk(lat_deg: jax.Array, lon_deg: jax.Array, city_xyz: jax.Array) -> jax.Array:
+    """argmin great-circle distance == argmax 3D dot product with the city
+    unit vectors — one (n,3)@(3,C) MXU matmul instead of n×C haversines."""
+    la = jnp.radians(lat_deg.astype(jnp.float32))
+    lo = jnp.radians(lon_deg.astype(jnp.float32))
+    pts = jnp.stack(
+        [jnp.cos(la) * jnp.cos(lo), jnp.cos(la) * jnp.sin(lo), jnp.sin(la)], axis=1
+    )
+    return jnp.argmax(pts @ city_xyz.T, axis=1)
+
+
+_GEOCODE_CHUNK = 8192
+
+
+def _nearest_city_idx(lat: np.ndarray, lon: np.ndarray, city_xyz: np.ndarray) -> np.ndarray:
+    """Tiled nearest-centroid search: queries go through in fixed-size
+    chunks (last one padded) so a geonames-scale table (C ≈ 150k) never
+    materializes an (N, C) score matrix for the whole query set, and every
+    chunk reuses ONE compiled shape."""
+    n = len(lat)
+    cx = jnp.asarray(city_xyz)
+    if n <= _GEOCODE_CHUNK:
+        # next power of two: bounded compile count across varying batch sizes
+        pad = min(_GEOCODE_CHUNK, 1 << max(n - 1, 1).bit_length())
+        la = np.zeros(pad, np.float32)
+        lo = np.zeros(pad, np.float32)
+        la[:n], lo[:n] = lat, lon
+        return np.asarray(jax.device_get(_nearest_city_chunk(jnp.asarray(la), jnp.asarray(lo), cx)))[:n]
+    out = np.empty(n, np.int64)
+    for s in range(0, n, _GEOCODE_CHUNK):
+        e = min(s + _GEOCODE_CHUNK, n)
+        la = np.zeros(_GEOCODE_CHUNK, np.float32)
+        lo = np.zeros(_GEOCODE_CHUNK, np.float32)
+        la[: e - s], lo[: e - s] = lat[s:e], lon[s:e]
+        out[s:e] = np.asarray(
+            jax.device_get(_nearest_city_chunk(jnp.asarray(la), jnp.asarray(lo), cx))
+        )[: e - s]
+    return out
+
+
+def reverse_geocoding(idf: Table, lat_col: str, long_col: str, **_ignored) -> pd.DataFrame:
+    """[lat, long, name_of_place, region, country_code] via nearest centroid
+    (reference :1335-1409; its offline ``reverse_geocoder`` package is the
+    same design — geonames centroids + NN search — so the bundled compact
+    table preserves the semantics at city granularity).  When the optional
+    package IS importable it takes precedence for its much denser database."""
+    if lat_col not in idf.columns:
+        raise TypeError("Invalid input for lat_col")
+    if long_col not in idf.columns:
+        raise TypeError("Invalid input for long_col")
+    lat, ml = _host_num(idf, lat_col)
+    lon, mo = _host_num(idf, long_col)
+    ok = ml & mo & np.isfinite(lat) & np.isfinite(lon)
+    if (~ok).any():
+        warnings.warn("Rows dropped due to null value in longitude and/or latitude values")
+    rng_ok = (lat >= -90) & (lat <= 90) & (lon >= -180) & (lon <= 180)
+    if (ok & ~rng_ok).any():
+        warnings.warn(
+            "Rows dropped due to longitude and/or latitude values being out of the valid range"
+        )
+    ok &= rng_ok
+    if not ok.any():
+        warnings.warn(
+            "No reverse_geocoding Computation - No valid latitude/longitude row(s) to compute"
+        )
+        return pd.DataFrame(columns=[lat_col, long_col, "name_of_place", "region", "country_code"])
+    la, lo = lat[ok], lon[ok]
+    try:  # pragma: no cover - optional dependency with a denser database
+        import reverse_geocoder as rg
+
+        res = rg.search(list(zip(la, lo)), mode=1)
+        name = [r["name"] for r in res]
+        admin1 = [r["admin1"] for r in res]
+        cc = [r["cc"] for r in res]
+    except ImportError:
+        city_xyz, cities = _geocode_table()
+        idx = _nearest_city_idx(la.astype(np.float32), lo.astype(np.float32), city_xyz)
+        name = cities["name"].to_numpy()[idx]
+        admin1 = cities["admin1"].to_numpy()[idx]
+        cc = cities["cc"].to_numpy()[idx]
+    return pd.DataFrame(
+        {
+            lat_col: la,
+            long_col: lo,
+            "name_of_place": name,
+            "region": admin1,
+            "country_code": cc,
+        }
+    ).reset_index(drop=True)

@@ -1,0 +1,182 @@
+"""Latent-feature transformers (reference transformers.py:2524-3168).
+
+``autoencoder_latentFeatures``: the north-star item — the reference trains a
+Keras AE on a ≤500k pandas sample and applies it via pandas_udf
+(ref :2783-2892); here the AE (models/autoencoder.py) trains as a jitted
+optax loop on the device-resident standardized block and the encoder applies
+as one forward pass.  ``PCA_latentFeatures``: Spark ML PCA → device SVD with
+the same explained-variance-cutoff k selection (ref :3121-3137).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from collections import OrderedDict
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from anovos_tpu.data_transformer.model_io import load_model_df, save_model_df
+from anovos_tpu.models.autoencoder import AutoEncoder
+from anovos_tpu.ops.reductions import masked_moments
+from anovos_tpu.shared.runtime import get_runtime
+from anovos_tpu.shared.table import Column, Table
+from anovos_tpu.shared.utils import parse_cols
+
+
+def _prep_block(idf: Table, cols: List[str], standardization: bool, imputation: bool):
+    """Common preamble (reference :2560-2780): impute missing with median,
+    z-standardize.  Returns (X, stats) with X fully dense."""
+    X, M = idf.numeric_block(cols)
+    mom = masked_moments(X, M)
+    mean = mom["mean"]
+    std = jnp.where(mom["stddev"] > 0, mom["stddev"], 1.0)
+    if imputation:
+        from anovos_tpu.ops.quantiles import masked_median
+
+        fill = masked_median(X, M)
+        Xd = jnp.where(M, X, fill[None, :])
+    else:
+        Xd = jnp.where(M, X, mean[None, :])
+    if standardization:
+        Xd = (Xd - mean[None, :]) / std[None, :]
+    return Xd, mean, std
+
+
+def autoencoder_latentFeatures(
+    idf: Table,
+    list_of_cols="all",
+    drop_cols=[],
+    reduction_params: float = 0.5,
+    sample_size: int = 500000,
+    epochs: int = 100,
+    batch_size: int = 256,
+    pre_existing_model: bool = False,
+    model_path: str = "NA",
+    standardization: bool = True,
+    standardization_configs: dict = {},
+    imputation: bool = True,
+    imputation_configs: dict = {},
+    output_mode: str = "replace",
+    print_impact: bool = False,
+    **_ignored,
+) -> Table:
+    """Append/replace with ``latent_<i>`` encoder outputs.
+
+    ``reduction_params`` < 1 → bottleneck = round(r·n_cols); ≥ 1 → exact k
+    (reference :2640-2651).  Training runs on device over the full (or
+    ``sample_size``-capped) standardized block.
+    """
+    num_all, _, _ = idf.attribute_type_segregation()
+    cols = parse_cols(list_of_cols if list_of_cols != "all" else num_all, idf.col_names, drop_cols)
+    cols = [c for c in cols if c in num_all]
+    if len(cols) < 2:
+        warnings.warn("No Autoencoder Computation - need ≥2 numerical columns")
+        return idf
+    n = len(cols)
+    k = int(round(reduction_params * n)) if reduction_params < 1 else int(reduction_params)
+    k = max(1, min(k, n))
+    X, mean, std = _prep_block(idf, cols, standardization, imputation)
+
+    if pre_existing_model:
+        ae, params = AutoEncoder.load(model_path)
+    else:
+        n_fit = min(idf.nrows, sample_size)
+        Xfit = X[: idf.nrows][:n_fit]
+        split = int(n_fit * 0.8)
+        ae = AutoEncoder(n, k)
+        params = ae.fit(
+            Xfit[:split],
+            epochs=int(epochs),
+            batch_size=int(min(batch_size, max(split, 1))),
+            validation_X=Xfit[split:] if split < n_fit else None,
+            verbose=print_impact,
+        )
+        if model_path != "NA":
+            ae.save(params, model_path)
+
+    Z = ae.latent(params, X)  # (padded_rows, k)
+    odf = idf
+    in_range = jnp.arange(idf.padded_rows) < idf.nrows
+    for i in range(ae.n_bottleneck):
+        odf = odf.with_column(
+            f"latent_{i}", Column("num", Z[:, i].astype(jnp.float32), in_range, dtype_name="float")
+        )
+    if output_mode == "replace":
+        odf = odf.drop(cols)
+    if print_impact:
+        print(f"autoencoder latent features: {ae.n_bottleneck} from {n} columns")
+    return odf
+
+
+def PCA_latentFeatures(
+    idf: Table,
+    list_of_cols="all",
+    drop_cols=[],
+    explained_variance_cutoff: float = 0.95,
+    pre_existing_model: bool = False,
+    model_path: str = "NA",
+    standardization: bool = False,
+    standardization_configs: dict = {},
+    imputation: bool = False,
+    imputation_configs: dict = {},
+    output_mode: str = "replace",
+    print_impact: bool = False,
+    **_ignored,
+) -> Table:
+    """PCA with k = smallest component count reaching the explained-variance
+    cutoff (reference :2915-3168).  SVD runs on device; components persist as
+    parquet [attribute, loadings…]."""
+    num_all, _, _ = idf.attribute_type_segregation()
+    cols = parse_cols(list_of_cols if list_of_cols != "all" else num_all, idf.col_names, drop_cols)
+    cols = [c for c in cols if c in num_all]
+    if len(cols) < 2:
+        warnings.warn("No PCA Computation - need ≥2 numerical columns")
+        return idf
+    X, mean, std = _prep_block(idf, cols, standardization, imputation=True)
+    rowmask = (jnp.arange(idf.padded_rows) < idf.nrows)[:, None]
+    Xc = jnp.where(rowmask, X - X.mean(axis=0, where=rowmask), 0.0)
+
+    if pre_existing_model:
+        dfm = load_model_df(model_path, "PCA_latentFeatures")
+        comp = np.stack([np.asarray(r, dtype=np.float32) for r in dfm["loadings"]])
+        saved_cols = list(dfm["attribute"]) if "attribute" in dfm else cols
+        k = comp.shape[0]
+        V = jnp.asarray(comp.T)
+    else:
+        cov = (Xc.T @ Xc) / jnp.maximum(idf.nrows - 1, 1)
+        eigval, eigvec = jnp.linalg.eigh(cov)
+        order = jnp.argsort(eigval)[::-1]
+        eigval = eigval[order]
+        eigvec = eigvec[:, order]
+        ratio = np.cumsum(np.asarray(eigval)) / max(float(jnp.sum(eigval)), 1e-30)
+        k = int(np.searchsorted(ratio, explained_variance_cutoff) + 1)
+        k = max(1, min(k, len(cols)))
+        V = eigvec[:, :k]
+        if model_path != "NA":
+            save_model_df(
+                pd.DataFrame(
+                    {
+                        "component": [f"latent_{i}" for i in range(k)],
+                        "loadings": [np.asarray(V[:, i], dtype=float).tolist() for i in range(k)],
+                    }
+                ),
+                model_path,
+                "PCA_latentFeatures",
+            )
+    Z = Xc @ V  # (padded_rows, k)
+    odf = idf
+    in_range = jnp.arange(idf.padded_rows) < idf.nrows
+    for i in range(int(Z.shape[1])):
+        odf = odf.with_column(
+            f"latent_{i}", Column("num", Z[:, i].astype(jnp.float32), in_range, dtype_name="float")
+        )
+    if output_mode == "replace":
+        odf = odf.drop(cols)
+    if print_impact:
+        print(f"PCA latent features: {int(Z.shape[1])} components (cutoff {explained_variance_cutoff})")
+    return odf

@@ -1,0 +1,44 @@
+"""Model-artifact persistence helpers.
+
+Mirrors the reference's checkpoint discipline (SURVEY.md §5): every fit-like
+transformer persists its parameters under ``model_path/<name>`` and can be
+re-applied with ``pre_existing_model=True``.  Artifacts are parquet (cutoffs,
+scaler stats) or CSV (encoders) directories like the reference's, written
+via pandas/pyarrow.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+from typing import Optional
+
+import pandas as pd
+
+
+def save_model_df(df: pd.DataFrame, model_path: str, name: str, fmt: str = "parquet") -> None:
+    path = os.path.join(model_path, name)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.makedirs(path, exist_ok=True)
+    if fmt == "parquet":
+        df.to_parquet(os.path.join(path, "part-00000.parquet"), index=False)
+    else:
+        df.to_csv(os.path.join(path, "part-00000.csv"), index=False)
+
+
+def load_model_df(model_path: str, name: str, fmt: str = "parquet") -> pd.DataFrame:
+    path = os.path.join(model_path, name)
+    if fmt == "parquet":
+        files = sorted(glob.glob(os.path.join(path, "*.parquet")))
+        if not files and os.path.isfile(path):
+            files = [path]
+        return pd.concat([pd.read_parquet(f) for f in files], ignore_index=True)
+    files = sorted(glob.glob(os.path.join(path, "*.csv")))
+    if not files and os.path.isfile(path):
+        files = [path]
+    # dtype=str: category values like "01" or "1" must round-trip verbatim —
+    # pandas numeric inference would mangle them and break vocab matching on
+    # pre_existing_model re-apply; callers cast numeric columns themselves.
+    return pd.concat([pd.read_csv(f, dtype=str) for f in files], ignore_index=True)

@@ -1,0 +1,13 @@
+"""Drift & stability analysis (reference: src/main/anovos/drift_stability/).
+
+The headline-benchmark module: the reference's per-column Spark-job loop with
+groupBy + full-outer join per column (drift_detector.py:243-344) becomes ONE
+fused kernel — binned histograms for every column at once via segment
+reductions, then vectorized PSI/HD/JSD/KS over the (cols × bins) array.
+"""
+
+from anovos_tpu.drift_stability.drift_detector import statistics  # noqa: F401
+from anovos_tpu.drift_stability.stability import (  # noqa: F401
+    feature_stability_estimation,
+    stability_index_computation,
+)

@@ -1,0 +1,345 @@
+"""Covariate-drift statistics (reference: drift_stability/drift_detector.py:18).
+
+The BASELINE comparison target.  Mechanism (reference :216-344): bin the
+source with cutoffs persisted as a binning model, apply the same cutoffs to
+the target, build per-column relative-frequency tables p/q with 0→0.0001
+smoothing, then PSI / Hellinger / JSD / KS per column.
+
+TPU shape (SURVEY.md §3.4) with dispatch-count discipline: per dataset side
+the ENTIRE histogram computation — every numeric column binned + every
+categorical column counted — is one fused jitted program
+(ops/drift_kernels.py); cutoff fitting is one more.  The reference's
+thousands of Spark jobs become ~5 device dispatches total, and the metric
+arithmetic is vectorized host numpy over the (cols × bins) arrays.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from anovos_tpu.drift_stability.validations import check_distance_method
+from anovos_tpu.shared.table import Table
+from anovos_tpu.shared.utils import parse_cols
+
+_SMOOTH = 0.0001
+
+
+def _freqs_to_metrics(p: np.ndarray, q: np.ndarray, methods: List[str]) -> dict:
+    """Vectorized drift metrics over (k, nb) frequency arrays with the
+    reference's 0→0.0001 smoothing (:266-271)."""
+    p = np.where(p <= 0, _SMOOTH, p)
+    q = np.where(q <= 0, _SMOOTH, q)
+    out = {}
+    if "PSI" in methods:
+        out["PSI"] = ((p - q) * np.log(p / q)).sum(axis=1)
+    if "HD" in methods:
+        out["HD"] = np.sqrt(((np.sqrt(p) - np.sqrt(q)) ** 2).sum(axis=1) / 2)
+    if "JSD" in methods:
+        m = (p + q) / 2
+        out["JSD"] = ((p * np.log(p / m)).sum(axis=1) + (q * np.log(q / m)).sum(axis=1)) / 2
+    if "KS" in methods:
+        out["KS"] = np.abs(np.cumsum(p, axis=1) - np.cumsum(q, axis=1)).max(axis=1)
+    return out
+
+
+def _drop_allnan_cutoffs(cutoffs: np.ndarray, cols: List[str]):
+    """Drop columns whose every cutoff is NaN (all-null in source) with the
+    reference's warning.  Returns (cutoffs, cols, keep mask)."""
+    cutoffs = np.asarray(cutoffs, np.float64)
+    keep = ~np.isnan(cutoffs).all(axis=1)
+    if not keep.all():
+        dropped = [c for c, k in zip(cols, keep) if not k]
+        warnings.warn("Columns contains too much null values. Dropping " + ", ".join(dropped))
+    return cutoffs[keep], [c for c, k in zip(cols, keep) if k], keep
+
+
+def statistics(
+    idf_target: Table,
+    idf_source: Optional[Table] = None,
+    list_of_cols="all",
+    drop_cols=None,
+    method_type: str = "PSI",
+    bin_method: str = "equal_range",
+    bin_size: int = 10,
+    threshold: float = 0.1,
+    use_sampling: bool = True,
+    sample_method: str = "random",
+    strata_cols="all",
+    stratified_type: str = "population",
+    sample_size: int = 100000,
+    sample_seed: int = 42,
+    pre_existing_source: bool = False,
+    source_save: bool = True,
+    source_path: str = "NA",
+    model_directory: str = "drift_statistics",
+    print_impact: bool = False,
+    **_ignored,
+) -> pd.DataFrame:
+    """[attribute, <PSI|HD|JSD|KS…>, flagged] drift between source and target.
+
+    With ``pre_existing_source=True`` the persisted binning model and source
+    frequency CSVs under ``source_path/model_directory`` are reused and
+    ``idf_source`` may be None (reference :245-250 source-free re-runs).
+    """
+    methods = check_distance_method(method_type)
+    drop_cols = drop_cols or []
+    num_all, cat_all, _ = idf_target.attribute_type_segregation()
+    cols = parse_cols(
+        list_of_cols if list_of_cols != "all" else num_all + cat_all,
+        idf_target.col_names,
+        drop_cols,
+    )
+    num_cols = [c for c in cols if idf_target.columns[c].kind == "num"]
+    cat_cols = [c for c in cols if idf_target.columns[c].kind == "cat"]
+    if source_path == "NA":
+        source_path = "intermediate_data"
+    model_dir = os.path.join(source_path, model_directory)
+
+    if use_sampling:
+        from anovos_tpu.data_ingest.data_sampling import data_sample
+
+        if idf_target.nrows > sample_size:
+            idf_target = data_sample(
+                idf_target, strata_cols=strata_cols, fraction=sample_size / idf_target.nrows,
+                method_type=sample_method, stratified_type=stratified_type, seed_value=sample_seed,
+            )
+        if not pre_existing_source and idf_source is not None and idf_source.nrows > sample_size:
+            idf_source = data_sample(
+                idf_source, strata_cols=strata_cols, fraction=sample_size / idf_source.nrows,
+                method_type=sample_method, stratified_type=stratified_type, seed_value=sample_seed,
+            )
+
+    count_target = idf_target.nrows
+    from anovos_tpu.data_transformer.model_io import load_model_df, save_model_df
+    from anovos_tpu.ops.drift_kernels import drift_side_full
+    from anovos_tpu.shared.runtime import get_runtime
+
+    # single-device meshes have no collectives, so the cutoff-fit and both
+    # side programs can be pipelined on device with ONE host sync at the end;
+    # multi-device stays strictly sequential (two collective programs in
+    # flight can interleave their rendezvous — see Table.gather_rows)
+    pipeline_ok = bool(get_runtime().n_devices == 1 and not pre_existing_source and num_cols)
+
+    # ---- numeric cutoffs: fit on source (1 kernel) or load the model ------
+    num_cols_eff = list(num_cols)
+    cutoffs = None
+    cuts_d = None
+    if num_cols:
+        if pre_existing_source:
+            dfm = load_model_df(model_dir, "attribute_binning")
+            cut_map = {r["attribute"]: list(r["parameters"]) for _, r in dfm.iterrows()}
+            num_cols_eff = [c for c in num_cols if c in cut_map]
+            cutoffs = np.array([cut_map[c] for c in num_cols_eff], dtype=np.float64)
+        else:
+            cuts_d = _fit_cutoffs_dev(idf_source, num_cols, bin_size, bin_method)
+            if not pipeline_ok:
+                cutoffs, num_cols_eff, _ = _drop_allnan_cutoffs(np.asarray(cuts_d), num_cols)
+
+    # ---- union vocabularies for categorical columns -----------------------
+    union_vocabs: Dict[str, np.ndarray] = {}
+    freq_p: Dict[str, np.ndarray] = {}
+    if pre_existing_source:
+        for c in cols:
+            path = os.path.join(model_dir, "frequency_counts", c, "part-00000.csv")
+            if not os.path.exists(path):
+                # e.g. a column the fit run dropped (all-null in source)
+                warnings.warn(f"drift statistics: no persisted source frequencies for {c}; skipping")
+                continue
+            f = pd.read_csv(path, dtype=str)
+            kcol = f.columns[0]
+            smap = dict(zip(f[kcol].astype(str), f["p"].astype(float)))
+            if c in num_cols_eff:
+                freq_p[c] = np.array([smap.get(str(k), 0.0) for k in range(1, bin_size + 1)])
+            elif c in cat_cols:
+                tgt_vocab = {str(v) for v in idf_target.columns[c].vocab}
+                uni = np.array(sorted(set(smap) | tgt_vocab), dtype=object)
+                union_vocabs[c] = uni
+                freq_p[c] = np.array([smap.get(str(v), 0.0) for v in uni])
+            # numeric columns absent from the binning model are skipped
+        cat_cols = [c for c in cat_cols if c in union_vocabs]
+    else:
+        union_vocabs = _union_vocabs_for(idf_source, idf_target, cat_cols)
+
+    # ---- ONE fused program per dataset side --------------------------------
+    n_union = max((len(union_vocabs[c]) for c in cat_cols), default=1)
+    if pipeline_ok:
+        cuts_dev = cuts_d  # stays on device; NaN rows dropped post-hoc
+        num_cols_eff = list(num_cols)
+    else:
+        cuts_dev = jnp.asarray(cutoffs, jnp.float32) if num_cols_eff else jnp.zeros((0, bin_size - 1))
+
+    def side(idf: Table, sync: bool = True):
+        out = drift_side_full(
+            *_side_args(
+                idf, num_cols_eff, cat_cols, cuts_dev,
+                _lut_for(idf, cat_cols, union_vocabs), bin_size, n_union,
+            )
+        )
+        return jax.device_get(out) if sync else out
+
+    if pipeline_ok:
+        # async dispatch of all three programs, one host sync
+        tgt_pair = side(idf_target, sync=False)
+        src_pair = side(idf_source, sync=False)
+        cutoffs, (tgt_num, tgt_cat), (src_num, src_cat) = jax.device_get(
+            (cuts_dev, tgt_pair, src_pair)
+        )
+        cutoffs, num_cols_eff, keep = _drop_allnan_cutoffs(cutoffs, num_cols_eff)
+        tgt_num = tgt_num[keep]
+        src_num = src_num[keep]
+    else:
+        tgt_num, tgt_cat = side(idf_target)
+        if not pre_existing_source:
+            src_num, src_cat = side(idf_source)
+
+    if not pre_existing_source and cutoffs is not None:
+        save_model_df(
+            pd.DataFrame(
+                {"attribute": num_cols_eff, "parameters": [list(map(float, c)) for c in cutoffs]}
+            ),
+            model_dir,
+            "attribute_binning",
+        )
+
+    freq_q: Dict[str, np.ndarray] = {}
+    for i, c in enumerate(num_cols_eff):
+        freq_q[c] = tgt_num[i] / max(count_target, 1)
+    for j, c in enumerate(cat_cols):
+        freq_q[c] = tgt_cat[j][: len(union_vocabs[c])] / max(count_target, 1)
+
+    if not pre_existing_source:
+        for i, c in enumerate(num_cols_eff):
+            freq_p[c] = src_num[i] / max(idf_source.nrows, 1)
+        for j, c in enumerate(cat_cols):
+            freq_p[c] = src_cat[j][: len(union_vocabs[c])] / max(idf_source.nrows, 1)
+        if source_save:
+            for c in num_cols_eff + cat_cols:
+                d = os.path.join(model_dir, "frequency_counts", c)
+                os.makedirs(d, exist_ok=True)
+                keys = (
+                    list(range(1, bin_size + 1)) if c in num_cols_eff else list(union_vocabs[c])
+                )
+                pd.DataFrame({c: keys, "p": freq_p[c]}).to_csv(
+                    os.path.join(d, "part-00000.csv"), index=False
+                )
+
+    # ---- vectorized metrics over padded (k, max_bins) arrays --------------
+    cols_eff = [c for c in cols if c in freq_p and c in freq_q]
+    if not cols_eff:
+        return pd.DataFrame(columns=["attribute"] + methods + ["flagged"])
+    nb = max(len(freq_p[c]) for c in cols_eff)
+    P = np.full((len(cols_eff), nb), _SMOOTH)
+    Q = np.full((len(cols_eff), nb), _SMOOTH)
+    for i, c in enumerate(cols_eff):
+        P[i, : len(freq_p[c])] = freq_p[c]
+        q = freq_q[c]
+        if len(q) < len(freq_p[c]):  # pre-existing source saw more categories
+            q = np.concatenate([q, np.zeros(len(freq_p[c]) - len(q))])
+        Q[i, : len(q)] = q
+    # padding lanes hold equal smoothing on both sides → zero contribution
+    mets = _freqs_to_metrics(P, Q, methods)
+    odf = pd.DataFrame({"attribute": cols_eff})
+    for m in methods:
+        odf[m] = np.round(mets[m], 4)
+    odf["flagged"] = (odf[methods] > threshold).any(axis=1).astype(int)
+    if print_impact:
+        print(odf.to_string(index=False))
+    return odf
+
+
+def _fit_cutoffs_dev(idf_source: Table, num_cols: List[str], bin_size: int, bin_method: str):
+    """Device cutoff fit over the source side's column arrays (one kernel)."""
+    from anovos_tpu.ops.drift_kernels import fit_cutoffs
+
+    return fit_cutoffs(
+        tuple(idf_source.columns[c].data for c in num_cols),
+        tuple(idf_source.columns[c].mask for c in num_cols),
+        bin_size,
+        bin_method,
+    )
+
+
+def _union_vocabs_for(idf_source: Table, idf_target: Table, cat_cols: List[str]):
+    """Per-column union vocabulary over both sides (string-keyed, sorted)."""
+    return {
+        c: np.array(
+            sorted(
+                {str(v) for v in idf_source.columns[c].vocab}
+                | {str(v) for v in idf_target.columns[c].vocab}
+            ),
+            dtype=object,
+        )
+        for c in cat_cols
+    }
+
+
+def _lut_for(idf: Table, cat_cols: List[str], union_vocabs: Dict[str, np.ndarray]):
+    """(k, maxv) LUT mapping each column's LOCAL codes to union indices."""
+    if not cat_cols:
+        return jnp.zeros((0, 1), jnp.int32)
+    maxv = max(max(len(idf.columns[c].vocab), 1) for c in cat_cols)
+    luts = np.zeros((len(cat_cols), maxv), np.int32)
+    for j, c in enumerate(cat_cols):
+        pos = {v: i for i, v in enumerate(union_vocabs[c])}
+        for i, v in enumerate(idf.columns[c].vocab):
+            luts[j, i] = pos[str(v)]
+    return jnp.asarray(luts)
+
+
+def _side_args(
+    idf: Table,
+    num_cols: List[str],
+    cat_cols: List[str],
+    cuts_dev,
+    lut,
+    bin_size: int,
+    n_union: int,
+):
+    """The exact ``drift_side_full`` argument tuple ``statistics`` dispatches
+    for one dataset side — shared with ``drift_device_args`` so the
+    steady-state benchmark times the production program, not a copy."""
+    return (
+        tuple(idf.columns[c].data for c in num_cols),
+        tuple(idf.columns[c].mask for c in num_cols),
+        cuts_dev,
+        tuple(idf.columns[c].data for c in cat_cols),
+        tuple(idf.columns[c].mask for c in cat_cols),
+        lut,
+        bin_size,
+        max(n_union, 1),
+    )
+
+
+def drift_device_args(
+    idf_target: Table, idf_source: Table, bin_size: int = 10, bin_method: str = "equal_range"
+):
+    """Argument tuples for ``drift_side_full`` over both sides, prepared with
+    the SAME helpers ``statistics`` uses (``_fit_cutoffs_dev`` /
+    ``_union_vocabs_for`` / ``_lut_for`` / ``_side_args``) — the pure
+    device-resident work of the drift pipeline with host orchestration,
+    model I/O and metric assembly stripped.  Used by the steady-state
+    benchmark (bench.py): the inclusive wall hides ~100× of device headroom
+    under host upload and dispatch, so the kernel claim needs
+    data-already-on-device timing."""
+    num_all, cat_all, _ = idf_target.attribute_type_segregation()
+    num_cols = [c for c in num_all if idf_target.columns[c].kind == "num"]
+    cat_cols = [c for c in cat_all if idf_target.columns[c].kind == "cat"]
+    if num_cols:
+        cuts = _fit_cutoffs_dev(idf_source, num_cols, bin_size, bin_method)
+    else:
+        cuts = jnp.zeros((0, bin_size - 1), jnp.float32)
+    union_vocabs = _union_vocabs_for(idf_source, idf_target, cat_cols)
+    n_union = max((len(union_vocabs[c]) for c in cat_cols), default=1)
+    return (
+        _side_args(idf_target, num_cols, cat_cols, cuts,
+                   _lut_for(idf_target, cat_cols, union_vocabs), bin_size, n_union),
+        _side_args(idf_source, num_cols, cat_cols, cuts,
+                   _lut_for(idf_source, cat_cols, union_vocabs), bin_size, n_union),
+    )

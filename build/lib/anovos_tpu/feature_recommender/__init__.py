@@ -1,0 +1,8 @@
+"""Feature recommender (reference: src/main/anovos/feature_recommender/).
+
+Semantic search over a feature corpus.  The embedding backend prefers
+sentence-transformers (``all-mpnet-base-v2``, the reference's model) when its
+weights are available locally, and falls back to a TF-IDF character+word
+vectorizer — same API, deterministic, zero-download.  Host-side only (not on
+the TPU hot path), matching the reference's driver-side design.
+"""

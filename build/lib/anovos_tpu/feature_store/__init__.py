@@ -1,0 +1,1 @@
+"""Feast feature-store export (reference: src/main/anovos/feature_store/)."""

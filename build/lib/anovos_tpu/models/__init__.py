@@ -1,0 +1,9 @@
+"""JAX-native models trained on TPU.
+
+Replaces the reference's driver-side TensorFlow/sklearn model fits
+(SURVEY.md §2.9): the autoencoder for latent features (the BASELINE.json
+north-star item) trains here as a jitted optax loop over the sharded table —
+no 500k-row sample cap, no pandas_udf inference round-trip.
+"""
+
+from anovos_tpu.models.autoencoder import AutoEncoder  # noqa: F401

@@ -1,0 +1,298 @@
+"""Symmetric autoencoder for latent features — the flagship model.
+
+Architecture mirrors the reference's Keras AE (transformers.py:2793-2819):
+n → 2n → n → bottleneck → n → 2n → n, BatchNorm + LeakyReLU on every hidden
+layer, linear output, Adam on MSE.  Implementation is pure JAX + optax with
+an explicit parameter pytree so the layout can be sharded over a
+(data, model) mesh:
+
+- batch axis rides ``data`` (DP) — gradients psum over ICI automatically;
+- the two widest layers (n→2n and 2n→n) are column/row-sharded over
+  ``model`` (Megatron-style pair: the 2n activation dimension is sharded,
+  the following row-sharded matmul contracts it back with one psum) — the
+  tensor-parallel analogue SURVEY.md §2.10 asks the design to keep open.
+
+Training is a jitted ``lax.scan``-free minibatch loop (one jit per step,
+donated optimizer state) — the whole dataset stays device-resident.
+
+Mixed precision: on TPU the dense matmuls run with bfloat16 inputs and
+float32 accumulation (``preferred_element_type``) — the MXU's native mode —
+while master weights, optimizer state, batch-norm statistics and the loss
+stay float32.  This is the standard recipe for dense nets and is safe here
+(the on-hardware sweep that showed bf16 corrupting *distance/covariance*
+expansions — commit e7e831c — does not apply: those are quadratic
+cancellation-prone forms; an AE layer is a plain affine map).  Control it
+with ``compute_dtype=`` ("bf16" | "f32" | "auto") or ``ANOVOS_AE_COMPUTE``.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from anovos_tpu.shared.runtime import DATA_AXIS, MODEL_AXIS
+
+
+def _dense_init(key, n_in, n_out, dtype=jnp.float32):
+    k1, _ = jax.random.split(key)
+    scale = jnp.sqrt(2.0 / n_in)
+    return {
+        "w": jax.random.normal(k1, (n_in, n_out), dtype) * scale,
+        "b": jnp.zeros((n_out,), dtype),
+    }
+
+
+def _bn_init(n, dtype=jnp.float32):
+    return {
+        "scale": jnp.ones((n,), dtype),
+        "bias": jnp.zeros((n,), dtype),
+        "mean": jnp.zeros((n,), dtype),
+        "var": jnp.ones((n,), dtype),
+    }
+
+
+_LAYERS = ("enc1", "enc2", "bottleneck", "dec1", "dec2", "out")
+
+
+def _resolve_compute_dtype(requested: str):
+    """Precedence: explicit constructor arg > ANOVOS_AE_COMPUTE env > auto
+    (bf16 on TPU — the MXU's native mode — f32 elsewhere)."""
+    req = (requested or "auto").lower()
+    if req == "auto":
+        req = os.environ.get("ANOVOS_AE_COMPUTE", "auto").lower()
+    if req == "auto":
+        req = "bf16" if jax.default_backend() == "tpu" else "f32"
+    return jnp.bfloat16 if req in ("bf16", "bfloat16") else None
+
+
+def _dense(x, layer, compute_dtype):
+    """x @ w + b with optional bf16 inputs / f32 accumulation.
+
+    ``preferred_element_type=float32`` keeps the MXU accumulating in f32 and
+    propagates through the dot's transpose rule, so gradients accumulate in
+    f32 too; the bias add and everything downstream stay f32.
+    """
+    w = layer["w"]
+    if compute_dtype is not None:
+        y = jnp.matmul(
+            x.astype(compute_dtype),
+            w.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        y = x @ w
+    return y + layer["b"]
+
+
+class AutoEncoder:
+    """n → 2n → n → k → n → 2n → n symmetric AE."""
+
+    def __init__(
+        self,
+        n_inputs: int,
+        n_bottleneck: int,
+        seed: int = 0,
+        compute_dtype: str = "auto",
+    ):
+        self.n_inputs = int(n_inputs)
+        self.n_bottleneck = int(n_bottleneck)
+        self.seed = seed
+        self._requested_dtype = compute_dtype
+        self._compute_dtype_cache = ()
+
+    @property
+    def compute_dtype(self):
+        """Resolved lazily so constructing an AE never forces backend init."""
+        if self._compute_dtype_cache == ():
+            self._compute_dtype_cache = _resolve_compute_dtype(self._requested_dtype)
+            # 'auto' silently picks bf16 on TPU, so CPU and TPU runs of the
+            # same config can differ in the last bits — make the choice
+            # visible once per model so that drift is attributable
+            logging.getLogger("anovos_tpu.autoencoder").info(
+                "autoencoder compute dtype resolved to %s (requested=%r, backend=%s)",
+                "bfloat16+f32-accum" if self._compute_dtype_cache is not None else "float32",
+                self._requested_dtype, jax.default_backend(),
+            )
+        return self._compute_dtype_cache
+
+    # -- parameters ------------------------------------------------------
+    def init_params(self) -> Dict:
+        n, k = self.n_inputs, self.n_bottleneck
+        keys = jax.random.split(jax.random.PRNGKey(self.seed), 6)
+        dims = [(n, 2 * n), (2 * n, n), (n, k), (k, n), (n, 2 * n), (2 * n, n)]
+        params = {}
+        for name, key, (i, o) in zip(_LAYERS, keys, dims):
+            params[name] = _dense_init(key, i, o)
+            # BatchNorm on hidden blocks only — the bottleneck and output are
+            # plain linear, matching the reference graph (transformers.py:2798-2806)
+            if name not in ("out", "bottleneck"):
+                params[name]["bn"] = _bn_init(o)
+        return params
+
+    def param_shardings(self, mesh: Mesh) -> Dict:
+        """Megatron-style placement for the widest pair of layers; everything
+        else replicated.  Applied with jax.device_put / jit in_shardings."""
+
+        def spec(name, leaf_path):
+            if name in ("enc1", "dec2"):  # n→2n: shard the 2n output dim
+                if leaf_path == "w":
+                    return P(None, MODEL_AXIS)
+                return P(MODEL_AXIS)  # bias + bn over the sharded dim
+            if name in ("enc2", "out"):  # 2n→n: shard the 2n input dim
+                if leaf_path == "w":
+                    return P(MODEL_AXIS, None)
+                return P()
+            return P()
+
+        shardings = {}
+        for name in _LAYERS:
+            layer = {
+                "w": NamedSharding(mesh, spec(name, "w")),
+                "b": NamedSharding(mesh, spec(name, "b") if name in ("enc1", "dec2") else P()),
+            }
+            if name not in ("out", "bottleneck"):
+                bnspec = P(MODEL_AXIS) if name in ("enc1", "dec2") else P()
+                layer["bn"] = {
+                    k: NamedSharding(mesh, bnspec) for k in ("scale", "bias", "mean", "var")
+                }
+            shardings[name] = layer
+        return shardings
+
+    # -- forward ---------------------------------------------------------
+    def _block(self, x, layer, train: bool, momentum: float = 0.99):
+        """Dense → BatchNorm → LeakyReLU; returns (y, updated_bn)."""
+        h = _dense(x, layer, self.compute_dtype)
+        bn = layer["bn"]
+        if train:
+            mu = h.mean(axis=0)
+            var = h.var(axis=0)
+            new_bn = {
+                "scale": bn["scale"],
+                "bias": bn["bias"],
+                "mean": momentum * bn["mean"] + (1 - momentum) * mu,
+                "var": momentum * bn["var"] + (1 - momentum) * var,
+            }
+        else:
+            mu, var = bn["mean"], bn["var"]
+            new_bn = bn
+        hn = (h - mu) / jnp.sqrt(var + 1e-3) * bn["scale"] + bn["bias"]
+        return jax.nn.leaky_relu(hn, 0.3), new_bn
+
+    def encode(self, params: Dict, x: jax.Array, train: bool = False):
+        """Returns (z, params_with_updated_bn)."""
+        new_params = dict(params)
+        h, bn = self._block(x, params["enc1"], train)
+        new_params["enc1"] = {**params["enc1"], "bn": bn}
+        h, bn = self._block(h, params["enc2"], train)
+        new_params["enc2"] = {**params["enc2"], "bn": bn}
+        z = _dense(h, params["bottleneck"], self.compute_dtype)
+        return z, new_params
+
+    def forward(self, params: Dict, x: jax.Array, train: bool = False):
+        """Full reconstruction; returns (x_hat, params_with_updated_bn)."""
+        z, new_params = self.encode(params, x, train)
+        h, bn = self._block(z, params["dec1"], train)
+        new_params["dec1"] = {**params["dec1"], "bn": bn}
+        h, bn = self._block(h, params["dec2"], train)
+        new_params["dec2"] = {**params["dec2"], "bn": bn}
+        x_hat = _dense(h, params["out"], self.compute_dtype)
+        return x_hat, new_params
+
+    def reconstruct(self, params: Dict, x: jax.Array) -> jax.Array:
+        x_hat, _ = self.forward(params, x, train=False)
+        return x_hat
+
+    def latent(self, params: Dict, x: jax.Array) -> jax.Array:
+        z, _ = self.encode(params, x, train=False)
+        return z
+
+    # -- training --------------------------------------------------------
+    def make_train_step(self, optimizer):
+        def loss_fn(params, batch):
+            x_hat, new_params = self.forward(params, batch, train=True)
+            return jnp.mean((x_hat - batch) ** 2), new_params
+
+        @jax.jit
+        def train_step(params, opt_state, batch):
+            (loss, new_params), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(new_params, updates)
+            return params, opt_state, loss
+
+        return train_step
+
+    def fit(
+        self,
+        X: jax.Array,
+        epochs: int = 100,
+        batch_size: int = 256,
+        learning_rate: float = 1e-3,
+        validation_X: Optional[jax.Array] = None,
+        verbose: bool = False,
+        seed: int = 0,
+    ) -> Dict:
+        """Minibatch Adam training; X must be standardized & imputed."""
+        params = self.init_params()
+        optimizer = optax.adam(learning_rate)
+        opt_state = optimizer.init(params)
+        step = self.make_train_step(optimizer)
+        n = X.shape[0]
+        steps_per_epoch = max(n // batch_size, 1)
+        key = jax.random.PRNGKey(seed)
+        for ep in range(epochs):
+            key, sub = jax.random.split(key)
+            perm = jax.random.permutation(sub, n)
+            loss = None
+            for s in range(steps_per_epoch):
+                idx = jax.lax.dynamic_slice_in_dim(perm, s * batch_size, batch_size)
+                batch = X[idx]
+                params, opt_state, loss = step(params, opt_state, batch)
+            if verbose and (ep % 10 == 0 or ep == epochs - 1):
+                msg = f"epoch {ep}: train mse {float(loss):.5f}"
+                if validation_X is not None:
+                    v = self.reconstruct(params, validation_X)
+                    msg += f" val mse {float(jnp.mean((v - validation_X) ** 2)):.5f}"
+                print(msg)
+        return params
+
+    # -- persistence -----------------------------------------------------
+    def save(self, params: Dict, model_path: str) -> None:
+        d = os.path.join(model_path, "autoencoders_latentFeatures")
+        os.makedirs(d, exist_ok=True)
+        flat = {}
+        for lname, layer in params.items():
+            for k, v in layer.items():
+                if k == "bn":
+                    for bk, bv in v.items():
+                        flat[f"{lname}.bn.{bk}"] = np.asarray(bv)
+                else:
+                    flat[f"{lname}.{k}"] = np.asarray(v)
+        np.savez(
+            os.path.join(d, "model.npz"),
+            n_inputs=self.n_inputs,
+            n_bottleneck=self.n_bottleneck,
+            **flat,
+        )
+
+    @staticmethod
+    def load(model_path: str) -> Tuple["AutoEncoder", Dict]:
+        blob = np.load(os.path.join(model_path, "autoencoders_latentFeatures", "model.npz"))
+        ae = AutoEncoder(int(blob["n_inputs"]), int(blob["n_bottleneck"]))
+        params: Dict = {}
+        for key in blob.files:
+            if key in ("n_inputs", "n_bottleneck"):
+                continue
+            parts = key.split(".")
+            d = params
+            for p in parts[:-1]:
+                d = d.setdefault(p, {})
+            d[parts[-1]] = jnp.asarray(blob[key])
+        return ae, params

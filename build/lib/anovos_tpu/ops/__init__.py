@@ -1,0 +1,18 @@
+"""The kernel library: every XLA computation the framework runs.
+
+Each module is a family of jitted, batched, mask-aware kernels operating on
+``(padded_rows, ncols)`` blocks row-sharded over the mesh's ``data`` axis.
+Cross-shard combination is left to GSPMD — kernels are written as global
+array programs and XLA inserts psum/all_gather over ICI (SURVEY.md §2.10).
+
+- ``reductions``   masked moments: count/sum/mean/var/stddev/skew/kurtosis
+- ``quantiles``    exact sort-based and histogram-sketch quantiles, median
+- ``histogram``    binning (searchsorted), bincount/segment histograms
+- ``segment``      sort-based group-by machinery, mode, distinct counts
+- ``correlation``  Pearson correlation / covariance via MXU matmul
+- ``sampling``     bernoulli + stratified sampling masks
+- ``linalg``       PCA (SVD), standardization
+- ``als``          matrix-factorization imputation (alternating least squares)
+- ``knn``          KNN imputation via tiled pairwise distances (MXU)
+- ``cluster``      KMeans (jitted Lloyd) + DBSCAN via neighbor counts
+"""

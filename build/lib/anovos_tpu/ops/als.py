@@ -1,0 +1,58 @@
+"""Masked alternating least squares (matrix-factorization imputation).
+
+Replaces ``pyspark.ml.recommendation.ALS`` (reference transformers.py:2186-2194,
+maxIter=20, regParam=0.01, rank 10): the (rows × cols) table with missing
+cells IS the ratings matrix, so instead of exploding to (id, attribute,
+value) triples and shuffling, we keep the dense masked matrix on device and
+alternate batched ridge solves — each side is one vmapped Cholesky solve,
+MXU-shaped.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _solve_side(Y: jax.Array, X: jax.Array, M: jax.Array, reg: float) -> jax.Array:
+    """Solve for U given V (or V given U): for each row i,
+    u_i = (Vᵀ diag(m_i) V + λ n_i I)⁻¹ Vᵀ diag(m_i) x_i.
+    Y: (n, k) values; X: (k, r) fixed factor; M: (n, k) mask."""
+    r = X.shape[1]
+    Mf = M.astype(Y.dtype)
+
+    def one(y_i, m_i):
+        Xw = X * m_i[:, None]  # (k, r)
+        A = Xw.T @ X + reg * jnp.maximum(m_i.sum(), 1.0) * jnp.eye(r, dtype=Y.dtype)
+        b = Xw.T @ jnp.where(m_i > 0, y_i, 0.0)
+        return jax.scipy.linalg.solve(A, b, assume_a="pos")
+
+    return jax.vmap(one)(Y, Mf)
+
+
+@functools.partial(jax.jit, static_argnames=("rank", "iters"))
+def als_impute(
+    X: jax.Array, M: jax.Array, rank: int = 10, iters: int = 20, reg: float = 0.01, seed: int = 0
+) -> jax.Array:
+    """Factorize masked X ≈ U Vᵀ and return the completed matrix.
+
+    X: (rows, k); M: (rows, k) bool observed.  Regularization scales with
+    per-row/col observation count (MLlib's ALS-WR λ·n_i convention).
+    """
+    rows, k = X.shape
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    scale = jnp.sqrt(jnp.abs(jnp.where(M, X, 0.0)).mean() / max(rank, 1) + 1e-6)
+    U = jax.random.normal(k1, (rows, rank), X.dtype) * scale
+    V = jax.random.normal(k2, (k, rank), X.dtype) * scale
+
+    def body(_, UV):
+        U, V = UV
+        U = _solve_side(X, V, M, reg)
+        V = _solve_side(X.T, U, M.T, reg)
+        return U, V
+
+    U, V = jax.lax.fori_loop(0, iters, body, (U, V))
+    return U @ V.T

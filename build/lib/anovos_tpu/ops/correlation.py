@@ -1,0 +1,64 @@
+"""Pearson correlation / covariance via MXU matmuls.
+
+Replaces ``pyspark.ml.stat.Correlation.corr`` (association_evaluator.py:122)
+and MLlib ``RowMatrix.computeCovariance`` (association_eval_varclus.py:83).
+Pairwise-complete masked statistics are expressed entirely as X.T @ X-shaped
+products so the whole computation lands on the systolic array; row-sharded
+inputs psum-merge the partial products.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from anovos_tpu.ops.reductions import masked_mean
+
+
+@jax.jit
+def masked_corr(X: jax.Array, M: jax.Array) -> jax.Array:
+    """Pairwise-complete Pearson correlation matrix.
+
+    X: (rows, k); M: (rows, k) bool.  Returns (k, k).
+    For each pair (a,b) all sums run over rows where BOTH are valid — five
+    matmuls total, all MXU-shaped.
+    """
+    dt = jnp.float32
+    Mf = M.astype(dt)
+    Xf = X.astype(dt)
+    # pre-center each column by its global masked mean: pairwise-complete
+    # Pearson r is exactly translation-invariant, and without the shift the
+    # n·Sxy − Sx·Sy cancellation loses most f32 bits for large-offset
+    # low-spread columns (a year column came back with r off by 0.06)
+    Xm = jnp.where(M, Xf - masked_mean(Xf, M)[None, :], 0.0)
+    X2m = Xm * Xm
+    n = Mf.T @ Mf                       # pairwise counts
+    Sx = Xm.T @ Mf                      # Sx[a,b] = Σ x_a over both-valid rows
+    Sxx = X2m.T @ Mf
+    Sxy = Xm.T @ Xm
+    Sy = Sx.T
+    Syy = Sxx.T
+    cov_n = n * Sxy - Sx * Sy
+    var_a = n * Sxx - Sx * Sx
+    var_b = n * Syy - Sy * Sy
+    denom = jnp.sqrt(jnp.maximum(var_a, 0.0) * jnp.maximum(var_b, 0.0))
+    corr = jnp.where(denom > 0, cov_n / jnp.maximum(denom, 1e-30), jnp.nan)
+    k = X.shape[1]
+    return jnp.where(jnp.eye(k, dtype=bool), 1.0, corr)
+
+
+@jax.jit
+def masked_cov(X: jax.Array, M: jax.Array) -> jax.Array:
+    """Pairwise-complete sample covariance matrix (n-1 normalization),
+    matching RowMatrix.computeCovariance on complete data."""
+    dt = jnp.float32
+    Mf = M.astype(dt)
+    Xf = X.astype(dt)
+    # same pre-centering as masked_corr: covariance is translation-invariant
+    # and the Sxy − SxSy/n cancellation is catastrophic at raw magnitudes
+    Xm = jnp.where(M, Xf - masked_mean(Xf, M)[None, :], 0.0)
+    n = Mf.T @ Mf
+    Sx = Xm.T @ Mf
+    Sxy = Xm.T @ Xm
+    mean_prod = Sx * Sx.T / jnp.maximum(n, 1.0)
+    return jnp.where(n > 1, (Sxy - mean_prod) / jnp.maximum(n - 1.0, 1.0), jnp.nan)

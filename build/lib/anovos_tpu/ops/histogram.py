@@ -1,0 +1,69 @@
+"""Histogram / binning kernels.
+
+Replaces (a) the histogrammar JARs the reference ships but routes around
+(SURVEY.md §2.9 — histograms are binning + groupBy in practice), and (b) the
+per-row Python UDF ``bucket_label`` (transformers.py:248-276): binning becomes
+a batched ``searchsorted`` against cutoff matrices, counting becomes a
+one-hot matmul-style reduction that XLA maps onto the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def digitize(X: jax.Array, cutoffs: jax.Array) -> jax.Array:
+    """Assign bin ids per column.
+
+    X: (rows, k); cutoffs: (k, nb+1) ascending per-column bin edges (first/last
+    edge are -inf/+inf-like bounds).  Returns int32 (rows, k) in [0, nb-1]:
+    value ≤ interior edge i → bin i (right-closed, the reference's bucket
+    semantics, transformers.py:248-276).  Dense compare+count — per-element
+    binary search lowers to serialized TPU code (~10× slower measured).
+    """
+    interior = cutoffs[:, 1:-1]  # (k, nb-1)
+    return (X[:, :, None] > interior[None, :, :]).sum(axis=2).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("nbins",))
+def masked_bincount(idx: jax.Array, M: jax.Array, nbins: int) -> jax.Array:
+    """Per-column counts of bin ids.
+
+    idx: (rows, k) int32 in [0, nbins); M: (rows, k) bool.
+    Returns (k, nbins) float32 counts via compare-and-reduce (no scatter,
+    no materialized one-hot), psum-merged across row shards by GSPMD.
+    """
+    lanes = jnp.arange(nbins, dtype=idx.dtype)
+    eq = (idx[:, :, None] == lanes) & M[:, :, None]
+    return eq.sum(axis=0).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("nbins",))
+def masked_label_bincount(
+    idx: jax.Array, M: jax.Array, y: jax.Array, nbins: int
+) -> jax.Array:
+    """Per-column, per-bin event counts: sum of binary label y within each bin.
+
+    idx: (rows, k); M: (rows, k); y: (rows,) float 0/1.
+    Returns (k, nbins).  Used by IV/IG/event-rate charts.
+    """
+    oh = jax.nn.one_hot(idx, nbins, dtype=jnp.float32)
+    w = (M.astype(jnp.float32) * y[:, None])[..., None]
+    return (oh * w).sum(axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("nbins", "method"))
+def equal_range_cutoffs(X: jax.Array, M: jax.Array, nbins: int, method: str = "equal_range"):
+    """Equal-width cutoffs (k, nbins+1) from per-column min/max
+    (reference transformers.py:217-232)."""
+    dt = jnp.float32
+    Xf = X.astype(dt)
+    big = jnp.asarray(jnp.finfo(dt).max, dt)
+    lo = jnp.where(M, Xf, big).min(axis=0)
+    hi = jnp.where(M, Xf, -big).max(axis=0)
+    steps = jnp.linspace(0.0, 1.0, nbins + 1, dtype=dt)  # (nb+1,)
+    return lo[:, None] + steps[None, :] * (hi - lo)[:, None]

@@ -1,0 +1,34 @@
+"""Mode (most frequent value) kernels.
+
+Replaces the reference's per-column ``groupby(col).count().orderBy.limit(1)``
+Spark-job loop (stats_generator.py:386-401): numeric modes come from one
+sort + run-length segment reduction vmapped over the column axis; categorical
+modes from dictionary-code bincounts.  Ties resolve to the smallest value
+(the reference's orderBy desc is nondeterministic on ties; we pin it).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _mode_one(x: jax.Array, m: jax.Array):
+    dt = jnp.float32 if x.dtype not in (jnp.float32, jnp.float64) else x.dtype
+    big = jnp.asarray(jnp.finfo(dt).max, dt)
+    xs = jnp.sort(jnp.where(m, x.astype(dt), big))
+    rows = x.shape[0]
+    n = m.sum()
+    newrun = jnp.concatenate([jnp.ones((1,), bool), xs[1:] != xs[:-1]])
+    runid = jnp.cumsum(newrun) - 1
+    valid = jnp.arange(rows) < n
+    cnt = jax.ops.segment_sum(valid.astype(jnp.int32), runid, num_segments=rows)
+    best = jnp.argmax(cnt)  # ties → first (smallest value)
+    first_idx = jnp.searchsorted(runid, best)
+    return jnp.where(n > 0, xs[first_idx], jnp.nan), cnt[best]
+
+
+@jax.jit
+def masked_mode(X: jax.Array, M: jax.Array):
+    """Per-column (mode_value, mode_count) for a (rows, k) masked block."""
+    return jax.vmap(_mode_one, in_axes=(1, 1), out_axes=0)(X, M)

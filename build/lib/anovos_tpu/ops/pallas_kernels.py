@@ -1,0 +1,200 @@
+"""Pallas TPU kernels for the hot histogram path — **EXPERIMENTAL**.
+
+``binned_histograms_pallas`` fuses binning + counting for the drift/report
+pipeline into a hand-scheduled kernel: the row dimension streams through
+VMEM in tiles (grid), each tile does the compare-count binning and the
+lane-compare histogram entirely on the VPU, and the (k, nbins) accumulator
+lives in the output block across grid steps (initialized on the first step).
+Functionally identical to ops/drift_kernels.binned_histograms.
+
+Status (PERF.md "Pallas status"): the kernels are parity-verified in
+interpret mode (tests/test_pallas_kernels.py) but have NEVER executed
+Mosaic-compiled in this environment — the remote-TPU tunnel's compile
+bridge returns HTTP 500 for Mosaic payloads — so there is no measured
+XLA-vs-Pallas comparison and **no performance claim**.  The XLA versions
+are the production default; ``ANOVOS_USE_PALLAS=1`` opts in and warns.
+``tools/tpu_capture.sh`` attempts one compiled run whenever a tunnel
+window opens; promote these kernels only after that lands a number.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # pallas is part of jax.experimental; guard exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_OK = True
+except ImportError:  # pragma: no cover
+    _PALLAS_OK = False
+
+_TILE_ROWS = 2048
+
+
+def _hist_kernel(x_ref, m_ref, cut_ref, out_ref):
+    """One row tile: bin via compare-count, histogram via lane compare,
+    accumulate into the shared output block."""
+    i = pl.program_id(0)
+    x = x_ref[:]  # (TILE, k)
+    m = m_ref[:]  # (TILE, k) bool (as int8/bool)
+    cuts = cut_ref[:]  # (k, nbins-1)
+    nbins = out_ref.shape[1]
+    # bin id = number of interior cutoffs strictly below the value
+    bins = (x[:, :, None] > cuts[None, :, :]).sum(axis=2).astype(jnp.int32)  # (TILE, k)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (1, 1, nbins), 2)
+    eq = (bins[:, :, None] == lanes) & (m[:, :, None] != 0)
+    tile_counts = eq.sum(axis=0).astype(jnp.float32)  # (k, nbins)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = tile_counts
+
+    @pl.when(i > 0)
+    def _acc():
+        out_ref[:] = out_ref[:] + tile_counts
+
+
+@functools.partial(jax.jit, static_argnames=("nbins", "interpret"))
+def binned_histograms_pallas(
+    X: jax.Array, M: jax.Array, cutoffs: jax.Array, nbins: int, interpret: bool = False
+) -> jax.Array:
+    """Fused bin+count histogram: X/M (rows, k), cutoffs (k, nbins-1) →
+    (k, nbins) float32 counts.  rows are padded to the tile size with
+    mask=False lanes."""
+    if not _PALLAS_OK:  # pragma: no cover
+        from anovos_tpu.ops.drift_kernels import binned_histograms
+
+        return binned_histograms(X, M, cutoffs, nbins)
+    rows, k = X.shape
+    pad = (-rows) % _TILE_ROWS
+    if pad:
+        X = jnp.concatenate([X, jnp.zeros((pad, k), X.dtype)])
+        M = jnp.concatenate([M, jnp.zeros((pad, k), bool)])
+    grid = (X.shape[0] // _TILE_ROWS,)
+    return pl.pallas_call(
+        _hist_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TILE_ROWS, k), lambda i: (i, 0)),
+            pl.BlockSpec((_TILE_ROWS, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, cutoffs.shape[1]), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((k, nbins), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((k, nbins), jnp.float32),
+        interpret=interpret,
+    )(X.astype(jnp.float32), M, cutoffs.astype(jnp.float32))
+
+
+def _moments_kernel(x_ref, m_ref, out_ref):
+    """One row tile → Chan-merge into the running (8, k) accumulator:
+    rows of the accumulator are [n, mean, M2, M3, M4, min, max, nonzero].
+
+    A naive raw-power-sum single pass cancels catastrophically in f32 for
+    columns with large means; per-tile central moments merged pairwise keep
+    the error O(log tiles) — same policy as ops/streaming."""
+    i = pl.program_id(0)
+    x = x_ref[:].astype(jnp.float32)  # (TILE, k)
+    m = m_ref[:] != 0
+    big = jnp.float32(3.4e38)
+    n_t = m.sum(axis=0).astype(jnp.float32)
+    safe = jnp.maximum(n_t, 1.0)
+    mean_t = jnp.where(m, x, 0).sum(axis=0) / safe
+    d = jnp.where(m, x - mean_t, 0)
+    d2 = d * d
+    M2_t = d2.sum(axis=0)
+    M3_t = (d2 * d).sum(axis=0)
+    M4_t = (d2 * d2).sum(axis=0)
+    min_t = jnp.where(m, x, big).min(axis=0)
+    max_t = jnp.where(m, x, -big).max(axis=0)
+    nz_t = (m & (x != 0)).sum(axis=0).astype(jnp.float32)
+    tile = jnp.stack([n_t, mean_t, M2_t, M3_t, M4_t, min_t, max_t, nz_t])
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = tile
+
+    @pl.when(i > 0)
+    def _merge():
+        acc = out_ref[:]
+        na, nb = acc[0], n_t
+        n = na + nb
+        s = jnp.maximum(n, 1.0)
+        delta = mean_t - acc[1]
+        mean = acc[1] + delta * nb / s
+        M2 = acc[2] + M2_t + delta**2 * na * nb / s
+        M3 = (
+            acc[3] + M3_t
+            + delta**3 * na * nb * (na - nb) / (s * s)
+            + 3 * delta * (na * M2_t - nb * acc[2]) / s
+        )
+        M4 = (
+            acc[4] + M4_t
+            + delta**4 * na * nb * (na * na - na * nb + nb * nb) / (s * s * s)
+            + 6 * delta**2 * (na * na * M2_t + nb * nb * acc[2]) / (s * s)
+            + 4 * delta * (na * M3_t - nb * acc[3]) / s
+        )
+        out_ref[:] = jnp.stack(
+            [n, mean, M2, M3, M4,
+             jnp.minimum(acc[5], min_t), jnp.maximum(acc[6], max_t), acc[7] + nz_t]
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def moments_pallas(X: jax.Array, M: jax.Array, interpret: bool = False) -> jax.Array:
+    """Fused single-pass masked moments: X/M (rows, k) → (8, k) float32
+    accumulator [n, mean, M2, M3, M4, min, max, nonzero].  Finalize with
+    ops/reductions.finalize_moments (s1 = n·mean)."""
+    if not _PALLAS_OK:  # pragma: no cover
+        raise RuntimeError("pallas unavailable")
+    rows, k = X.shape
+    pad = (-rows) % _TILE_ROWS
+    if pad:
+        X = jnp.concatenate([X, jnp.zeros((pad, k), X.dtype)])
+        M = jnp.concatenate([M, jnp.zeros((pad, k), bool)])
+    grid = (X.shape[0] // _TILE_ROWS,)
+    return pl.pallas_call(
+        _moments_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TILE_ROWS, k), lambda i: (i, 0)),
+            pl.BlockSpec((_TILE_ROWS, k), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, k), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, k), jnp.float32),
+        interpret=interpret,
+    )(X.astype(jnp.float32), M)
+
+
+_WARNED = False
+
+
+def use_pallas() -> bool:
+    global _WARNED
+    if not (_PALLAS_OK and os.environ.get("ANOVOS_USE_PALLAS", "0") == "1"):
+        return False
+    import warnings
+
+    if jax.default_backend() != "tpu":
+        if not _WARNED:
+            warnings.warn(
+                "ANOVOS_USE_PALLAS=1 ignored: compiled pallas_call is "
+                "TPU-only (CPU supports interpret mode only — used by the "
+                "test suite); falling back to the XLA kernels."
+            )
+            _WARNED = True
+        return False
+    if not _WARNED:
+        warnings.warn(
+            "ANOVOS_USE_PALLAS=1: the Pallas kernels are EXPERIMENTAL — "
+            "interpret-mode parity-tested only, never executed Mosaic-"
+            "compiled in this environment, no measured perf claim (PERF.md "
+            "'Pallas status')."
+        )
+        _WARNED = True
+    return True

@@ -1,0 +1,110 @@
+"""Masked moment reductions — the aggregation heart.
+
+One batched kernel over a ``(padded_rows, k)`` block computes every
+per-column statistic at once, replacing the reference's per-column Spark jobs
+(stats_generator.py:386-401, :485-494) and MLlib ``Statistics.colStats``
+(stats_generator.py:240-241).  Inputs are row-sharded; XLA turns the ``sum``
+reductions into per-shard partials + psum over ICI.
+
+Semantics match Spark:
+- ``stddev``/``variance`` are sample (n-1) — Spark ``summary("stddev")``;
+- ``skewness``/``kurtosis`` are population, kurtosis is *excess*
+  (Spark ``F.skewness``/``F.kurtosis``, stats_generator.py:993-1003);
+- null propagation: stats are over valid (masked) entries only; counts of
+  missing are derived as ``nrows − count`` (stats_generator.py:163-173).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def finalize_moments(n, s1, m2, m3, m4, cmin, cmax, nonzero) -> Dict[str, jax.Array]:
+    """Shared finalizer: globally-reduced power sums → the moments dict.
+    Used by both the GSPMD kernel below and the explicit shard_map variant
+    (parallel/collectives.py) so their statistical policies cannot drift."""
+    safe_n = jnp.maximum(n, 1.0)
+    mean = s1 / safe_n
+    var_samp = m2 / jnp.maximum(n - 1.0, 1.0)
+    std = jnp.sqrt(var_samp)
+    # population central moments for shape stats (Spark F.skewness/F.kurtosis)
+    m2p = m2 / safe_n
+    skew = jnp.where(m2p > 0, (m3 / safe_n) / jnp.power(jnp.maximum(m2p, 1e-38), 1.5), jnp.nan)
+    kurt = jnp.where(m2p > 0, (m4 / safe_n) / jnp.maximum(m2p * m2p, 1e-38) - 3.0, jnp.nan)
+    empty = n == 0
+    nanv = jnp.asarray(jnp.nan, s1.dtype)
+    return {
+        "count": n,
+        "sum": s1,
+        "mean": jnp.where(empty, nanv, mean),
+        "variance": jnp.where(n > 1, var_samp, nanv),
+        "stddev": jnp.where(n > 1, std, nanv),
+        "skewness": jnp.where(empty, nanv, skew),
+        "kurtosis": jnp.where(empty, nanv, kurt),
+        "min": jnp.where(empty, nanv, cmin),
+        "max": jnp.where(empty, nanv, cmax),
+        "nonzero": nonzero,
+    }
+
+
+def masked_moments(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
+    """All central moments per column of a masked block.
+
+    X: (rows, k) numeric; M: (rows, k) bool validity.
+    Returns dict of (k,) arrays: count, sum, mean, variance (sample), stddev,
+    skewness, kurtosis (excess), min, max, nonzero.
+    XLA path: two-pass (global mean psum, then centered power sums).
+    ``ANOVOS_USE_PALLAS=1``: single-pass hand-scheduled tile kernel with
+    Chan merging (ops/pallas_kernels.moments_pallas) — backend choice sits
+    OUTSIDE jit so the env var is honored per call."""
+    from anovos_tpu.ops.pallas_kernels import moments_pallas, use_pallas
+
+    if use_pallas():
+        acc = moments_pallas(X, M)
+        n, mean = acc[0], acc[1]
+        return finalize_moments(n, mean * n, acc[2], acc[3], acc[4], acc[5], acc[6], acc[7])
+    return _masked_moments_xla(X, M)
+
+
+@jax.jit
+def _masked_moments_xla(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
+    dt = X.dtype if X.dtype in (jnp.float32, jnp.float64) else jnp.float32
+    Xf = X.astype(dt)
+    Mf = M.astype(dt)
+    n = Mf.sum(axis=0)
+    s1 = jnp.where(M, Xf, 0).sum(axis=0)
+    mean = s1 / jnp.maximum(n, 1.0)
+    d = jnp.where(M, Xf - mean, 0)
+    d2 = d * d
+    m2 = d2.sum(axis=0)
+    m3 = (d2 * d).sum(axis=0)
+    m4 = (d2 * d2).sum(axis=0)
+    big = jnp.asarray(jnp.finfo(dt).max, dt)
+    cmin = jnp.where(M, Xf, big).min(axis=0)
+    cmax = jnp.where(M, Xf, -big).max(axis=0)
+    nonzero = (M & (Xf != 0)).sum(axis=0).astype(dt)
+    return finalize_moments(n, s1, m2, m3, m4, cmin, cmax, nonzero)
+
+
+@jax.jit
+def masked_count(M: jax.Array) -> jax.Array:
+    """Valid count per column: (rows, k) bool → (k,)."""
+    return M.sum(axis=0)
+
+
+@jax.jit
+def masked_mean(X: jax.Array, M: jax.Array) -> jax.Array:
+    n = jnp.maximum(M.sum(axis=0), 1)
+    return jnp.where(M, X, 0).sum(axis=0) / n
+
+
+@functools.partial(jax.jit, static_argnames=("ddof",))
+def masked_var(X: jax.Array, M: jax.Array, ddof: int = 1) -> jax.Array:
+    n = M.sum(axis=0).astype(X.dtype)
+    mean = jnp.where(M, X, 0).sum(axis=0) / jnp.maximum(n, 1)
+    d = jnp.where(M, X - mean, 0)
+    return (d * d).sum(axis=0) / jnp.maximum(n - ddof, 1)

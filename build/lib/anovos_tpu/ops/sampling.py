@@ -1,0 +1,39 @@
+"""Device-RNG sampling masks.
+
+Replaces Spark ``df.sample`` / ``stat.sampleBy`` (data_sampling.py:8,138-146)
+with counter-based ``jax.random`` Bernoulli draws — deterministic given the
+seed, shard-parallel, no shuffle.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bernoulli_mask(key: jax.Array, n_padded: int, fraction: float) -> jax.Array:  # pragma: no cover - thin
+    return jax.random.uniform(key, (n_padded,)) < fraction
+
+
+def sample_mask(seed: int, n_padded: int, fraction) -> jax.Array:
+    """Row-keep mask for a simple random sample."""
+    key = jax.random.PRNGKey(seed)
+    return jax.random.uniform(key, (n_padded,)) < jnp.asarray(fraction, jnp.float32)
+
+
+def stratified_mask(
+    seed: int, strata_codes: jax.Array, fractions: jax.Array
+) -> jax.Array:
+    """Per-stratum Bernoulli keep mask.
+
+    strata_codes: (rows,) int32 (−1 = null stratum → dropped);
+    fractions: (n_strata,) keep probability per stratum.
+    Mirrors sampleBy's per-key fractions (data_sampling.py:138-146).
+    """
+    key = jax.random.PRNGKey(seed)
+    u = jax.random.uniform(key, strata_codes.shape)
+    f = jnp.where(strata_codes >= 0, fractions[jnp.maximum(strata_codes, 0)], 0.0)
+    return u < f

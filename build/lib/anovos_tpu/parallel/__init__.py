@@ -1,0 +1,12 @@
+"""Mesh construction and sharding helpers (the distributed backend).
+
+The reference's communication stack — py4j control plane, netty shuffle,
+Arrow IPC, broadcast variables (SURVEY.md §2.10) — collapses on TPU into
+compiler-scheduled XLA collectives over ICI plus ``jax.distributed`` process
+groups over DCN.  This package holds the small amount of explicit machinery
+that remains: mesh construction, sharding specs, and shard_map wrappers for
+the few ops that want manual collectives.
+"""
+
+from anovos_tpu.parallel.mesh import make_mesh, data_sharding, replicated_sharding  # noqa: F401
+from anovos_tpu.parallel.collectives import masked_moments_shmap  # noqa: F401

@@ -1,0 +1,45 @@
+"""Mesh + sharding-spec helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from anovos_tpu.shared.runtime import DATA_AXIS, MODEL_AXIS
+
+
+def make_mesh(
+    n_data: Optional[int] = None,
+    n_model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data, model) mesh.  Defaults to all devices on the data axis.
+
+    On real hardware pass a ``jax.experimental.mesh_utils``-style contiguous
+    device order so the data axis rides ICI rings; for the CPU-virtual test
+    mesh order is irrelevant.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        n_data = len(devs) // n_model
+    grid = np.array(devs[: n_data * n_model]).reshape(n_data, n_model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Rows over the data axis, everything else replicated."""
+    return NamedSharding(mesh, P(*((DATA_AXIS,) + (None,) * (ndim - 1))))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def model_sharding(mesh: Mesh, axis: int, ndim: int) -> NamedSharding:
+    """Shard one axis over the model dimension (tensor-parallel layouts)."""
+    spec = [None] * ndim
+    spec[axis] = MODEL_AXIS
+    return NamedSharding(mesh, P(*spec))

@@ -1,0 +1,137 @@
+"""Device-mesh runtime singleton.
+
+The reference builds a process-wide SparkSession at import time
+(shared/spark.py:84-97) and every public function takes it as the first
+argument.  Here the analogue is a :class:`Runtime` holding a
+``jax.sharding.Mesh`` over the local (or distributed) device set, created
+lazily on first use.  Row-sharding of Tables rides the ``"data"`` axis;
+the optional ``"model"`` axis exists so very wide tables / model weights can
+be column-sharded (tensor-parallel analogue — SURVEY.md §2.10).
+
+Unlike Spark there is no RPC control plane: all cross-device communication is
+compiler-scheduled XLA collectives over ICI (psum/all_gather/reduce_scatter),
+and multi-host process groups come from ``jax.distributed.initialize`` over
+DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_RUNTIME: Optional["Runtime"] = None
+
+
+@dataclasses.dataclass
+class Runtime:
+    """Process-wide execution context (the SparkSession analogue)."""
+
+    mesh: Mesh
+    data_axis: str = DATA_AXIS
+    model_axis: str = MODEL_AXIS
+
+    @property
+    def n_data(self) -> int:
+        return self.mesh.shape[self.data_axis]
+
+    @property
+    def n_model(self) -> int:
+        return self.mesh.shape.get(self.model_axis, 1)
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.size
+
+    # -- sharding helpers -------------------------------------------------
+    def row_sharding(self) -> NamedSharding:
+        """Sharding for (rows,) or (rows, cols) arrays: rows over 'data'."""
+        return NamedSharding(self.mesh, P(self.data_axis))
+
+    def row_col_sharding(self, shard_cols: bool = False) -> NamedSharding:
+        spec = P(self.data_axis, self.model_axis if shard_cols else None)
+        return NamedSharding(self.mesh, spec)
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_rows(self, arr) -> jax.Array:
+        """Place a host array on device, row-sharded over the data axis."""
+        spec = P(*((self.data_axis,) + (None,) * (arr.ndim - 1)))
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def pad_rows(self, n: int) -> int:
+        """Rows are padded to a multiple of the data-axis size so every
+        shard has identical (static) shape — XLA requires static shapes.
+
+        On top of that, row counts are bucketed into geometric size classes
+        (2^k and 1.5·2^k — ≤33% padding waste) so tables with nearby row
+        counts share compiled program shapes: every jit is keyed on the
+        padded shape, and on a remote-compile backend each novel shape costs
+        seconds of XLA compile.  Padding rows carry mask=False, so kernels
+        are unaffected.  ANOVOS_SHAPE_BUCKETS=0 disables the bucketing."""
+        m = self.n_data
+        if os.environ.get("ANOVOS_SHAPE_BUCKETS", "1") != "0" and n > 256:
+            b = 256
+            while b < n:
+                if (c := b + b // 2) >= n:  # 1.5·2^k class between doublings
+                    b = c
+                    break
+                b *= 2
+            n = b
+        return ((n + m - 1) // m) * m
+
+
+def init_runtime(
+    devices: Optional[Sequence[jax.Device]] = None,
+    mesh_shape: Optional[tuple] = None,
+    distributed: bool = False,
+) -> Runtime:
+    """Build (or rebuild) the global Runtime.
+
+    ``mesh_shape=(n_data, n_model)``; defaults to all devices on the data
+    axis.  ``distributed=True`` calls ``jax.distributed.initialize()`` first
+    (multi-host over DCN; env-driven coordinator discovery).
+    """
+    global _RUNTIME
+    # TPU MXU's default f32 matmul precision is bf16 inputs — catastrophic
+    # for the quadratic-expansion distance/covariance kernels (squared lat/lon
+    # magnitudes produced within-eps errors ~800x eps^2).  A stats framework
+    # needs true-f32 matmuls; ANOVOS_MATMUL_PRECISION overrides (e.g. to
+    # "default" for throughput-over-accuracy experiments).
+    jax.config.update(
+        "jax_default_matmul_precision", os.environ.get("ANOVOS_MATMUL_PRECISION", "highest")
+    )
+    cache_dir = os.environ.get("ANOVOS_COMPILE_CACHE", "")
+    if cache_dir:
+        # persistent XLA compilation cache: pipeline stages produce many
+        # distinct table shapes, and on remote backends compilation dominates
+        # cold-run wall time
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    if distributed and jax.process_count() == 1 and "JAX_COORDINATOR_ADDRESS" in os.environ:
+        jax.distributed.initialize()
+    devs = list(devices if devices is not None else jax.devices())
+    if mesh_shape is None:
+        mesh_shape = (len(devs), 1)
+    n_data, n_model = mesh_shape
+    if n_data * n_model != len(devs):
+        raise ValueError(f"mesh_shape {mesh_shape} != device count {len(devs)}")
+    dev_grid = np.array(devs).reshape(n_data, n_model)
+    mesh = Mesh(dev_grid, (DATA_AXIS, MODEL_AXIS))
+    _RUNTIME = Runtime(mesh=mesh)
+    return _RUNTIME
+
+
+def get_runtime() -> Runtime:
+    global _RUNTIME
+    if _RUNTIME is None:
+        _RUNTIME = init_runtime()
+    return _RUNTIME

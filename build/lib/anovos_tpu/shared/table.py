@@ -1,0 +1,553 @@
+"""Sharded columnar Table — the Spark-DataFrame replacement.
+
+Design (SURVEY.md §7 "Design center"):
+
+- numeric columns: ``float32``/``int32`` device arrays with an explicit bool
+  validity mask (NaN in the source becomes mask=False);
+- categorical/string columns: host-side dictionary (``vocab``: np.ndarray of
+  strings) + device ``int32`` code arrays — *strings never live on the TPU*;
+  null is code ``-1`` with mask=False;
+- timestamp columns: ``int32`` epoch-seconds + mask (host-side parse);
+- every column has the same padded row count, a multiple of the mesh's data
+  axis, so per-shard shapes are static; ``nrows`` is the true row count and
+  padding rows carry mask=False;
+- layout ``(rows_sharded_over_mesh,)`` per column via NamedSharding; stats
+  kernels stack column groups into (rows, ncols) blocks so one batched XLA
+  reduction covers all columns at once (replacing the reference's per-column
+  Spark job loops, e.g. stats_generator.py:386-401).
+
+The reference's dtype triage (shared/utils.py:48-73: string→cat,
+double/int/bigint/float/long/decimal→num) maps onto ``Column.kind``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from anovos_tpu.shared.runtime import get_runtime
+
+# Spark-style dtype names kept for report parity (global_summary prints them).
+NUM_DTYPES = {"int", "bigint", "float", "double", "long", "decimal", "smallint", "tinyint"}
+CAT_DTYPES = {"string", "boolean"}
+
+
+@dataclasses.dataclass
+class Column:
+    """One column: device data + validity mask (+ host vocab for cat).
+
+    int64 values outside int32 range (id-like columns around 1e9+) keep an
+    EXACT device representation as an (hi, lo) int32 pair alongside the f32
+    approximation in ``data``: ``hi = v >> 32`` and ``lo`` is the low 32 bits
+    bias-shifted by 2^31 so that signed (hi, lo) lexicographic order equals
+    int64 numeric order.  Moment kernels keep using the f32 ``data``;
+    exactness-critical ops (distinct count, mode, percentiles, joins, dedup)
+    consult the pair — TPUs have no native int64, so this is the idiomatic
+    split (round-1 verdict: the silent f32 cast corrupted uniqueCount/IDness
+    on exactly the id columns that need them).
+    """
+
+    kind: str  # "num" | "cat" | "ts"
+    data: jax.Array  # f32/i32 (num), i32 codes (cat), i32 epoch-sec (ts)
+    mask: jax.Array  # bool, True = valid
+    vocab: Optional[np.ndarray] = None  # host strings, cat only
+    dtype_name: str = "double"  # spark-style name for reports
+    wide_hi: Optional[jax.Array] = None  # int32, v >> 32 of the wide key
+    wide_lo: Optional[jax.Array] = None  # int32, (v & 0xffffffff) - 2^31
+    # "int": the wide key IS the int64 value.  "float": the key is the
+    # order-preserving int64 transform of the float64 bit pattern (see
+    # float_order_parts) — attached when a float64 column does not survive
+    # the f32 round-trip, so distinct/mode/percentiles stay exact (the same
+    # failure class as the round-1 id-column bug, but for dense floats like
+    # lat/long whose spacing is below f32 resolution).
+    wide_kind: str = "int"
+
+    @property
+    def padded_len(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def is_wide(self) -> bool:
+        return self.wide_hi is not None
+
+    @property
+    def is_wide_int(self) -> bool:
+        return self.wide_hi is not None and self.wide_kind == "int"
+
+    def astype_float(self, dtype=jnp.float32) -> jax.Array:
+        return self.data.astype(dtype)
+
+    def exact_host(self, nrows: Optional[int] = None) -> np.ndarray:
+        """Host values with exactness preserved (wide pair → int64/float64)."""
+        n = self.data.shape[0] if nrows is None else nrows
+        if self.wide_hi is not None:
+            hi = np.asarray(jax.device_get(self.wide_hi))[:n].astype(np.int64)
+            lo = np.asarray(jax.device_get(self.wide_lo))[:n].astype(np.int64) + (1 << 31)
+            key = (hi << 32) + lo
+            if self.wide_kind == "float":
+                return float_from_order_key(key)
+            return key
+        return np.asarray(jax.device_get(self.data))[:n]
+
+
+def wide_int_parts(v64: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Split int64 → (hi, lo) int32 pair in the sortable encoding."""
+    v64 = v64.astype(np.int64)
+    hi = (v64 >> 32).astype(np.int32)
+    lo = ((v64 & 0xFFFFFFFF) - (1 << 31)).astype(np.int32)
+    return hi, lo
+
+
+def float_order_key(v64: np.ndarray) -> np.ndarray:
+    """float64 → int64 key whose numeric order equals the float order.
+
+    IEEE-754 trick: negative floats flip every bit, non-negatives flip only
+    the sign bit, giving a monotonic unsigned map; re-flipping the top bit
+    recenters it to signed int64.  (-0.0 and +0.0 map to distinct keys —
+    acceptable for distinct-count semantics.)"""
+    b = np.ascontiguousarray(v64, np.float64).view(np.uint64)
+    flip = np.where(b >> np.uint64(63), np.uint64(0xFFFFFFFFFFFFFFFF),
+                    np.uint64(0x8000000000000000))
+    return (b ^ flip ^ np.uint64(0x8000000000000000)).view(np.int64)
+
+
+def float_from_order_key(key: np.ndarray) -> np.ndarray:
+    """Inverse of float_order_key."""
+    u = key.view(np.uint64) ^ np.uint64(0x8000000000000000)
+    flip = np.where(u >> np.uint64(63), np.uint64(0x8000000000000000),
+                    np.uint64(0xFFFFFFFFFFFFFFFF))
+    return (u ^ flip).view(np.float64)
+
+
+def float_order_parts(v64: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """float64 → (hi, lo) int32 pair whose signed lexicographic order equals
+    the float numeric order (same pair encoding as wide_int_parts)."""
+    return wide_int_parts(float_order_key(v64))
+
+
+def _pad_to(arr: np.ndarray, n: int, fill) -> np.ndarray:
+    if arr.shape[0] == n:
+        return arr
+    pad = np.full((n - arr.shape[0],) + arr.shape[1:], fill, dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def _spark_dtype_name(np_dtype) -> str:
+    kind = np.dtype(np_dtype).kind
+    if kind in "iu":
+        return "bigint" if np.dtype(np_dtype).itemsize > 4 else "int"
+    if kind == "f":
+        return "double" if np.dtype(np_dtype).itemsize > 4 else "float"
+    if kind == "b":
+        return "boolean"
+    if kind == "M":
+        return "timestamp"
+    return "string"
+
+
+class Table:
+    """Immutable-ish columnar table; transformation methods return new Tables."""
+
+    def __init__(
+        self,
+        columns: "OrderedDict[str, Column]",
+        nrows: int,
+        valid_rows: Optional[jax.Array] = None,
+    ):
+        self.columns: "OrderedDict[str, Column]" = columns
+        self.nrows = int(nrows)
+        # multi-host tables carry interleaved per-process padding, so row
+        # validity is an explicit device mask instead of arange < nrows
+        self.valid_rows = valid_rows
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_numpy(
+        data: Dict[str, np.ndarray],
+        nrows: Optional[int] = None,
+    ) -> "Table":
+        """Build from host column arrays (object arrays → cat; datetime64 →
+        ts; numeric → num).  NaN/None become nulls."""
+        rt = get_runtime()
+        cols: "OrderedDict[str, Column]" = OrderedDict()
+        if not data:
+            return Table(cols, 0)
+        n = nrows if nrows is not None else len(next(iter(data.values())))
+        npad = rt.pad_rows(max(n, 1))
+        from anovos_tpu.shared.native import NativeEncodedStrings
+
+        for name, arr in data.items():
+            if not isinstance(arr, NativeEncodedStrings):
+                arr = np.asarray(arr)
+            cols[name] = _host_to_column(arr, n, npad, rt)
+        return Table(cols, n)
+
+    @staticmethod
+    def from_pandas(df) -> "Table":
+        data = {}
+        for name in df.columns:
+            s = df[name]
+            if s.dtype == object or str(s.dtype) in ("string", "category"):
+                data[name] = s.to_numpy(dtype=object)
+            else:
+                data[name] = s.to_numpy()
+        return Table.from_numpy(data, nrows=len(df))
+
+    # ------------------------------------------------------------------
+    # basic introspection (the reference's utils.attributeType_segregation)
+    # ------------------------------------------------------------------
+    @property
+    def ncols(self) -> int:
+        return len(self.columns)
+
+    @property
+    def col_names(self) -> List[str]:
+        return list(self.columns.keys())
+
+    @property
+    def padded_rows(self) -> int:
+        if not self.columns:
+            return 0
+        return next(iter(self.columns.values())).padded_len
+
+    def pad_target(self) -> int:
+        """Padded length a NEW column of this table must have.  Always the
+        table's existing padded length when it has columns — a fresh
+        ``pad_rows(nrows)`` would diverge on multi-host tables (interleaved
+        per-process padding) and whenever the bucketing policy changed
+        between table creation and column addition."""
+        if self.columns:
+            return self.padded_rows
+        return get_runtime().pad_rows(max(self.nrows, 1))
+
+    def dtypes(self) -> List[Tuple[str, str]]:
+        return [(k, c.dtype_name) for k, c in self.columns.items()]
+
+    def attribute_type_segregation(self) -> Tuple[List[str], List[str], List[str]]:
+        """num_cols, cat_cols, other_cols (reference shared/utils.py:48-73)."""
+        num, cat, other = [], [], []
+        for k, c in self.columns.items():
+            if c.kind == "num":
+                num.append(k)
+            elif c.kind == "cat":
+                cat.append(k)
+            else:
+                other.append(k)
+        return num, cat, other
+
+    # ------------------------------------------------------------------
+    # column ops (reference data_ingest.py:201-367)
+    # ------------------------------------------------------------------
+    def select(self, names: Sequence[str]) -> "Table":
+        missing = [n for n in names if n not in self.columns]
+        if missing:
+            raise KeyError(f"columns not in table: {missing}")
+        # column ops keep the row layout → valid_rows must survive (multi-
+        # host tables would otherwise silently revert to arange < nrows)
+        return Table(
+            OrderedDict((n, self.columns[n]) for n in names), self.nrows, self.valid_rows
+        )
+
+    def drop(self, names: Sequence[str]) -> "Table":
+        names = set(names)
+        return Table(
+            OrderedDict((n, c) for n, c in self.columns.items() if n not in names),
+            self.nrows,
+            self.valid_rows,
+        )
+
+    def rename(self, mapping: Dict[str, str]) -> "Table":
+        return Table(
+            OrderedDict((mapping.get(n, n), c) for n, c in self.columns.items()),
+            self.nrows,
+            self.valid_rows,
+        )
+
+    def with_column(self, name: str, col: Column) -> "Table":
+        cols = OrderedDict(self.columns)
+        cols[name] = col
+        return Table(cols, self.nrows, self.valid_rows)
+
+    def __getitem__(self, name: str) -> Column:
+        return self.columns[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.columns
+
+    # ------------------------------------------------------------------
+    # device block extraction for batched kernels
+    # ------------------------------------------------------------------
+    def numeric_block(
+        self, names: Sequence[str], dtype=jnp.float32, shard_cols: bool = False
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Stack numeric columns into (padded_rows, k) X and bool mask M,
+        row-sharded.  This is the input shape for every batched stats kernel.
+        Cast+stack runs as ONE jitted program — per-column eager casts would
+        cost one device dispatch each (expensive on remote backends).
+
+        ``shard_cols=True`` additionally shards the column axis over the
+        mesh's model axis — the wide-table analogue of tensor parallelism
+        (SURVEY §2.10): per-column stats kernels reduce over rows only, so a
+        frame whose (rows × cols) block exceeds one chip's HBM splits across
+        the whole mesh with no kernel changes (GSPMD inserts the layout)."""
+        datas = tuple(self.columns[n].data for n in names)
+        masks = tuple(self.columns[n].mask for n in names)
+        X, M = _stack_cast(datas, masks, dtype)
+        if shard_cols:
+            from anovos_tpu.shared.runtime import DATA_AXIS, MODEL_AXIS
+
+            rt = get_runtime()
+            if rt.mesh is not None and len(names) >= rt.mesh.shape.get(MODEL_AXIS, 1) > 1:
+                sh = NamedSharding(rt.mesh, P(DATA_AXIS, MODEL_AXIS))
+                X = jax.device_put(X, sh)
+                M = jax.device_put(M, sh)
+        return X, M
+
+    def row_mask(self) -> jax.Array:
+        """Validity of the *row* (excludes padding rows).  Multi-host tables
+        carry interleaved per-process padding → explicit mask."""
+        if self.valid_rows is not None:
+            return self.valid_rows
+        return jnp.arange(self.padded_rows) < self.nrows
+
+    # ------------------------------------------------------------------
+    # row movement (gather/filter) — the shuffle replacement
+    # ------------------------------------------------------------------
+    def gather_rows(self, idx: np.ndarray, valid: Optional[np.ndarray] = None) -> "Table":
+        """New Table whose row r is this table's row ``idx[r]``.
+
+        ``idx`` is a host int array (−1 or ``valid[r]==False`` → null row —
+        used for outer joins).  All columns move in ONE jitted program and the
+        result is blocked on before returning: a cross-shard gather lowers to
+        an all-gather, and two *independent* collective programs in flight at
+        once can interleave their rendezvous on hosts with fewer worker
+        threads than devices (observed deadlock on the 8-virtual-device CPU
+        mesh) — single program + block makes the dispatch race-free.
+        """
+        rt = get_runtime()
+        idx = np.asarray(idx)
+        n = len(idx)
+        npad = rt.pad_rows(max(n, 1))
+        if valid is None:
+            valid = idx >= 0
+        live = idx[np.asarray(valid, bool)]
+        if live.size and (live.min() < 0 or live.max() >= self.nrows):
+            raise IndexError(
+                f"gather_rows: index out of range [0, {self.nrows}) "
+                f"(min={live.min()}, max={live.max()})"
+            )
+        idx_p = _pad_to(np.where(valid, idx, 0).astype(np.int32), npad, 0)
+        val_p = _pad_to(np.asarray(valid, bool), npad, False)
+        idx_d = rt.shard_rows(idx_p)
+        val_d = rt.shard_rows(val_p)
+        names = self.col_names
+        datas: List[jax.Array] = []
+        for c in names:
+            col = self.columns[c]
+            datas.append(col.data)
+            if col.wide_hi is not None:
+                datas.append(col.wide_hi)
+                datas.append(col.wide_lo)
+        masks = tuple(self.columns[c].mask for c in names)
+        gd, gm = _gather_program(tuple(datas), masks, idx_d, val_d)
+        jax.block_until_ready((gd, gm))
+        cols: "OrderedDict[str, Column]" = OrderedDict()
+        j = 0
+        for i, name in enumerate(names):
+            c = self.columns[name]
+            whi = wlo = None
+            data = gd[j]
+            j += 1
+            if c.wide_hi is not None:
+                whi, wlo = gd[j], gd[j + 1]
+                j += 2
+            cols[name] = Column(
+                c.kind, data, gm[i], vocab=c.vocab, dtype_name=c.dtype_name,
+                wide_hi=whi, wide_lo=wlo, wide_kind=c.wide_kind,
+            )
+        return Table(cols, n)
+
+    def filter_rows(self, keep: np.ndarray) -> "Table":
+        """Compact to rows where host bool ``keep`` is True (stage-boundary
+        host compaction — the 'mask-don't-shrink' escape hatch).  ``keep``
+        must cover all rows (length nrows or padded_rows)."""
+        keep = np.asarray(keep)
+        if len(keep) not in (self.nrows, self.padded_rows):
+            raise ValueError(
+                f"filter_rows: keep has length {len(keep)}, expected "
+                f"{self.nrows} (nrows) or {self.padded_rows} (padded_rows)"
+            )
+        idx = np.nonzero(keep[: self.nrows])[0]
+        return self.gather_rows(idx)
+
+    # ------------------------------------------------------------------
+    # host materialization
+    # ------------------------------------------------------------------
+    def to_pandas(self):
+
+        out = {}
+        n = self.nrows
+        for name, c in self.columns.items():
+            data = np.asarray(jax.device_get(c.data))[:n]
+            mask = np.asarray(jax.device_get(c.mask))[:n]
+            if c.kind == "cat":
+                vals = np.empty(n, dtype=object)
+                valid = mask & (data >= 0)
+                vals[valid] = c.vocab[data[valid]]
+                vals[~valid] = None
+                out[name] = vals
+            elif c.kind == "ts":
+                vals = data.astype("int64") * np.int64(1_000_000_000)
+                ts = vals.view("datetime64[ns]").copy()
+                s = pd.Series(ts)
+                s[~mask] = pd.NaT
+                out[name] = s
+            elif c.wide_hi is not None:
+                vals = c.exact_host(n)  # exact int64 / float64
+                if c.wide_kind == "float":
+                    vals = vals.copy()
+                    vals[~mask] = np.nan
+                    out[name] = vals
+                elif mask.all():
+                    out[name] = vals
+                else:  # nullable after outer joins: pandas Int64 keeps exactness
+                    out[name] = pd.arrays.IntegerArray(vals, ~mask)
+            else:
+                if np.issubdtype(data.dtype, np.integer) and mask.all():
+                    out[name] = data
+                else:
+                    vals = data.astype("float64")
+                    vals[~mask] = np.nan
+                    out[name] = vals
+        return pd.DataFrame(out, columns=list(self.columns.keys()))
+
+    def head(self, k: int = 5):
+        return self.to_pandas().head(k)
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{n}:{c.kind}" for n, c in self.columns.items())
+        return f"Table[{self.nrows} rows]({cols})"
+
+
+@functools.partial(jax.jit, static_argnames=("dtype",))
+def _stack_cast(datas, masks, dtype):
+    X = jnp.stack([d.astype(dtype) for d in datas], axis=1)
+    M = jnp.stack(masks, axis=1)
+    return X, M
+
+
+@jax.jit
+def _gather_program(datas, masks, idx, valid):
+    gd = tuple(jnp.take(a, idx, axis=0) for a in datas)
+    gm = tuple(jnp.take(m, idx, axis=0) & valid for m in masks)
+    return gd, gm
+
+
+def _host_to_column(arr: np.ndarray, n: int, npad: int, rt) -> Column:
+    """Convert one host array to a device Column (pad + shard)."""
+    from anovos_tpu.shared.native import NativeEncodedStrings
+
+    if isinstance(arr, NativeEncodedStrings):
+        # already dictionary-encoded by the native decoder (codes + vocab,
+        # strings never became Python objects)
+        code_arr = arr.codes[:n]
+        data = rt.shard_rows(_pad_to(code_arr, npad, -1))
+        mask = rt.shard_rows(_pad_to(code_arr >= 0, npad, False))
+        return Column("cat", data, mask, vocab=arr.vocab, dtype_name="string")
+    if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+        # categorical: dictionary-encode on host, codes on device
+        vals = arr[:n]
+        isnull = pd.isna(vals)
+        nn_strs = np.array([str(v) for v in vals[~isnull]], dtype=object)
+        vocab, codes = np.unique(nn_strs, return_inverse=True)
+        code_arr = np.full(n, -1, dtype=np.int32)
+        code_arr[~isnull] = codes.astype(np.int32)
+        data = rt.shard_rows(_pad_to(code_arr, npad, -1))
+        mask = rt.shard_rows(_pad_to(~isnull, npad, False))
+        return Column("cat", data, mask, vocab=vocab.astype(object), dtype_name="string")
+    if arr.dtype.kind == "M":
+        # timestamps → epoch seconds int32
+        vals = arr[:n].astype("datetime64[s]")
+        isnull = np.isnat(vals)
+        secs = vals.astype("int64")
+        secs = np.where(isnull, 0, secs).astype(np.int32)
+        data = rt.shard_rows(_pad_to(secs, npad, 0))
+        mask = rt.shard_rows(_pad_to(~isnull, npad, False))
+        return Column("ts", data, mask, dtype_name="timestamp")
+    if arr.dtype.kind == "b":
+        vals = arr[:n].astype(np.int32)
+        data = rt.shard_rows(_pad_to(vals, npad, 0))
+        mask = rt.shard_rows(_pad_to(np.ones(n, bool), npad, False))
+        return Column("num", data, mask, dtype_name="boolean")
+    # numeric
+    dtn = _spark_dtype_name(arr.dtype)
+    vals = arr[:n]
+    if vals.dtype.kind == "f":
+        isnull = np.isnan(vals)
+        host = np.where(isnull, 0.0, vals).astype(np.float32)
+        fill = np.float32(0)
+        if vals.dtype.itemsize > 4:
+            v64 = np.where(isnull, 0.0, vals).astype(np.float64)
+            if not np.array_equal(host.astype(np.float64), v64):
+                # values don't survive the f32 round-trip: keep the exact
+                # order-preserving (hi, lo) pair for distinct/mode/percentiles
+                whi, wlo = float_order_parts(v64)
+                mask = rt.shard_rows(_pad_to(~isnull, npad, False))
+                return Column(
+                    "num",
+                    rt.shard_rows(_pad_to(host, npad, fill)),
+                    mask,
+                    dtype_name=dtn,
+                    wide_hi=rt.shard_rows(_pad_to(whi, npad, np.int32(0))),
+                    wide_lo=rt.shard_rows(_pad_to(wlo, npad, np.int32(-(1 << 31)))),
+                    wide_kind="float",
+                )
+    else:
+        isnull = np.zeros(n, dtype=bool)
+        if vals.dtype.itemsize > 4:
+            lo, hi = vals.min(initial=0), vals.max(initial=0)
+            if lo >= np.iinfo(np.int32).min and hi <= np.iinfo(np.int32).max:
+                host = vals.astype(np.int32)
+            else:
+                # wide int64: f32 approximation for moment kernels + exact
+                # (hi, lo) int32 pair for distinct/mode/percentiles/joins
+                whi, wlo = wide_int_parts(vals)
+                mask = rt.shard_rows(_pad_to(np.ones(n, bool), npad, False))
+                return Column(
+                    "num",
+                    rt.shard_rows(_pad_to(vals.astype(np.float32), npad, np.float32(0))),
+                    mask,
+                    dtype_name="bigint",
+                    wide_hi=rt.shard_rows(_pad_to(whi, npad, np.int32(0))),
+                    wide_lo=rt.shard_rows(_pad_to(wlo, npad, np.int32(-(1 << 31)))),
+                )
+        else:
+            host = vals.astype(np.int32) if vals.dtype.kind in "iu" else vals.astype(np.float32)
+        fill = host.dtype.type(0)
+    data = rt.shard_rows(_pad_to(host, npad, fill))
+    mask = rt.shard_rows(_pad_to(~isnull, npad, False))
+    return Column("num", data, mask, dtype_name=dtn)
+
+
+def make_column_from_device(
+    kind: str,
+    data: jax.Array,
+    mask: jax.Array,
+    vocab: Optional[np.ndarray] = None,
+    dtype_name: Optional[str] = None,
+) -> Column:
+    if dtype_name is None:
+        dtype_name = {"num": "double", "cat": "string", "ts": "timestamp"}[kind]
+        if kind == "num" and data.dtype in (jnp.int32, jnp.int16, jnp.int8):
+            dtype_name = "int"
+    return Column(kind, data, mask, vocab=vocab, dtype_name=dtype_name)

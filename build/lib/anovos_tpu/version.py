@@ -1,0 +1,3 @@
+"""Package version (reference: src/main/anovos/version.py:1)."""
+
+__version__ = "0.1.0"

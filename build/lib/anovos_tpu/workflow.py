@@ -1,0 +1,460 @@
+"""Config-driven pipeline runner (reference: src/main/anovos/workflow.py).
+
+Same YAML schema, same reflection dispatch — top-level keys are module
+blocks, nested keys are function names resolved by ``getattr`` (ref ETL
+:45-61, stats :495, quality :528, transformers :745).  ``stats_args``
+(ref :91-145) injects previously-saved stats CSVs into downstream functions;
+``save(..., reread=True)`` (ref :64-88) checkpoints intermediates.  The
+``run_type`` axis routes through the pluggable artifact store
+(``shared/artifact_store.py``): local/databricks are path mappings,
+emr/ak8s stage locally and shell out to aws/azcopy like the reference;
+mlflow hooks activate when the package is importable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import copy
+import logging
+import os
+import timeit
+from typing import Optional
+
+import pandas as pd
+import yaml
+
+from anovos_tpu.data_ingest import data_ingest
+from anovos_tpu.data_ingest.ts_auto_detection import ts_preprocess
+from anovos_tpu.data_analyzer import association_evaluator, quality_checker, stats_generator
+from anovos_tpu.data_report.basic_report_generation import anovos_basic_report
+from anovos_tpu.data_report.report_generation import anovos_report
+from anovos_tpu.data_report.report_preprocessing import charts_to_objects, save_stats
+from anovos_tpu.data_transformer import transformers
+from anovos_tpu.drift_stability import drift_detector as ddetector
+from anovos_tpu.drift_stability import stability as dstability
+from anovos_tpu.shared.table import Table
+
+logger = logging.getLogger("anovos_tpu.workflow")
+logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
+
+
+def ETL(args: dict) -> Table:
+    """read_dataset + chained column ops by reflection (reference :45-61)."""
+    read_args = args.get("read_dataset", None)
+    if not read_args:
+        raise TypeError("Invalid input for reading dataset")
+    df = data_ingest.read_dataset(**read_args)
+    for key, value in args.items():
+        if key != "read_dataset" and value is not None:
+            f = getattr(data_ingest, key)
+            df = f(df, **value) if isinstance(value, dict) else f(df, value)
+    return df
+
+
+def save(data, write_configs: Optional[dict], folder_name: str, reread: bool = False):
+    """Checkpoint a Table (or stats frame) under the write config's path
+    (reference :64-88).  reread loads it back, cutting any lineage."""
+    if not write_configs:
+        return data
+    if "file_path" not in write_configs:
+        raise TypeError("file path missing for writing data")
+    write = copy.deepcopy(write_configs)
+    write.pop("mlflow_run_id", "")
+    write.pop("log_mlflow", False)
+    write["file_path"] = os.path.join(write["file_path"], folder_name)
+    if isinstance(data, pd.DataFrame):
+        from anovos_tpu.shared.table import Table as _T
+
+        data_t = _T.from_pandas(data)
+        data_ingest.write_dataset(data_t, **write)
+        if reread:
+            return data_ingest.read_dataset(
+                write["file_path"], write.get("file_type", "csv"),
+                _clean_read_cfg(write.get("file_configs")),
+            ).to_pandas()
+        return data
+    data_ingest.write_dataset(data, **write)
+    if reread:
+        return data_ingest.read_dataset(
+            write["file_path"], write.get("file_type", "csv"), _clean_read_cfg(write.get("file_configs"))
+        )
+    return data
+
+
+def _clean_read_cfg(cfg):
+    cfg = copy.deepcopy(cfg) if cfg else {}
+    cfg.pop("repartition", None)
+    cfg.pop("mode", None)
+    return cfg
+
+
+def stats_args(
+    all_configs: dict, func: str, run_type: str = "local", auth_key: str = "NA"
+) -> dict:
+    """Wire cached stats CSVs into downstream kwargs (reference :91-145).
+
+    The configured ``master_path`` may be remote (s3://, wasbs://) on
+    emr/ak8s, but the consumers read with the local reader — so the path is
+    resolved through the run_type store's staging dir, which is exactly
+    where ``save_stats`` just wrote the same CSV."""
+    stats_configs = all_configs.get("stats_generator", None)
+    write_configs = all_configs.get("write_stats", None)
+    report_configs = all_configs.get("report_preprocessing", None)
+    report_input_path = ""
+    if report_configs is not None:
+        if "master_path" not in report_configs:
+            raise TypeError("Master path missing for saving report statistics")
+        report_input_path = report_configs.get("master_path")
+    result = {}
+    if not stats_configs:
+        return result
+    mainfunc_to_args = {
+        "biasedness_detection": ["stats_mode"],
+        "IDness_detection": ["stats_unique"],
+        "nullColumns_detection": ["stats_unique", "stats_mode", "stats_missing"],
+        "variable_clustering": ["stats_mode"],
+        "charts_to_objects": ["stats_unique"],
+        "cat_to_num_unsupervised": ["stats_unique"],
+        "PCA_latentFeatures": ["stats_missing"],
+        "autoencoder_latentFeatures": ["stats_missing"],
+    }
+    args_to_statsfunc = {
+        "stats_unique": "measures_of_cardinality",
+        "stats_mode": "measures_of_centralTendency",
+        "stats_missing": "measures_of_counts",
+    }
+    if report_input_path:
+        from anovos_tpu.shared.artifact_store import for_run_type
+
+        store = for_run_type(run_type, auth_key)
+        configured = report_input_path
+        report_input_path = store.staging_dir(report_input_path)
+        # split-job runs (stats produced by an EARLIER job on another
+        # cluster) find an empty staging dir — pull the remote contents
+        # down before handing consumers a local path
+        if report_input_path != configured and not (
+            os.path.isdir(report_input_path) and os.listdir(report_input_path)
+        ):
+            try:
+                report_input_path = store.pull_dir(configured, report_input_path)
+            except Exception as e:  # nothing remote yet: same-process flow
+                logger.warning("stats pull from %s failed (%s); using staging", configured, e)
+    for arg in mainfunc_to_args.get(func, []):
+        if report_input_path:
+            result[arg] = {
+                "file_path": os.path.join(report_input_path, args_to_statsfunc[arg] + ".csv"),
+                "file_type": "csv",
+                "file_configs": {"header": True, "inferSchema": True},
+            }
+        elif write_configs:
+            read = copy.deepcopy(write_configs)
+            read["file_configs"] = _clean_read_cfg(read.get("file_configs"))
+            read["file_path"] = os.path.join(
+                read["file_path"], "data_analyzer/stats_generator", args_to_statsfunc[arg]
+            )
+            result[arg] = read
+    return result
+
+
+def _auth_key(auth_key_val: dict) -> str:
+    """The SAS token is the last value of the auth dict (reference :148-157
+    sets each pair on the spark conf and keeps the last value as auth_key)."""
+    return list(auth_key_val.values())[-1] if auth_key_val else "NA"
+
+
+def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) -> None:
+    start_main = timeit.default_timer()
+    auth_key = _auth_key(auth_key_val)
+    df = ETL(all_configs.get("input_dataset"))
+
+    write_main = all_configs.get("write_main", None)
+    write_intermediate = all_configs.get("write_intermediate", None)
+    write_stats = all_configs.get("write_stats", None)
+
+    mlflow_config = all_configs.get("mlflow", None)
+    mlflow_ctx = contextlib.nullcontext()
+    if mlflow_config is not None:
+        try:  # pragma: no cover - optional dependency
+            import mlflow
+
+            mlflow.set_tracking_uri(mlflow_config["tracking_uri"])
+            mlflow.set_experiment(mlflow_config["experiment"])
+            mlflow_ctx = mlflow.start_run()
+        except ImportError:
+            logger.warning("mlflow configured but not installed; skipping tracking")
+            mlflow_config = None
+
+    report_input_path = ""
+    report_configs = all_configs.get("report_preprocessing", None)
+    if report_configs is not None:
+        if "master_path" not in report_configs:
+            raise TypeError("Master path missing for saving report statistics")
+        report_input_path = report_configs.get("master_path")
+
+    basic_report_flag = all_configs.get("anovos_basic_report", {}) or {}
+    basic_report_flag = basic_report_flag.get("basic_report", False)
+
+    with mlflow_ctx:
+        for key, args in all_configs.items():
+            if key == "concatenate_dataset" and args is not None:
+                start = timeit.default_timer()
+                idfs = [df] + [ETL(args[k]) for k in args if k not in ("method", "method_type")]
+                df = data_ingest.concatenate_dataset(
+                    *idfs, method_type=args.get("method", args.get("method_type", "name"))
+                )
+                df = save(df, write_intermediate, "data_ingest/concatenate_dataset", reread=True)
+                logger.info(f"{key}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}")
+                continue
+
+            if key == "join_dataset" and args is not None:
+                start = timeit.default_timer()
+                idfs = [df] + [ETL(args[k]) for k in args if k not in ("join_type", "join_cols")]
+                df = data_ingest.join_dataset(
+                    *idfs, join_cols=args.get("join_cols"), join_type=args.get("join_type")
+                )
+                df = save(df, write_intermediate, "data_ingest/join_dataset", reread=True)
+                logger.info(f"{key}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}")
+                continue
+
+            if key == "timeseries_analyzer" and args is not None:
+                start = timeit.default_timer()
+                # omit None-valued config keys so callee defaults apply
+                opt = {k: v for k, v in args.items() if v is not None}
+                # auto-detection is best-effort in the reference too
+                # (ts_auto_detection.py:707 swallows per-column failures):
+                # a malformed timestamp column must not kill the pipeline,
+                # and a detection failure must not also cost the inspection
+                try:
+                    if opt.get("auto_detection", False):
+                        df = ts_preprocess(
+                            df, opt.get("id_col"), output_path=report_input_path or ".",
+                            tz_offset=opt.get("tz_offset", "local"), run_type=run_type,
+                        )
+                except Exception:
+                    logger.exception("ts auto-detection failed; continuing with the raw table")
+                try:
+                    if opt.get("inspection", False):
+                        from anovos_tpu.data_analyzer.ts_analyzer import ts_analyzer
+
+                        kw = {
+                            k: opt[k]
+                            for k in ("max_days", "tz_offset")
+                            if k in opt
+                        }
+                        if "analysis_level" in opt:
+                            kw["output_type"] = opt["analysis_level"]
+                        ts_analyzer(
+                            df, opt.get("id_col"), output_path=report_input_path or ".",
+                            run_type=run_type, **kw,
+                        )
+                except Exception:
+                    logger.exception("ts inspection failed; continuing without ts analysis")
+                logger.info(f"{key}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}")
+                continue
+
+            if key == "geospatial_controller" and args is not None:
+                ga = args.get("geospatial_analyzer", {}) or {}
+                if ga.get("auto_detection_analyzer", False):
+                    start = timeit.default_timer()
+                    from anovos_tpu.data_analyzer.geospatial_analyzer import geospatial_autodetection
+
+                    kw = {
+                        k: ga[k]
+                        for k in (
+                            "max_analysis_records", "top_geo_records", "max_cluster",
+                            "eps", "min_samples", "global_map_box_val",
+                        )
+                        if ga.get(k) is not None
+                    }
+                    try:
+                        geospatial_autodetection(
+                            df, ga.get("id_col"), report_input_path or ".", run_type=run_type, **kw
+                        )
+                    except Exception:
+                        logger.exception("geospatial_analyzer failed; continuing without geo analysis")
+                    logger.info(
+                        f"{key}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
+                    )
+                continue
+
+            if key == "anovos_basic_report" and args is not None and args.get("basic_report", False):
+                start = timeit.default_timer()
+                anovos_basic_report(df, **args.get("report_args", {}), run_type=run_type, auth_key=auth_key)
+                logger.info(f"Basic Report: execution time (in secs) = {round(timeit.default_timer() - start, 4)}")
+                continue
+
+            if basic_report_flag:
+                continue
+
+            if key == "stats_generator" and args is not None:
+                for m in args["metric"]:
+                    start = timeit.default_timer()
+                    df_stats = getattr(stats_generator, m)(df, **args["metric_args"])
+                    if report_input_path:
+                        save_stats(df_stats, report_input_path, m, reread=True, run_type=run_type, auth_key=auth_key)
+                    else:
+                        save(df_stats, write_stats, "data_analyzer/stats_generator/" + m, reread=True)
+                    logger.info(f"{key}, {m}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}")
+
+            if key == "quality_checker" and args is not None:
+                for subkey, value in args.items():
+                    if value is None:
+                        continue
+                    start = timeit.default_timer()
+                    extra_args = stats_args(all_configs, subkey, run_type, auth_key)
+                    if subkey == "nullColumns_detection":
+                        # upstream treatments invalidate cached missing stats (ref :552-566)
+                        if (args.get("invalidEntries_detection") or {}).get("treatment"):
+                            extra_args["stats_missing"] = {}
+                        if (args.get("outlier_detection") or {}).get("treatment") and (
+                            args.get("outlier_detection") or {}
+                        ).get("treatment_method") == "null_replacement":
+                            extra_args["stats_missing"] = {}
+                    df, df_stats = getattr(quality_checker, subkey)(df, **value, **extra_args)
+                    df = save(
+                        df, write_intermediate,
+                        "data_analyzer/quality_checker/" + subkey + "/dataset", reread=True,
+                    )
+                    if report_input_path:
+                        save_stats(df_stats, report_input_path, subkey, reread=True, run_type=run_type, auth_key=auth_key)
+                    else:
+                        save(df_stats, write_stats, "data_analyzer/quality_checker/" + subkey, reread=True)
+                    logger.info(
+                        f"{key}, {subkey}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
+                    )
+
+            if key == "association_evaluator" and args is not None:
+                for subkey, value in args.items():
+                    if value is None:
+                        continue
+                    start = timeit.default_timer()
+                    extra_args = stats_args(all_configs, subkey, run_type, auth_key)
+                    if subkey == "correlation_matrix":
+                        cat_params = all_configs.get("cat_to_num_transformer", None)
+                        df_in = (
+                            transformers.cat_to_num_transformer(df, **cat_params) if cat_params else df
+                        )
+                    else:
+                        df_in = df
+                    df_stats = getattr(association_evaluator, subkey)(df_in, **value, **extra_args)
+                    if report_input_path:
+                        save_stats(df_stats, report_input_path, subkey, reread=True, run_type=run_type, auth_key=auth_key)
+                    else:
+                        save(df_stats, write_stats, "data_analyzer/association_evaluator/" + subkey, reread=True)
+                    logger.info(
+                        f"{key}, {subkey}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
+                    )
+
+            if key == "drift_detector" and args is not None:
+                for subkey, value in args.items():
+                    if value is None:
+                        continue
+                    start = timeit.default_timer()
+                    if subkey == "drift_statistics":
+                        source = None
+                        if not value["configs"].get("pre_existing_source", False):
+                            source = ETL(value.get("source_dataset"))
+                        df_stats = ddetector.statistics(df, source, **value["configs"])
+                    elif subkey == "stability_index":
+                        idfs = [ETL(value[k]) for k in value if k != "configs"]
+                        df_stats = dstability.stability_index_computation(*idfs, **value["configs"])
+                    else:
+                        continue
+                    if report_input_path:
+                        save_stats(df_stats, report_input_path, subkey, reread=True, run_type=run_type, auth_key=auth_key)
+                        if subkey == "stability_index":
+                            amp = value["configs"].get("appended_metric_path", "")
+                            if amp:
+                                metrics = data_ingest.read_dataset(amp, "csv", {"header": True})
+                                save_stats(metrics.to_pandas(), report_input_path, "stabilityIndex_metrics", run_type=run_type, auth_key=auth_key)
+                    else:
+                        save(df_stats, write_stats, "drift_detector/" + subkey, reread=True)
+                    logger.info(
+                        f"{key}, {subkey}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
+                    )
+
+            if key == "transformers" and args is not None:
+                for subkey, value in args.items():
+                    if value is None:
+                        continue
+                    for subkey2, value2 in value.items():
+                        if value2 is None:
+                            continue
+                        start = timeit.default_timer()
+                        extra_args = stats_args(all_configs, subkey2, run_type, auth_key)
+                        f = getattr(transformers, subkey2)
+                        df = f(df, **value2, **extra_args)
+                        df = save(
+                            df, write_intermediate, "data_transformer/transformers/" + subkey2, reread=True
+                        )
+                        logger.info(
+                            f"{key}, {subkey2}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
+                        )
+
+            if key == "report_preprocessing" and args is not None:
+                for subkey, value in args.items():
+                    if subkey == "charts_to_objects" and value is not None:
+                        start = timeit.default_timer()
+                        extra_args = stats_args(all_configs, subkey, run_type, auth_key)
+                        charts_to_objects(df, **value, **extra_args, master_path=report_input_path, run_type=run_type, auth_key=auth_key)
+                        logger.info(
+                            f"{key}, {subkey}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
+                        )
+
+            if key == "report_generation" and args is not None:
+                start = timeit.default_timer()
+                anovos_report(**args, run_type=run_type, auth_key=auth_key)
+                logger.info(
+                    f"{key}, full_report: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
+                )
+
+        # feast export adds its timestamp columns BEFORE the single final
+        # write (reference :854-866); config validated up front (ref :173-182)
+        write_feast = all_configs.get("write_feast_features", None)
+        if write_feast is not None:
+            if write_main is None:
+                raise ValueError("write_feast_features requires write_main")
+            from anovos_tpu.feature_store import feast_exporter
+
+            repartition_count = (write_main.get("file_configs") or {}).get("repartition", -1)
+            feast_exporter.check_feast_configuration(write_feast, repartition_count)
+            df = feast_exporter.add_timestamp_columns(df, write_feast["file_source"])
+        if write_main:
+            save(df, write_main, "final_dataset", reread=False)
+        if write_feast is not None:
+            import glob as _glob
+
+            from anovos_tpu.feature_store import feast_exporter
+
+            path = os.path.join(write_main["file_path"], "final_dataset", "part*")
+            files = _glob.glob(path)
+            feast_exporter.generate_feature_description(df.dtypes(), write_feast, files[0] if files else "")
+    logger.info(f"execution time w/o report (in sec) = {round(timeit.default_timer() - start_main, 4)}")
+
+
+def run(config_path: str, run_type: str = "local", auth_key_val: dict = {}) -> None:
+    """Entry (reference :873-888): load YAML → main.
+
+    Tracing: the reference logs per-block wall times only (SURVEY.md §5);
+    here ``ANOVOS_PROFILE=<dir>`` additionally wraps the run in a JAX
+    profiler trace (xprof-compatible) for kernel-level timing.
+    """
+    from anovos_tpu.shared.artifact_store import for_run_type
+
+    store = for_run_type(run_type, _auth_key(auth_key_val))
+    if run_type == "ak8s" and not auth_key_val:
+        raise ValueError("Invalid auth key for run_type")
+    # remote configs (e.g. s3:// for emr) are pulled before reading
+    # (reference workflow.py:877 "aws s3 cp <config> config.yaml")
+    config_file = store.pull(config_path, "config.yaml")
+    with open(config_file, "r") as f:
+        all_configs = yaml.load(f, yaml.SafeLoader)
+    profile_dir = os.environ.get("ANOVOS_PROFILE", "")
+    if profile_dir:
+        import jax
+
+        ctx = jax.profiler.trace(profile_dir)
+    else:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        main(all_configs, run_type, auth_key_val)
