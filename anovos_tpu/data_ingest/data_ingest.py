@@ -217,7 +217,37 @@ def write_dataset(
         stem = os.path.join(file_path, f"part-{i:05d}")
         if file_type == "csv":
             header = str(cfg.get("header", True)).lower() in ("true", "1")
-            part.to_csv(stem + ".csv", index=False, header=header, sep=str(cfg.get("delimiter", ",")))
+            delim = str(cfg.get("delimiter", ","))
+            try:
+                # pyarrow's C++ writer is ~7× pandas' on the checkpoint hot
+                # path (booleans land lowercase like Spark's writer).  One
+                # formatting trap: pyarrow renders whole-valued floats
+                # without the '.0', so a null-free all-integral float64
+                # column would reread as int64 — pre-format exactly those
+                # columns (C-speed int→str) so the dtype survives.
+                import pyarrow as pa
+                import pyarrow.csv as pacsv
+
+                part = part.copy(deep=False)
+                for c in part.columns:
+                    v = part[c]
+                    if (
+                        v.dtype.kind == "f"
+                        and not v.isna().any()
+                        and len(v)
+                        and np.abs(v.to_numpy()).max() < 2**62
+                        and (v.to_numpy() == np.trunc(v.to_numpy())).all()
+                    ):
+                        part[c] = np.char.add(
+                            v.to_numpy().astype(np.int64).astype(str), ".0"
+                        ).astype(object)
+                pacsv.write_csv(
+                    pa.Table.from_pandas(part, preserve_index=False),
+                    stem + ".csv",
+                    write_options=pacsv.WriteOptions(include_header=header, delimiter=delim),
+                )
+            except Exception:  # mixed-type object columns etc: pandas handles
+                part.to_csv(stem + ".csv", index=False, header=header, sep=delim)
         elif file_type == "parquet":
             part.to_parquet(stem + ".parquet", index=False)
         elif file_type == "avro":
