@@ -129,6 +129,32 @@ def test_recast_wide_float_to_int_is_exact():
     )
 
 
+def test_csv_checkpoint_preserves_float_dtype(tmp_path):
+    """The pyarrow checkpoint writer renders whole-valued floats without a
+    decimal point; the writer must pre-format those columns so a null-free
+    all-integral float64 column rereads as double, not bigint (code-review
+    r4 finding — the write_intermediate path hits this on imputed columns)."""
+    t = Table.from_pandas(pd.DataFrame({
+        "f_whole": [1.0, 2.0, 3.0],
+        "f_frac": [1.5, np.nan, 3.25],
+        "f_big": [2.0**40, 2.0**40 + 1, 0.0],
+        "i": [1, 2, 3],
+        "s": ["a", "b", None],
+        "b": [True, False, True],
+    }))
+    write_dataset(t, str(tmp_path / "x"), "csv", {"mode": "overwrite", "header": True})
+    back = read_dataset(str(tmp_path / "x"), "csv", {"header": True})
+    assert back.columns["f_whole"].dtype_name in ("double", "float")
+    assert back.columns["f_frac"].dtype_name in ("double", "float")
+    assert back.columns["f_big"].dtype_name in ("double", "float")
+    assert back.columns["i"].dtype_name in ("int", "bigint")
+    np.testing.assert_allclose(
+        np.asarray(back.columns["f_whole"].data)[:3], [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(
+        np.asarray(back.columns["f_big"].data)[:3],
+        np.array([2.0**40, 2.0**40 + 1, 0.0], np.float32))
+
+
 def test_recast_num_to_string():
     t = Table.from_pandas(pd.DataFrame({"n": [1, 2, 3]}))
     out = recast_column(t, ["n"], ["string"])
