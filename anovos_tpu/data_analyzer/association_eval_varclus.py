@@ -58,8 +58,14 @@ class VarClusJax:
         n_rs: int = 0,
         seed: int = 42,
     ):
-        self.corr_df = corr
         self.feat_list = list(corr.columns)
+        # integer-indexed view: the reassignment loops evaluate _correig
+        # thousands of times, and label-based .loc lookups dominated the
+        # whole VarClus wall (pandas indexing ~1.0 s of a 1.5 s fit).
+        # .loc re-orders by label so a frame whose index ordering differs
+        # from its columns stays correct
+        self._C = corr.loc[self.feat_list, self.feat_list].to_numpy()
+        self._ix = {f: i for i, f in enumerate(self.feat_list)}
         self.maxeigval2 = maxeigval2
         self.maxclus = maxclus
         self.n_rs = n_rs
@@ -67,13 +73,20 @@ class VarClusJax:
         self.clusters: "collections.OrderedDict[int, dict]" = collections.OrderedDict()
 
     # -- spectral helpers ------------------------------------------------
+    def _sub(self, feats):
+        ii = [self._ix[f] for f in feats]
+        return self._C[np.ix_(ii, ii)]
+
+    def _row(self, feat, feats):
+        return self._C[self._ix[feat]][[self._ix[f] for f in feats]]
+
     def _correig(self, feats: List[str], n_pcs: int = 2):
         if len(feats) <= 1:
             eigvals = [float(len(feats))] + [0.0] * (n_pcs - 1)
             eigvecs = np.array([[float(len(feats))]])
             varprops = [sum(eigvals)]
             return np.array(eigvals), eigvecs, np.array(varprops)
-        corr = self.corr_df.loc[feats, feats].to_numpy()
+        corr = self._sub(feats)
         raw_vals, raw_vecs = np.linalg.eigh(corr)
         idx = np.argsort(raw_vals)[::-1]
         vals, vecs = raw_vals[idx], raw_vecs[:, idx]
@@ -156,7 +169,7 @@ class VarClusJax:
                 break
             # NCS phase: assign to the rotated component with higher |r|
             r_vecs = quartimax_rotate(c_vecs[:, :2])
-            corr = self.corr_df.loc[split_clus, split_clus].to_numpy()
+            corr = self._sub(split_clus)
             comp_cov = corr @ r_vecs  # cov(x_i, comp_j), correlation scale
             comp_var = np.einsum("ij,ij->j", r_vecs, comp_cov)
             sqcorr = (comp_cov**2) / np.maximum(comp_var[None, :], 1e-30)
@@ -189,7 +202,7 @@ class VarClusJax:
                 continue
             _, vecs, _ = self._correig(feats)
             v1 = vecs[:, :1]
-            corr = self.corr_df.loc[feats, feats].to_numpy()
+            corr = self._sub(feats)
             comps[i] = (feats, v1, float((v1.T @ corr @ v1)[0, 0]))
         rows = []
         for i, info in self.clusters.items():
@@ -198,14 +211,13 @@ class VarClusJax:
                 if len(feats_i) == 1:
                     rs_own = 1.0
                 else:
-                    j = feats_i.index(feat)
-                    cov_own = float((self.corr_df.loc[[feat], feats_i].to_numpy() @ v_i)[0, 0])
+                    cov_own = float(self._row(feat, feats_i) @ v_i[:, 0])
                     rs_own = cov_own**2 / max(var_i, 1e-30)
                 rs_others = []
                 for k, (feats_k, v_k, var_k) in comps.items():
                     if k == i:
                         continue
-                    cov = float((self.corr_df.loc[[feat], feats_k].to_numpy() @ v_k)[0, 0])
+                    cov = float(self._row(feat, feats_k) @ v_k[:, 0])
                     denom = var_k if len(feats_k) > 1 else 1.0
                     rs_others.append(cov**2 / max(denom, 1e-30))
                 rs_nc = max(rs_others) if rs_others else 0.0

@@ -150,9 +150,11 @@ def test_csv_checkpoint_preserves_float_dtype(tmp_path):
     assert back.columns["i"].dtype_name in ("int", "bigint")
     np.testing.assert_allclose(
         np.asarray(back.columns["f_whole"].data)[:3], [1.0, 2.0, 3.0])
-    np.testing.assert_allclose(
-        np.asarray(back.columns["f_big"].data)[:3],
-        np.array([2.0**40, 2.0**40 + 1, 0.0], np.float32))
+    # 2^40+1 is f32-lossy: the reread column must carry the exact wide pair
+    # and reproduce the value bit-for-bit in float64
+    np.testing.assert_array_equal(
+        back.columns["f_big"].exact_host(3),
+        np.array([2.0**40, 2.0**40 + 1, 0.0], np.float64))
 
 
 def test_recast_num_to_string():
