@@ -304,7 +304,9 @@ def main() -> None:
         )
     results["hist_pallas_vs_xla"] = _run_section("hist")
     results["ae_train"] = _run_section("ae")
-    if "tflops" in results["ae_train"]:
+    if "tflops" in results["ae_train"] and "mfu_pct" not in results["ae_train"]:
+        # the sweep computes mfu_pct itself from unrounded tflops; only
+        # derive it here for older/partial section outputs
         peak_key = "bf16_tflops" if results["ae_train"].get("compute") == "bf16" else "f32_tflops"
         results["ae_train"]["mfu_pct"] = round(
             100 * results["ae_train"]["tflops"] / peaks[peak_key], 1
