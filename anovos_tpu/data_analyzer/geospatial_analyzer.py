@@ -37,6 +37,61 @@ def _latlon_points(idf: Table, lat_col: str, lon_col: str, max_records: int) -> 
     return pts
 
 
+def _silhouettes_batched(
+    D_full: np.ndarray, labels_list, sample: int = 2000, seed: int = 1
+) -> list:
+    """Sampled silhouettes for MANY labelings of the same points, sharing
+    ONE fixed sample and ONE distance→one-hot matmul across all combos.
+
+    The per-combo `_silhouette` resamples valid points per labeling and
+    rebuilds the sample distance block each time — ~40 ms × 35 grid combos.
+    Here the sample is drawn once from all points (noise rows masked per
+    combo), so the whole grid costs one 4M-element gather plus a single
+    (s, s) @ (s, Σk) BLAS call.  With noise-free labels and n > sample the
+    drawn indices coincide with `_silhouette`'s and the values are
+    bit-identical; with noise the estimator differs only in sampling
+    scheme (both are sampled approximations of the full silhouette)."""
+    n = D_full.shape[0]
+    rng = np.random.default_rng(seed)
+    pick = rng.choice(n, sample, replace=False) if n > sample else np.arange(n)
+    Ds = D_full[np.ix_(pick, pick)]
+    s = len(pick)
+    blocks, metas = [], []
+    for li, labels in enumerate(labels_list):
+        full_valid = labels >= 0
+        if len(np.unique(labels[full_valid])) < 2 or full_valid.sum() < 10:
+            metas.append(-1.0)  # ineligible on the FULL labeling
+            continue
+        lp = labels[pick]
+        valid = lp >= 0
+        uniq, inv = (np.unique(lp[valid], return_inverse=True) if valid.any()
+                     else (np.empty(0), np.empty(0, np.int64)))
+        if valid.sum() < 10 or len(uniq) < 2:
+            # eligible on the full labeling but degenerate in the SHARED
+            # sample (high noise / tiny clusters): fall back to the
+            # per-combo resample so the score matches the old path instead
+            # of flipping to -1.  X's values are unused on the D_full path.
+            metas.append(_silhouette(
+                np.empty((n, 0)), labels, sample=sample, D_full=D_full))
+            continue
+        k = len(uniq)
+        C = np.zeros((s, k))
+        C[np.nonzero(valid)[0], inv] = 1.0
+        metas.append((k, inv, valid))
+        blocks.append(C)
+    S_all = Ds @ np.concatenate(blocks, axis=1) if blocks else None
+    out, off = [], 0
+    for meta in metas:
+        if isinstance(meta, float):
+            out.append(meta)
+            continue
+        k, inv, valid = meta
+        S = S_all[:, off : off + k][valid]
+        off += k
+        out.append(_sil_mean(S, inv))
+    return out
+
+
 def _silhouette(
     X: np.ndarray, labels: np.ndarray, sample: int = 2000, D_full=None
 ) -> float:
@@ -70,12 +125,20 @@ def _silhouette(
     k = len(uniq)
     C = np.zeros((len(Xs), k))
     C[np.arange(len(Xs)), inv] = 1.0
-    sums = D @ C  # (n, k) total distance to each cluster
-    cnt = C.sum(axis=0)  # (k,)
+    return _sil_mean(D @ C, inv)
+
+
+def _sil_mean(S: np.ndarray, inv: np.ndarray) -> float:
+    """Mean silhouette from per-cluster distance sums S (m, k) and each
+    point's own-cluster index ``inv`` — the ONE copy of the a/b math shared
+    by the per-combo and batched paths."""
+    m, k = S.shape
+    cnt = np.bincount(inv, minlength=k).astype(float)
     own = cnt[inv]
-    a = np.where(own > 1, sums[np.arange(len(Xs)), inv] / np.maximum(own - 1, 1), 0.0)
-    means = sums / np.maximum(cnt[None, :], 1)
-    means[np.arange(len(Xs)), inv] = np.inf  # exclude own cluster from b
+    rows = np.arange(m)
+    a = np.where(own > 1, S[rows, inv] / np.maximum(own - 1, 1), 0.0)
+    means = S / np.maximum(cnt[None, :], 1)
+    means[rows, inv] = np.inf  # exclude own cluster from b
     b = means.min(axis=1)
     b = np.where(np.isfinite(b), b, 0.0)
     sil = (b - a) / np.maximum(np.maximum(a, b), 1e-30)
@@ -367,6 +430,7 @@ def cluster_analysis(
         # distances reused by every combo's silhouette sample
         D_full = np.sqrt(np.maximum(D2, 0.0))
         all_labels = dbscan_host_grid_multi(D2, eps_values, ms_eff)
+    combos = []  # (eps, min_samples, labels)
     for a, e in enumerate(eps_values):
         if D2 is not None:
             labels_b = all_labels[a]
@@ -375,18 +439,22 @@ def cluster_analysis(
             # batched device program (fixed shapes — one compile for the grid)
             counts = neighbor_counts(sub, float(e))
             labels_b = dbscan_grid(sub, float(e), ms_eff, counts=counts)
-        for m, labels in zip(ms_values, labels_b):
-            n_clusters = len(set(labels[labels >= 0]))
-            score = _silhouette(sub, labels, D_full=D_full) if n_clusters >= 2 else -1.0
-            rows.append(
-                {
-                    "eps": round(float(e), 4),
-                    "min_samples": int(m),
-                    "n_clusters": n_clusters,
-                    "noise_pct": round(float((labels < 0).mean()), 4),
-                    "silhouette": round(score, 4),
-                }
-            )
+        combos.extend((e, m, labels) for m, labels in zip(ms_values, labels_b))
+    if D_full is not None:
+        scores = _silhouettes_batched(D_full, [lab for _, _, lab in combos])
+    else:
+        # _silhouette itself returns -1.0 for <2 clusters / <10 valid points
+        scores = [_silhouette(sub, lab) for _, _, lab in combos]
+    for (e, m, labels), score in zip(combos, scores):
+        rows.append(
+            {
+                "eps": round(float(e), 4),
+                "min_samples": int(m),
+                "n_clusters": len(set(labels[labels >= 0])),
+                "noise_pct": round(float((labels < 0).mean()), 4),
+                "silhouette": round(score, 4),
+            }
+        )
     return km, pd.DataFrame(rows)
 
 

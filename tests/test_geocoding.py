@@ -127,3 +127,46 @@ def test_zoneinfo_densified_entries_resolve():
         np.array([21.31], np.float32), np.array([-157.86], np.float32), xyz
     )
     assert cities["name"].iloc[int(idx[0])] == "Honolulu"
+
+
+def test_batched_silhouettes_match_per_combo():
+    """Noise-free labels + n > sample: the batched grid silhouette must be
+    BIT-identical to the per-combo `_silhouette` (same rng draw, same
+    math); with noise it stays a close sampled estimate of the same
+    quantity."""
+    from anovos_tpu.data_analyzer.geospatial_analyzer import (
+        _silhouette, _silhouettes_batched)
+
+    rng = np.random.default_rng(4)
+    n = 2600
+    X = np.concatenate([
+        rng.normal([0, 0], 0.3, (n // 2, 2)), rng.normal([3, 3], 0.3, (n - n // 2, 2)),
+    ])
+    D_full = np.sqrt(((X[:, None, :] - X[None, :, :]) ** 2).sum(-1))
+    clean = (X[:, 0] > 1.5).astype(np.int64)
+    three = np.clip((X[:, 0] + 1).astype(np.int64), 0, 2)
+    got = _silhouettes_batched(D_full, [clean, three])
+    # same math over the SAME distance matrix (np.isclose: a BLAS may
+    # order the wide-vs-narrow GEMM reductions differently by ULPs);
+    # ~1e-12-close vs the quadratic-expansion distance computation
+    assert np.isclose(got[0], _silhouette(X, clean, D_full=D_full), rtol=1e-12, atol=0)
+    assert np.isclose(got[1], _silhouette(X, three, D_full=D_full), rtol=1e-12, atol=0)
+    assert abs(got[0] - _silhouette(X, clean)) < 1e-9
+    # noisy labeling: same estimand, different sampling scheme — close
+    noisy = clean.copy()
+    noisy[rng.choice(n, 400, replace=False)] = -1
+    got_noisy = _silhouettes_batched(D_full, [noisy])[0]
+    assert abs(got_noisy - _silhouette(X, noisy)) < 0.05
+    # degenerate labelings -> -1 like the per-combo path
+    assert _silhouettes_batched(D_full, [np.zeros(n, np.int64)]) == [-1.0]
+    assert _silhouettes_batched(D_full, [np.full(n, -1, np.int64)]) == [-1.0]
+    # eligible on the FULL labeling but degenerate in the shared sample
+    # (nearly-all-noise + tiny shared sample): must fall back to the
+    # per-combo resample and match its score, not flip to -1
+    sparse = np.full(n, -1, np.int64)
+    keep = rng.choice(n, 24, replace=False)
+    sparse[keep] = (X[keep, 0] > 1.5).astype(np.int64)
+    got_sparse = _silhouettes_batched(D_full, [sparse], sample=50)[0]
+    assert got_sparse != -1.0
+    # 24 valid points ≤ both sample sizes → no resampling on either path
+    assert abs(got_sparse - _silhouette(X, sparse)) < 1e-9
