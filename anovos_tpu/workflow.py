@@ -53,7 +53,15 @@ def ETL(args: dict) -> Table:
 
 def save(data, write_configs: Optional[dict], folder_name: str, reread: bool = False):
     """Checkpoint a Table (or stats frame) under the write config's path
-    (reference :64-88).  reread loads it back, cutting any lineage."""
+    (reference :64-88).
+
+    The reference's ``reread`` loads the checkpoint back to CUT THE SPARK
+    LINEAGE — a lazy-DAG concern this framework does not have: a Table is
+    already materialized device arrays.  So reread writes the checkpoint
+    artifact (same files on disk) and returns the in-memory data, skipping
+    ~15 disk read-backs per configs_full run.  ``ANOVOS_REREAD_FROM_DISK=1``
+    restores the literal read-back (for chasing a writer/reader parity bug:
+    it re-applies the CSV round-trip's dtype coercions mid-pipeline)."""
     if not write_configs:
         return data
     if "file_path" not in write_configs:
@@ -62,19 +70,20 @@ def save(data, write_configs: Optional[dict], folder_name: str, reread: bool = F
     write.pop("mlflow_run_id", "")
     write.pop("log_mlflow", False)
     write["file_path"] = os.path.join(write["file_path"], folder_name)
+    from_disk = os.environ.get("ANOVOS_REREAD_FROM_DISK", "0") == "1"
     if isinstance(data, pd.DataFrame):
         from anovos_tpu.shared.table import Table as _T
 
         data_t = _T.from_pandas(data)
         data_ingest.write_dataset(data_t, **write)
-        if reread:
+        if reread and from_disk:
             return data_ingest.read_dataset(
                 write["file_path"], write.get("file_type", "csv"),
                 _clean_read_cfg(write.get("file_configs")),
             ).to_pandas()
         return data
     data_ingest.write_dataset(data, **write)
-    if reread:
+    if reread and from_disk:
         return data_ingest.read_dataset(
             write["file_path"], write.get("file_type", "csv"), _clean_read_cfg(write.get("file_configs"))
         )
