@@ -357,6 +357,9 @@ def dbscan_host_grid_multi(
     keep = ei < ej
     ei, ej = ei[keep], ej[keep]
     d2e = D2[ei, ej]
+    # (measured: distance-sorting the edges to make each eps a prefix slice
+    # LOSES — the shuffled edge order is cache-hostile for the per-combo
+    # bincount/remap gathers; the row-major order from nonzero wins)
     out = np.full((len(eps_list), len(min_samples_list), n), -1, np.int64)
     for a, eps in enumerate(eps_list):
         within = d2e <= eps * eps
