@@ -149,3 +149,27 @@ def test_ts_geo_failures_do_not_kill_pipeline(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     wf.main(cfg, "local")
     assert (tmp_path / "global_summary.csv").exists()
+
+
+def test_reread_skips_disk_but_escape_hatch_reads_back(tmp_path, monkeypatch):
+    """save(reread=True) writes the checkpoint artifact and returns the
+    in-memory Table (no Spark lineage to cut); ANOVOS_REREAD_FROM_DISK=1
+    restores the literal read-back for writer/reader parity debugging."""
+    import numpy as np
+    import pandas as pd
+
+    from anovos_tpu import workflow
+    from anovos_tpu.shared import Table
+
+    t = Table.from_pandas(pd.DataFrame({"x": [1.5, 2.5], "c": ["a", "b"]}))
+    wc = {"file_path": str(tmp_path), "file_type": "csv",
+          "file_configs": {"mode": "overwrite", "header": True}}
+    monkeypatch.delenv("ANOVOS_REREAD_FROM_DISK", raising=False)
+    out = workflow.save(t, wc, "ckpt", reread=True)
+    assert out is t  # identity: no read-back
+    assert (tmp_path / "ckpt" / "_SUCCESS").exists()  # artifact still written
+    monkeypatch.setenv("ANOVOS_REREAD_FROM_DISK", "1")
+    out2 = workflow.save(t, wc, "ckpt", reread=True)
+    assert out2 is not t  # literal read-back
+    np.testing.assert_allclose(
+        np.asarray(out2.columns["x"].data)[:2], [1.5, 2.5])
