@@ -112,10 +112,16 @@ def init_runtime(
     cache_dir = os.environ.get("ANOVOS_COMPILE_CACHE", "")
     if cache_dir:
         # persistent XLA compilation cache: pipeline stages produce many
-        # distinct table shapes, and on remote backends compilation dominates
-        # cold-run wall time
+        # distinct table shapes, and compilation dominates cold-run wall
+        # time.  The pipeline is ~200 SMALL programs, so the threshold must
+        # sit well below jax's 1s default — at 0.02s a second process's
+        # configs_full "cold" run drops 34 → 15 s on one CPU core (~1.5 MB
+        # of cache).  First run pays ~15% cache-write overhead.
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(os.environ.get("ANOVOS_COMPILE_CACHE_MIN_SECS", 0.02)),
+        )
     if distributed and jax.process_count() == 1 and "JAX_COORDINATOR_ADDRESS" in os.environ:
         jax.distributed.initialize()
     devs = list(devices if devices is not None else jax.devices())
