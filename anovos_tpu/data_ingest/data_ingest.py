@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import glob
 import gzip
+import logging
 import os
 import shutil
 import warnings
@@ -23,11 +24,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pandas as pd
+import pyarrow as pa
+import pyarrow.csv as pacsv
 
 from anovos_tpu.data_ingest import avro_io
 from anovos_tpu.shared.runtime import get_runtime
 from anovos_tpu.shared.table import Column, Table, _host_to_column, _pad_to
 from anovos_tpu.shared.utils import ends_with, pairwise_reduce, parse_cols
+
+# one-shot notice when the pyarrow CSV checkpoint writer falls back to
+# pandas (mixed-format directories must be observable, not silent)
+_PANDAS_CSV_FALLBACK_LOGGED = False
 
 _EXTENSIONS = {
     "csv": (".csv",),
@@ -225,9 +232,6 @@ def write_dataset(
                 # without the '.0', so a null-free all-integral float64
                 # column would reread as int64 — pre-format exactly those
                 # columns (C-speed int→str) so the dtype survives.
-                import pyarrow as pa
-                import pyarrow.csv as pacsv
-
                 part = part.copy(deep=False)
                 for c in part.columns:
                     v = part[c]
@@ -246,7 +250,20 @@ def write_dataset(
                     stem + ".csv",
                     write_options=pacsv.WriteOptions(include_header=header, delimiter=delim),
                 )
-            except Exception:  # mixed-type object columns etc: pandas handles
+            except Exception as e:
+                # arrow conversion limits (mixed-type object columns,
+                # duplicate column names in the pre-format loop, ...):
+                # pandas handles those.  The except stays broad so the
+                # fallback is total, but it logs ONCE with the cause so a
+                # mixed-format checkpoint directory is observable, not
+                # silent (round-4 advisor).
+                global _PANDAS_CSV_FALLBACK_LOGGED
+                if not _PANDAS_CSV_FALLBACK_LOGGED:
+                    _PANDAS_CSV_FALLBACK_LOGGED = True
+                    logging.getLogger(__name__).info(
+                        "pyarrow CSV writer fell back to pandas for %s "
+                        "(%s: %s); later parts may mix formats "
+                        "(quoting/boolean case)", stem, type(e).__name__, e)
                 part.to_csv(stem + ".csv", index=False, header=header, sep=delim)
         elif file_type == "parquet":
             part.to_parquet(stem + ".parquet", index=False)

@@ -264,9 +264,10 @@ def table_describe(idf: Table, num_cols: List[str], cat_cols: List[str]) -> Tupl
         cache = {}
         idf._describe_cache = cache
     # the compensated mode is a cache INPUT: toggling the env var mid-process
-    # must not serve the other mode's moments
-    rows = idf.columns[num_cols[0]].data.shape[0] if num_cols else 0
-    compensated = bool(num_cols) and _compensated_enabled(rows)
+    # must not serve the other mode's moments.  The threshold compares the
+    # LOGICAL row count — shape-bucket padding inflates the device length
+    # and must not flip the mode for tables just under the cutoff.
+    compensated = bool(num_cols) and _compensated_enabled(idf.nrows)
     key = (tuple(num_cols), tuple(cat_cols), compensated)
     if key in cache:
         return cache[key]

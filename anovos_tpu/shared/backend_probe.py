@@ -1,0 +1,273 @@
+"""Bounded default-backend probe with CPU fallback.
+
+The remote-accelerator tunnel this project runs behind can wedge so hard
+that ``jax.devices()`` hangs forever (PERF.md "tunnel status", rounds 3-4).
+Any user-facing entry point that imports jax and touches the default
+backend therefore needs a *bounded* answer to "is the accelerator
+responsive?" before committing to it — otherwise the documented quickstart
+(``python examples/01_basic_stats.py``) hangs forever on a wedged tunnel.
+
+This is the demo/CLI-grade sibling of bench.py's gate probe
+(``bench.probe_backend``): one subprocess probe with a hard timeout, then
+fall back to CPU *in this process* with a printed notice.  The reference's
+demo surface just runs (run_anovos_demo.sh:1); ours must too, on any host.
+
+Contract:
+  * ``JAX_PLATFORMS=cpu`` is honored as-is (CPU cannot wedge) and
+    re-asserted via ``jax.config`` for hosts whose sitecustomize
+    pre-registers an accelerator plugin that would otherwise win.
+  * Any accelerator platform — explicit env or default — gets a bounded
+    subprocess probe (``ANOVOS_BACKEND_PROBE_TIMEOUT``, default 45 s)
+    running a real jitted computation.  The ambient environment here sets
+    ``JAX_PLATFORMS=<plugin>`` for every process, so a non-cpu env value
+    is NOT evidence of a deliberate user pin.  On success the process
+    proceeds on that backend; on timeout/failure it pins
+    ``jax_platforms = cpu`` and prints one notice to stderr.
+  * ``ANOVOS_BACKEND_PROBE=0`` skips probing entirely (trust the env).
+
+Call it BEFORE the first jax backend touch — config updates after backend
+initialization do not take effect.
+"""
+
+import os
+import select
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_PROBED: dict = {}
+
+# The probe must run a real jitted computation and fetch the result, not
+# just list devices: the wedged tunnel has been observed (round 5) to
+# answer ``jax.devices()`` in 0.3 s while every actual compile/execute
+# hangs forever.  float() forces the device→host transfer (PERF.md notes
+# block_until_ready returns early on this backend).
+PROBE_CODE = (
+    # hosts whose sitecustomize force-registers an accelerator plugin latch
+    # the platform at interpreter startup — the env choice must be
+    # re-asserted via jax.config inside the child or a JAX_PLATFORMS=cpu
+    # probe still dials the tunnel (same pattern as tests/conftest.py)
+    "import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+    "p and jax.config.update('jax_platforms', p); "
+    "assert float(jax.jit(lambda a: a + 1)(1.0)) == 2.0; "
+    "print(jax.devices()[0].platform)"
+)
+
+
+def probe_default_backend(timeout_s: float):
+    """One bounded subprocess probe. Returns (platform | None, diagnostic).
+
+    The child runs in its own session and is killed as a process group on
+    timeout; stdout/stderr go to temp files, not pipes — a tunnel helper
+    grandchild holding an inherited pipe open must not be able to block
+    the parent after the kill.
+    """
+    with tempfile.TemporaryFile() as out, tempfile.TemporaryFile() as err:
+        p = subprocess.Popen(
+            [sys.executable, "-c", PROBE_CODE],
+            stdout=out, stderr=err, start_new_session=True,
+        )
+        try:
+            p.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                p.kill()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass  # unkillable child: the temp files keep us unblocked
+            return None, f"backend probe timed out after {timeout_s:.0f}s"
+        out.seek(0)
+        stdout = out.read().decode(errors="replace").strip()
+        if p.returncode == 0 and stdout:
+            return stdout.splitlines()[-1], None
+        err.seek(0)
+        lines = err.read().decode(errors="replace").strip().splitlines()
+        return None, "backend probe failed: " + (
+            lines[-1][-200:] if lines else f"rc={p.returncode}"
+        )
+
+
+def ensure_responsive_backend(timeout_s: float | None = None, quiet: bool = False) -> str:
+    """Pin this process to a backend that is known to answer.
+
+    Returns the platform name the process will use.  Idempotent: the first
+    call decides, later calls return the cached answer.
+    """
+    if "platform" in _PROBED:
+        return _PROBED["platform"]
+
+    import jax  # deferred: importing jax is cheap; initializing a backend is not
+
+    explicit = os.environ.get("JAX_PLATFORMS", "")
+    if explicit:
+        # make the env choice stick even where sitecustomize pre-registered
+        # an accelerator plugin (it latches the platform at startup)
+        jax.config.update("jax_platforms", explicit)
+        if explicit.split(",")[0] == "cpu":
+            # CPU cannot wedge: nothing to probe
+            _PROBED["platform"] = "cpu"
+            return "cpu"
+        # an accelerator platform still gets the bounded probe: the ambient
+        # environment ships JAX_PLATFORMS=<plugin> for every process, so an
+        # env value is NOT evidence of a deliberate user pin, and honoring
+        # it blindly re-creates the infinite quickstart hang
+
+    if os.environ.get("ANOVOS_BACKEND_PROBE", "1") == "0":
+        _PROBED["platform"] = explicit.split(",")[0] if explicit else "default"
+        return _PROBED["platform"]
+
+    # 90 s default: the probe program is one scalar add — a healthy remote
+    # tunnel cold-compiles it in seconds (the 20-40 s figure is for full
+    # pipeline-sized programs), so 90 s covers interpreter + backend init +
+    # a slow compile with wide margin while keeping the wedged-case wait
+    # tolerable
+    budget = float(
+        timeout_s
+        if timeout_s is not None
+        else os.environ.get("ANOVOS_BACKEND_PROBE_TIMEOUT", 90)
+    )
+    platform, diag = probe_default_backend(budget)
+    if platform is None:
+        if not quiet:
+            print(
+                f"anovos_tpu: default backend unresponsive ({diag}); "
+                "falling back to CPU for this run. Set "
+                "ANOVOS_BACKEND_PROBE=0 to trust the configured backend "
+                "without probing, or ANOVOS_BACKEND_PROBE_TIMEOUT to "
+                "lengthen the probe.",
+                file=sys.stderr,
+            )
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
+    _PROBED["platform"] = platform
+    return platform
+
+
+def supervise_demo(stall_timeout_s: float | None = None) -> None:
+    """Process-level hang watchdog for demo/CLI entry points.
+
+    The upfront probe is necessary but not sufficient: the wedged tunnel
+    has been observed (round 5) to let one tiny jitted op round-trip and
+    then hang the very next program — so a demo that passed the probe can
+    still freeze mid-run.  The only robust recovery is at process level:
+
+      * First call (accelerator backend, no ``ANOVOS_SUPERVISED``):
+        re-runs ``sys.argv`` as a supervised child (own session, merged
+        stdout/stderr streamed through).  If the child goes
+        ``ANOVOS_STALL_TIMEOUT`` seconds (default 180) with no output, it
+        is killed as a group and retried once with ``JAX_PLATFORMS=cpu``.
+        The parent exits with the child's code and never returns.
+      * In the child, with ``JAX_PLATFORMS=cpu``, or with
+        ``ANOVOS_BACKEND_PROBE=0``: behaves as
+        :func:`ensure_responsive_backend` and returns, so the script body
+        just runs.
+
+    Cold XLA compiles through a healthy remote tunnel are 20-40 s each
+    (PERF.md); the stall timeout is silence-based, not total-runtime-based,
+    so long healthy runs that print progress are never killed.
+    """
+    if (
+        os.environ.get("ANOVOS_SUPERVISED") == "1"
+        or os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu"
+        or os.environ.get("ANOVOS_BACKEND_PROBE", "1") == "0"
+    ):
+        # child mode, a CPU pin (cannot wedge), or supervision disabled:
+        # run the script body in this process.  A non-cpu JAX_PLATFORMS
+        # does NOT opt out — the ambient environment sets it for every
+        # process, so it is not evidence of a deliberate user pin.
+        ensure_responsive_backend()
+        return
+
+    stall = float(
+        stall_timeout_s
+        if stall_timeout_s is not None
+        else os.environ.get("ANOVOS_STALL_TIMEOUT", 180)
+    )
+    # unbuffered child: the stall detector measures output cadence, and a
+    # block-buffered pipe would hold a healthy run's progress past the limit
+    env = {**os.environ, "ANOVOS_SUPERVISED": "1", "PYTHONUNBUFFERED": "1"}
+    p = subprocess.Popen(
+        [sys.executable] + sys.argv,
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        start_new_session=True,
+    )
+    fd = p.stdout.fileno()
+    last = time.monotonic()
+    stalled = False
+    while True:
+        ready, _, _ = select.select([fd], [], [], 5.0)
+        if ready:
+            chunk = os.read(fd, 1 << 16)
+            if not chunk:
+                break  # EOF: child finished (or died)
+            sys.stdout.buffer.write(chunk)
+            sys.stdout.flush()
+            last = time.monotonic()
+        elif p.poll() is not None:
+            # child exited but a background grandchild (tunnel helper)
+            # inherited the pipe and holds it open — exit status, not EOF,
+            # is the completion signal; waiting for EOF here would let the
+            # silence timeout kill-and-CPU-retry an already-finished run
+            break
+        elif time.monotonic() - last > stall:
+            stalled = True
+            break
+    if not stalled:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            # EOF arrived but the child never exited: wedged in backend
+            # teardown — treat as a stall, not a success
+            stalled = True
+    if stalled:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            p.kill()
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+    else:
+        # ordinary exit (success OR failure) propagates as-is: retrying a
+        # crashed run on CPU would re-execute side effects (checkpoint
+        # appends, report writes) for a failure that had nothing to do
+        # with the backend
+        sys.exit(p.returncode)
+    print(
+        f"anovos_tpu: supervised run produced no output for {stall:.0f}s "
+        "(backend stalled mid-run); retrying once on CPU. Set "
+        "ANOVOS_BACKEND_PROBE=0 to trust the configured backend unsupervised.",
+        file=sys.stderr,
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable] + sys.argv, env=env)
+    sys.exit(r.returncode)
+
+
+if __name__ == "__main__":
+    # shared CLI for the shell tooling (tools/tpu_poller.sh,
+    # tools/tpu_capture.sh) so the compute-grade probe exists in ONE place:
+    #   python -m anovos_tpu.shared.backend_probe [--timeout N] [--require-accelerator]
+    # exits 0 iff the default backend answered (and, with
+    # --require-accelerator, is not cpu); prints the platform on success.
+    import argparse
+
+    ap = argparse.ArgumentParser(description="bounded compute-grade backend probe")
+    ap.add_argument("--timeout", type=float, default=100.0)
+    ap.add_argument("--require-accelerator", action="store_true")
+    ns = ap.parse_args()
+    plat, diagnostic = probe_default_backend(ns.timeout)
+    if plat is None:
+        print(diagnostic, file=sys.stderr)
+        sys.exit(1)
+    if ns.require_accelerator and plat == "cpu":
+        print(f"backend is {plat}, not an accelerator", file=sys.stderr)
+        sys.exit(2)
+    print(plat)

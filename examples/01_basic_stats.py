@@ -12,9 +12,9 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from examples._data import honor_jax_platforms_env, load_income  # noqa: E402
+from examples._data import supervised_entry, load_income  # noqa: E402
 
-honor_jax_platforms_env()
+supervised_entry()
 
 from anovos_tpu.data_analyzer import stats_generator as sg  # noqa: E402
 from anovos_tpu.shared import Table  # noqa: E402

@@ -16,9 +16,9 @@ import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from examples._data import honor_jax_platforms_env, load_income  # noqa: E402
+from examples._data import supervised_entry, load_income  # noqa: E402
 
-honor_jax_platforms_env()
+supervised_entry()
 
 from anovos_tpu.drift_stability import drift_detector, stability  # noqa: E402
 from anovos_tpu.shared import Table  # noqa: E402

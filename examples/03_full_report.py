@@ -19,9 +19,9 @@ import yaml
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-from examples._data import honor_jax_platforms_env, materialize_income_parquet  # noqa: E402
+from examples._data import supervised_entry, materialize_income_parquet  # noqa: E402
 
-honor_jax_platforms_env()
+supervised_entry()
 
 from anovos_tpu import workflow  # noqa: E402
 

@@ -4,11 +4,32 @@
 import json
 import sys
 
-from anovos_tpu import workflow
+import importlib.util
+import os
+
+# load backend_probe standalone (stdlib-only module) WITHOUT triggering the
+# anovos_tpu package __init__, so the short-lived supervisor parent never
+# pays the jax/numpy/pandas import stack — only the re-exec'd child does
+_bp_spec = importlib.util.spec_from_file_location(
+    "_anovos_backend_probe",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "anovos_tpu", "shared", "backend_probe.py"),
+)
+_bp = importlib.util.module_from_spec(_bp_spec)
+_bp_spec.loader.exec_module(_bp)
+supervise_demo = _bp.supervise_demo
 
 if __name__ == "__main__":
     if len(sys.argv) < 2:
         sys.exit("usage: python main.py <config.yaml> [run_type] [auth_key_json]")
+    # an unresponsive accelerator tunnel must not hang the CLI forever:
+    # bounded backend probe + silence-based stall watchdog with a one-shot
+    # CPU retry on stall (JAX_PLATFORMS=cpu runs unsupervised; a non-cpu
+    # value still gets supervision — the ambient environment sets one for
+    # every process; ANOVOS_BACKEND_PROBE=0 trusts it unsupervised)
+    supervise_demo()
+
+    from anovos_tpu import workflow
     config_path = sys.argv[1]
     run_type = sys.argv[2] if len(sys.argv) > 2 else "local"
     if len(sys.argv) > 3:

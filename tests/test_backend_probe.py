@@ -1,0 +1,91 @@
+"""Tunnel-proof demo surface (VERDICT r4 next-round #2): the bounded
+backend probe and the process-level stall supervisor must guarantee the
+documented quickstart completes on any host — wedged accelerator tunnel
+included — with no env vars.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_probe_succeeds_on_responsive_backend():
+    """With JAX_PLATFORMS=cpu in the inherited env (conftest), the probe
+    child answers fast and reports the platform."""
+    from anovos_tpu.shared.backend_probe import probe_default_backend
+
+    platform, diag = probe_default_backend(60)
+    assert platform == "cpu" and diag is None
+
+
+def test_probe_times_out_and_reports(monkeypatch):
+    from anovos_tpu.shared import backend_probe
+
+    monkeypatch.setattr(backend_probe, "PROBE_CODE", "import time; time.sleep(60)")
+    platform, diag = backend_probe.probe_default_backend(2)
+    assert platform is None and "timed out" in diag
+
+
+def test_probe_reports_child_failure(monkeypatch):
+    from anovos_tpu.shared import backend_probe
+
+    monkeypatch.setattr(
+        backend_probe, "PROBE_CODE", "raise RuntimeError('no backend here')"
+    )
+    platform, diag = backend_probe.probe_default_backend(30)
+    assert platform is None and "no backend here" in diag
+
+
+def test_ensure_honors_explicit_platform(monkeypatch):
+    from anovos_tpu.shared import backend_probe
+
+    monkeypatch.setattr(backend_probe, "_PROBED", {})
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert backend_probe.ensure_responsive_backend() == "cpu"
+
+
+def test_supervise_demo_is_noop_in_child_mode(monkeypatch):
+    """With ANOVOS_SUPERVISED=1 the supervisor must return (not re-exec)."""
+    from anovos_tpu.shared import backend_probe
+
+    monkeypatch.setattr(backend_probe, "_PROBED", {})
+    monkeypatch.setenv("ANOVOS_SUPERVISED", "1")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    backend_probe.supervise_demo()  # returns; a re-exec would not
+
+
+SCRIPT = textwrap.dedent(
+    """
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    from anovos_tpu.shared.backend_probe import supervise_demo
+    supervise_demo(stall_timeout_s=4)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        print("completed-on-cpu")
+    else:
+        time.sleep(120)  # simulate a backend that wedged mid-run
+        print("completed-on-accel")
+    """
+).format(repo=REPO)
+
+
+def test_supervised_script_always_completes(tmp_path):
+    """End-to-end supervisor contract: on a wedged host the probe falls
+    back to CPU; on a healthy host the simulated mid-run wedge trips the
+    stall watchdog and the CPU retry completes.  Either way the script
+    finishes with rc=0 — the quickstart guarantee."""
+    script = tmp_path / "demo.py"
+    script.write_text(SCRIPT)
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["ANOVOS_BACKEND_PROBE_TIMEOUT"] = "5"
+    r = subprocess.run(
+        [sys.executable, str(script)],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "completed-on-cpu" in r.stdout

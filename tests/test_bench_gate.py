@@ -29,7 +29,8 @@ def _load_script(name):
 bench = _load_script("bench")
 
 
-def _write_capture(d, ts, backend="tpu", before="tpu-ok", after="tpu-ok", metric=True):
+def _write_capture(d, ts, backend="tpu", before="tpu-ok", after="tpu-ok", metric=True,
+                   probe_unix="coherent"):
     lines = []
     if metric:
         lines.append(json.dumps({
@@ -37,7 +38,12 @@ def _write_capture(d, ts, backend="tpu", before="tpu-ok", after="tpu-ok", metric
             "vs_baseline": 5.8, "backend": backend, "psi_ok": True,
             "e2e_warm_s": 80.0, "e2e_backend": backend,
         }))
-    lines.append(json.dumps({"probe_before": before, "probe_after": after}))
+    bracket = {"probe_before": before, "probe_after": after}
+    if probe_unix == "coherent":
+        bracket["probe_unix"] = ts + 600  # section finished 10 min after start
+    elif probe_unix != "omit":
+        bracket["probe_unix"] = probe_unix
+    lines.append(json.dumps(bracket))
     p = os.path.join(d, f"tpu_capture_{ts}_bench.json")
     with open(p, "w") as f:
         f.write("\n".join(lines) + "\n")
@@ -84,6 +90,40 @@ def test_rejects_stale_and_chained_captures(tmp_path, monkeypatch):
 
 def test_capture_dir_without_files(tmp_path, monkeypatch):
     monkeypatch.setenv("BENCH_CAPTURE_DIR", str(tmp_path))
+    assert bench._attested_capture() is None
+
+
+def test_embedded_probe_clock_cross_check(tmp_path, monkeypatch):
+    """VERDICT r4 #8: the capture script embeds its own wall clock; a
+    capture whose filename timestamp disagrees with the embedded clock
+    (renamed file, skewed clock) must be rejected, while an agreeing one
+    is adopted."""
+    import time
+
+    monkeypatch.setenv("BENCH_CAPTURE_DIR", str(tmp_path))
+    now = int(time.time())
+    # filename claims 1h old, embedded clock says the section finished 12h
+    # before the script allegedly started → skewed/doctored: reject
+    _write_capture(tmp_path, now - 3600, probe_unix=now - 3600 - 12 * 3600)
+    assert bench._attested_capture() is None
+    # embedded clock ~3h in the future (skewed host clock) → reject even
+    # though the filename-vs-embedded drift alone would pass the 6h window
+    _write_capture(tmp_path, now - 7200, probe_unix=now + 10700)
+    assert bench._attested_capture() is None
+    # coherent: section finished 30 min after the script started → adopt
+    _write_capture(tmp_path, now - 3000, probe_unix=now - 3000 + 1800)
+    got = bench._attested_capture()
+    assert got is not None and got[1] == now - 3000
+    # garbage embedded clock → reject
+    for f in os.listdir(tmp_path):
+        os.unlink(os.path.join(tmp_path, f))
+    _write_capture(tmp_path, now - 600, probe_unix="not-a-number")
+    assert bench._attested_capture() is None
+    # MISSING embedded clock → reject (a pre-round-5 capture renamed to a
+    # fresh timestamp must not be adoptable)
+    for f in os.listdir(tmp_path):
+        os.unlink(os.path.join(tmp_path, f))
+    _write_capture(tmp_path, now - 600, probe_unix="omit")
     assert bench._attested_capture() is None
 
 

@@ -170,3 +170,52 @@ def test_batched_silhouettes_match_per_combo():
     assert got_sparse != -1.0
     # 24 valid points ≤ both sample sizes → no resampling on either path
     assert abs(got_sparse - _silhouette(X, sparse)) < 1e-9
+
+
+def test_noisy_grid_winner_selection_stable():
+    """Round-4 advisor: with noise labels the batched estimator samples
+    differently from the per-combo path, so individual scores may shift
+    slightly — but the GRID WINNER (what cluster_analysis actually reports)
+    must not.  A DBSCAN-like grid of noisy labelings of clearly separated
+    blobs must rank the correct labeling first under both estimators."""
+    from anovos_tpu.data_analyzer.geospatial_analyzer import (
+        _silhouette, _silhouettes_batched)
+
+    rng = np.random.default_rng(11)
+    n = 2400
+    X = np.concatenate([
+        rng.normal([0, 0], 0.25, (n // 3, 2)),
+        rng.normal([4, 0], 0.25, (n // 3, 2)),
+        rng.normal([2, 3], 0.25, (n - 2 * (n // 3), 2)),
+    ])
+    D_full = np.sqrt(((X[:, None, :] - X[None, :, :]) ** 2).sum(-1))
+    true3 = np.concatenate([
+        np.zeros(n // 3, np.int64), np.ones(n // 3, np.int64),
+        np.full(n - 2 * (n // 3), 2, np.int64),
+    ])
+    grid = []
+    for noise_frac in (0.05, 0.15, 0.30):
+        lab = true3.copy()
+        lab[rng.choice(n, int(n * noise_frac), replace=False)] = -1
+        grid.append(lab)
+    # two deliberately-bad labelings with noise: random halves, merged pair
+    bad_random = rng.integers(0, 2, n).astype(np.int64)
+    bad_random[rng.choice(n, n // 10, replace=False)] = -1
+    merged = np.where(true3 == 2, 1, true3)
+    merged[rng.choice(n, n // 10, replace=False)] = -1
+    grid += [bad_random, merged]
+
+    batched = _silhouettes_batched(D_full, grid)
+    per_combo = [_silhouette(X, lab, D_full=D_full) for lab in grid]
+    # both estimators pick one of the true-3-cluster labelings, never a bad
+    # one — the winner the analyzer reports is stable across the estimator
+    # change even though near-tied good labelings may swap among themselves
+    assert int(np.argmax(batched)) < 3 and int(np.argmax(per_combo)) < 3
+    # any winner disagreement is confined to near-ties: the batched winner
+    # scores within 0.005 of the per-combo maximum under the per-combo
+    # estimator (and vice versa)
+    assert per_combo[int(np.argmax(batched))] > max(per_combo) - 5e-3
+    assert batched[int(np.argmax(per_combo))] > max(batched) - 5e-3
+    # bad labelings score far below every good one under both estimators
+    assert max(batched[3], batched[4]) < min(batched[:3]) - 0.2
+    assert max(per_combo[3], per_combo[4]) < min(per_combo[:3]) - 0.2

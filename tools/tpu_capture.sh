@@ -9,8 +9,12 @@ cd "$(dirname "$0")/.."
 TS=$(date +%s)
 OUT="tpu_capture_${TS}"
 
-probe() {  # prints tpu-ok | down
-  if timeout 150 python -c "import jax; assert jax.default_backend() != 'cpu'" >/dev/null 2>&1; then
+probe() {  # prints tpu-ok | down; compute-grade (a wedged tunnel can list
+  # devices while every compile/execute hangs — require a jitted round-trip;
+  # one shared definition in anovos_tpu/shared/backend_probe.py).  The
+  # outer shell timeout bounds even a stalled interpreter/import.
+  if timeout --signal=KILL 210 python -m anovos_tpu.shared.backend_probe \
+       --timeout 150 --require-accelerator >/dev/null 2>&1; then
     echo "tpu-ok"
   else
     echo "down"
@@ -28,7 +32,10 @@ section() {  # name, timeout, cmd...
   fi
   timeout "$to" "$@" > "${OUT}_${name}.json" 2> "${OUT}_${name}.err"
   after=$(probe)
-  echo "{\"probe_before\": \"${before}\", \"probe_after\": \"${after}\"}" >> "${OUT}_${name}.json"
+  # probe_unix: the wall clock embedded IN the evidence — bench.py's
+  # attestation cross-checks it against the filename timestamp so a
+  # clock-skewed or renamed capture cannot pass the freshness window
+  echo "{\"probe_before\": \"${before}\", \"probe_after\": \"${after}\", \"probe_unix\": $(date +%s)}" >> "${OUT}_${name}.json"
   tail -2 "${OUT}_${name}.json"
   if [ "$after" != "tpu-ok" ]; then
     echo "WARNING: tunnel dropped during ${name} — numbers may be CPU fallback"
