@@ -35,6 +35,18 @@ from anovos_tpu.drift_stability import stability as dstability
 from anovos_tpu.shared.table import Table
 
 logger = logging.getLogger("anovos_tpu.workflow")
+
+# per-block wall times of the most recent main() run — the reference logs
+# these per block (workflow.py:227-244); recording them machine-readably as
+# well lets the e2e suite assert a committed per-block budget
+# (tests/golden/e2e_block_budget.csv) so perf regressions fail loudly
+BLOCK_TIMES: dict = {}
+
+
+def _log_block_time(label: str, start: float) -> None:
+    secs = round(timeit.default_timer() - start, 4)
+    BLOCK_TIMES[label] = round(BLOCK_TIMES.get(label, 0.0) + secs, 4)
+    logger.info(f"{label}: execution time (in secs) = {secs}")
 logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
 
 
@@ -173,6 +185,7 @@ def _auth_key(auth_key_val: dict) -> str:
 
 def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) -> None:
     start_main = timeit.default_timer()
+    BLOCK_TIMES.clear()  # the table always describes the most recent run
     auth_key = _auth_key(auth_key_val)
     df = ETL(all_configs.get("input_dataset"))
 
@@ -212,7 +225,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                     *idfs, method_type=args.get("method", args.get("method_type", "name"))
                 )
                 df = save(df, write_intermediate, "data_ingest/concatenate_dataset", reread=True)
-                logger.info(f"{key}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}")
+                _log_block_time(key, start)
                 continue
 
             if key == "join_dataset" and args is not None:
@@ -222,7 +235,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                     *idfs, join_cols=args.get("join_cols"), join_type=args.get("join_type")
                 )
                 df = save(df, write_intermediate, "data_ingest/join_dataset", reread=True)
-                logger.info(f"{key}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}")
+                _log_block_time(key, start)
                 continue
 
             if key == "timeseries_analyzer" and args is not None:
@@ -258,7 +271,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                         )
                 except Exception:
                     logger.exception("ts inspection failed; continuing without ts analysis")
-                logger.info(f"{key}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}")
+                _log_block_time(key, start)
                 continue
 
             if key == "geospatial_controller" and args is not None:
@@ -281,15 +294,13 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                         )
                     except Exception:
                         logger.exception("geospatial_analyzer failed; continuing without geo analysis")
-                    logger.info(
-                        f"{key}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
-                    )
+                    _log_block_time(key, start)
                 continue
 
             if key == "anovos_basic_report" and args is not None and args.get("basic_report", False):
                 start = timeit.default_timer()
                 anovos_basic_report(df, **args.get("report_args", {}), run_type=run_type, auth_key=auth_key)
-                logger.info(f"Basic Report: execution time (in secs) = {round(timeit.default_timer() - start, 4)}")
+                _log_block_time("Basic Report", start)
                 continue
 
             if basic_report_flag:
@@ -303,7 +314,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                         save_stats(df_stats, report_input_path, m, reread=True, run_type=run_type, auth_key=auth_key)
                     else:
                         save(df_stats, write_stats, "data_analyzer/stats_generator/" + m, reread=True)
-                    logger.info(f"{key}, {m}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}")
+                    _log_block_time(f"{key}, {m}", start)
 
             if key == "quality_checker" and args is not None:
                 for subkey, value in args.items():
@@ -328,9 +339,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                         save_stats(df_stats, report_input_path, subkey, reread=True, run_type=run_type, auth_key=auth_key)
                     else:
                         save(df_stats, write_stats, "data_analyzer/quality_checker/" + subkey, reread=True)
-                    logger.info(
-                        f"{key}, {subkey}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
-                    )
+                    _log_block_time(f"{key}, {subkey}", start)
 
             if key == "association_evaluator" and args is not None:
                 for subkey, value in args.items():
@@ -350,9 +359,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                         save_stats(df_stats, report_input_path, subkey, reread=True, run_type=run_type, auth_key=auth_key)
                     else:
                         save(df_stats, write_stats, "data_analyzer/association_evaluator/" + subkey, reread=True)
-                    logger.info(
-                        f"{key}, {subkey}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
-                    )
+                    _log_block_time(f"{key}, {subkey}", start)
 
             if key == "drift_detector" and args is not None:
                 for subkey, value in args.items():
@@ -378,9 +385,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                                 save_stats(metrics.to_pandas(), report_input_path, "stabilityIndex_metrics", run_type=run_type, auth_key=auth_key)
                     else:
                         save(df_stats, write_stats, "drift_detector/" + subkey, reread=True)
-                    logger.info(
-                        f"{key}, {subkey}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
-                    )
+                    _log_block_time(f"{key}, {subkey}", start)
 
             if key == "transformers" and args is not None:
                 for subkey, value in args.items():
@@ -396,9 +401,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                         df = save(
                             df, write_intermediate, "data_transformer/transformers/" + subkey2, reread=True
                         )
-                        logger.info(
-                            f"{key}, {subkey2}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
-                        )
+                        _log_block_time(f"{key}, {subkey2}", start)
 
             if key == "report_preprocessing" and args is not None:
                 for subkey, value in args.items():
@@ -406,16 +409,12 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                         start = timeit.default_timer()
                         extra_args = stats_args(all_configs, subkey, run_type, auth_key)
                         charts_to_objects(df, **value, **extra_args, master_path=report_input_path, run_type=run_type, auth_key=auth_key)
-                        logger.info(
-                            f"{key}, {subkey}: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
-                        )
+                        _log_block_time(f"{key}, {subkey}", start)
 
             if key == "report_generation" and args is not None:
                 start = timeit.default_timer()
                 anovos_report(**args, run_type=run_type, auth_key=auth_key)
-                logger.info(
-                    f"{key}, full_report: execution time (in secs) = {round(timeit.default_timer() - start, 4)}"
-                )
+                _log_block_time(f"{key}, full_report", start)
 
         # feast export adds its timestamp columns BEFORE the single final
         # write (reference :854-866); config validated up front (ref :173-182)

@@ -283,6 +283,7 @@ def e2e_cold_warm() -> dict:
     from anovos_tpu import workflow
 
     out = {}
+    blocks = {}
     cwd = os.getcwd()
     for label in ("cold", "warm"):
         with tempfile.TemporaryDirectory() as d:
@@ -291,18 +292,23 @@ def e2e_cold_warm() -> dict:
                 t0 = time.perf_counter()
                 workflow.run(E2E_CONFIG, "local")
                 out[label] = round(time.perf_counter() - t0, 1)
+                blocks = dict(workflow.BLOCK_TIMES)
             finally:
                 os.chdir(cwd)
     try:
         n_rows = _e2e_rows()
     except Exception:
         n_rows = 32561  # income dataset fallback
+    top_blocks = dict(sorted(blocks.items(), key=lambda kv: -kv[1])[:8])
     return {
         "e2e_cold_s": out["cold"],
         "e2e_warm_s": out["warm"],
         "e2e_rows": n_rows,
         "e2e_warm_rows_per_sec_per_chip": round(n_rows / out["warm"], 1),
         "e2e_backend": jax.default_backend(),
+        # warm per-block hot spots (full table + regression budget:
+        # tests/golden/e2e_block_budget.csv)
+        "e2e_warm_blocks": {k: round(v, 2) for k, v in top_blocks.items()},
     }
 
 

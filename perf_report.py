@@ -222,6 +222,7 @@ def bench_e2e() -> dict:
         "warm_wall_s": r["e2e_warm_s"],
         "rows_per_sec_per_chip": round(r["e2e_rows"] / r["e2e_cold_s"], 1),
         "warm_rows_per_sec_per_chip": r["e2e_warm_rows_per_sec_per_chip"],
+        "warm_blocks": r.get("e2e_warm_blocks", {}),
     }
 
 
@@ -372,6 +373,13 @@ def _write_md(r: dict) -> None:
         if "warm_wall_s" in e:
             lines.append(f"| | warm wall | {e['warm_wall_s']} s |")
             lines.append(f"| | warm rows/sec/chip (headline) | {e['warm_rows_per_sec_per_chip']} |")
+        for blk, secs in (e.get("warm_blocks") or {}).items():
+            lines.append(f"| | warm block: {blk} | {secs} s |")
+        if e.get("warm_blocks"):
+            lines.append(
+                "| | per-block budget | tests/golden/e2e_block_budget.csv "
+                "(asserted by test_workflow_e2e.py) |"
+            )
     elif e:
         lines.append(f"| configs_full e2e | error | {e.get('error', '?')[:100]} |")
     lines += [

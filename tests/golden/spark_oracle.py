@@ -1,0 +1,222 @@
+"""Self-closing Spark-oracle leg for the golden fixtures (VERDICT r4 #4).
+
+The committed ``tests/golden/*.csv`` are an independently-written
+pandas/numpy ENCODING of the reference's semantics (see
+generate_golden.py) — not reference output, because this image has no
+JVM.  This module closes that epistemic gap the first time a Java
+environment appears: it runs the ACTUAL reference implementation
+(anovos/anovos under pyspark, local[*]) on the same golden inputs,
+regenerates the oracle-mapped fixtures, and diffs them against the
+committed pandas encodings.
+
+Oracle-mapped fixtures (12): counts, central, cardinality, dispersion,
+percentiles, shape, drift, correlation, iv, ig, duplicates, nullrows.
+The remaining fixtures (binning cutpoints, scaler fit params, stability,
+invalid entries, outlier fences) encode model-artifact internals whose
+extraction from the reference needs model-path plumbing — the pandas
+encoding stays authoritative for those and they are listed as unmapped.
+
+Tolerances: metrics computed with exact arithmetic on both sides diff at
+rel 1e-3 (rounding to 4dp is the fixture contract); percentile-family
+fields (median, percentile grid, IQR-derived) allow rel 1e-2 because the
+reference computes them via Spark's approxQuantile.
+
+Usage:
+    python tests/golden/generate_golden.py --from-spark [--write] [--diff]
+Exit codes: 0 ok, 3 unavailable (no JVM/pyspark/reference — CI skips).
+"""
+
+import glob
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REFERENCE_SRC = os.environ.get("ANOVOS_REFERENCE_SRC", "/root/reference/src/main")
+DATA = os.environ.get(
+    "ANOVOS_GOLDEN_DATA",
+    "/root/reference/examples/data/income_dataset/parquet",
+)
+
+NUM_COLS = [
+    "age", "fnlwgt", "logfnl", "education-num", "capital-gain",
+    "capital-loss", "hours-per-week", "latitude", "longitude",
+]
+CAT_COLS = [
+    "workclass", "education", "marital-status", "occupation",
+    "relationship", "race", "sex", "native-country", "income",
+]
+LABEL_COL, EVENT = "income", ">50K"
+
+# fixture -> (columns compared, tolerance class)
+ORACLE_MAPPED = {
+    "golden_counts.csv": "exact",
+    "golden_central.csv": "quantile",   # median via approxQuantile
+    "golden_cardinality.csv": "exact",
+    "golden_dispersion.csv": "quantile",  # IQR via approxQuantile
+    "golden_percentiles.csv": "quantile",
+    "golden_shape.csv": "exact",
+    "golden_drift.csv": "exact",
+    "golden_correlation.csv": "exact",
+    "golden_iv.csv": "quantile",        # equal-frequency cutoffs
+    "golden_ig.csv": "quantile",
+    "golden_duplicates.csv": "exact",
+    "golden_nullrows.csv": "exact",
+}
+UNMAPPED = [
+    "golden_binning.csv", "golden_scalers.csv", "golden_stability.csv",
+    "golden_invalid_entries.csv", "golden_outlier.csv",
+]
+RTOL = {"exact": 1e-3, "quantile": 1e-2}
+
+
+def available():
+    """(ok, reason): can the reference actually run here?"""
+    if shutil.which("java") is None:
+        return False, "no JVM (java not on PATH)"
+    try:
+        import pyspark  # noqa: F401
+    except ImportError:
+        return False, "pyspark not installed"
+    if not os.path.isdir(REFERENCE_SRC):
+        return False, f"reference source not found at {REFERENCE_SRC}"
+    if not glob.glob(os.path.join(DATA, "*.parquet")):
+        return False, f"golden input data not found at {DATA}"
+    return True, "ok"
+
+
+def _spark():
+    from pyspark.sql import SparkSession
+
+    return (
+        SparkSession.builder.master("local[*]")
+        .appName("golden-oracle")
+        .config("spark.driver.memory", "4g")
+        .config("spark.sql.shuffle.partitions", "8")
+        .getOrCreate()
+    )
+
+
+def _round_frame(pdf: pd.DataFrame) -> pd.DataFrame:
+    for c in pdf.columns:
+        if pd.api.types.is_float_dtype(pdf[c]):
+            pdf[c] = pdf[c].round(4)
+    return pdf
+
+
+def regenerate() -> dict:
+    """Run the reference on the golden inputs; return {fixture: DataFrame}."""
+    sys.path.insert(0, REFERENCE_SRC)
+    from anovos.data_analyzer import association_evaluator as ae
+    from anovos.data_analyzer import quality_checker as qc
+    from anovos.data_analyzer import stats_generator as sg
+    from anovos.drift_stability import drift_detector as dd
+
+    spark = _spark()
+    idf = spark.read.parquet(DATA).select(NUM_COLS + CAT_COLS)
+    idf.persist()
+    n = idf.count()
+    out = {}
+
+    out["golden_counts.csv"] = sg.measures_of_counts(spark, idf).toPandas()
+    out["golden_central.csv"] = sg.measures_of_centralTendency(spark, idf).toPandas()
+    out["golden_cardinality.csv"] = sg.measures_of_cardinality(spark, idf).toPandas()
+    out["golden_dispersion.csv"] = sg.measures_of_dispersion(spark, idf).toPandas()
+    out["golden_percentiles.csv"] = sg.measures_of_percentiles(spark, idf).toPandas()
+    out["golden_shape.csv"] = sg.measures_of_shape(spark, idf).toPandas()
+
+    # drift: same halves as generate_golden.load() — row order of the
+    # parquet read is deterministic for a local sorted file list
+    pdf = idf.toPandas()
+    src = spark.createDataFrame(pdf.iloc[: n // 2])
+    tgt = spark.createDataFrame(pdf.iloc[n // 2:])
+    with tempfile.TemporaryDirectory() as d:
+        drift = dd.statistics(
+            spark, tgt, src, method_type="all", use_sampling=False,
+            source_path=os.path.join(d, "drift_src"),
+        ).toPandas()
+    out["golden_drift.csv"] = drift
+
+    out["golden_correlation.csv"] = ae.correlation_matrix(
+        spark, idf.select(NUM_COLS)
+    ).toPandas()
+    out["golden_iv.csv"] = ae.IV_calculation(
+        spark, idf, label_col=LABEL_COL, event_label=EVENT
+    ).toPandas()
+    out["golden_ig.csv"] = ae.IG_calculation(
+        spark, idf, label_col=LABEL_COL, event_label=EVENT
+    ).toPandas()
+
+    dup_input = idf.union(idf.limit(500))  # fixture appends first 500 rows
+    out["golden_duplicates.csv"] = qc.duplicate_detection(
+        spark, dup_input, treatment=False
+    )[1].toPandas()
+    out["golden_nullrows.csv"] = qc.nullRows_detection(
+        spark, idf, treatment=False, treatment_threshold=0.1
+    )[1].toPandas()
+
+    return {k: _round_frame(v) for k, v in out.items()}
+
+
+def diff(regen: dict) -> list:
+    """Compare regenerated oracle output to the committed pandas encodings.
+
+    Returns a list of failure strings (empty = parity)."""
+    failures = []
+    for name, got in regen.items():
+        path = os.path.join(HERE, name)
+        want = pd.read_csv(path)
+        tol = RTOL[ORACLE_MAPPED[name]]
+        key = "attribute" if "attribute" in want.columns else want.columns[0]
+        if key in got.columns:
+            got = got.set_index(key).reindex(want[key]).reset_index()
+        for c in want.columns:
+            if c not in got.columns:
+                failures.append(f"{name}: column {c!r} missing from oracle output")
+                continue
+            w, g = want[c], got[c]
+            if pd.api.types.is_numeric_dtype(w):
+                wv = w.to_numpy(float)
+                gv = pd.to_numeric(g, errors="coerce").to_numpy(float)
+                both = ~(np.isnan(wv) | np.isnan(gv))
+                if (np.isnan(wv) != np.isnan(gv)).any():
+                    failures.append(f"{name}.{c}: null-pattern mismatch")
+                scale = np.maximum(np.abs(wv[both]), 1e-4)
+                bad = np.abs(wv[both] - gv[both]) / scale > tol
+                if bad.any():
+                    i = int(np.nonzero(bad)[0][0])
+                    failures.append(
+                        f"{name}.{c}: {int(bad.sum())} values beyond rtol={tol} "
+                        f"(first: want {wv[both][i]}, got {gv[both][i]})"
+                    )
+            else:
+                if not w.astype(str).equals(g.astype(str)):
+                    failures.append(f"{name}.{c}: string column mismatch")
+    return failures
+
+
+def main(argv) -> int:
+    ok, reason = available()
+    if not ok:
+        print(f"spark-oracle unavailable: {reason} (skipping)")
+        return 3
+    regen = regenerate()
+    if "--write" in argv:
+        for name, pdf in regen.items():
+            pdf.to_csv(os.path.join(HERE, name), index=False)
+            print(f"regenerated {name} from the Spark oracle ({len(pdf)} rows)")
+    if "--diff" in argv or "--write" not in argv:
+        failures = diff(regen)
+        print(f"oracle-mapped fixtures: {len(regen)}; unmapped "
+              f"(pandas encoding authoritative): {len(UNMAPPED)}")
+        if failures:
+            print("ORACLE DIVERGENCE:")
+            for f in failures:
+                print(" -", f)
+            return 1
+        print("oracle parity: all mapped fixtures agree within tolerance")
+    return 0

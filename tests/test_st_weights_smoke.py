@@ -1,0 +1,80 @@
+"""sentence-transformers weights smoke (VERDICT r4 next-round #7) —
+skip-if-absent, self-closing.
+
+This image has neither the sentence-transformers weights nor egress to
+fetch them, so the real-weights leg of the recommender's embedding
+backend (featrec_init loader, reference featrec_init.py:42-59) has never
+executed anywhere.  This test downloads NOTHING: it looks for
+all-mpnet-base-v2 in the well-known local cache locations and, when
+found, loads it cache-only, runs one embed, and sanity-checks semantic
+cosine ranking — agreeing with the hashed-JL stand-in backend on an easy
+triplet.  Here it skips with the exact reason; the first environment
+with cached weights turns it green with no code change.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+MODEL = "all-mpnet-base-v2"
+
+
+def _cached_weights_path():
+    """First existing local copy of the model, never the network."""
+    home = os.path.expanduser("~")
+    candidates = [os.environ.get("FR_MODEL_PATH", "")]
+    candidates += [
+        os.path.join(home, ".cache", "torch", "sentence_transformers",
+                     f"sentence-transformers_{MODEL}"),
+    ]
+    candidates += sorted(glob.glob(os.path.join(
+        home, ".cache", "huggingface", "hub",
+        f"models--sentence-transformers--{MODEL}", "snapshots", "*",
+    )))
+    for p in candidates:
+        # a real snapshot has the transformer config at its root
+        if p and os.path.isdir(p) and os.path.exists(os.path.join(p, "config.json")):
+            return p
+    return None
+
+
+def test_sentence_transformers_weights_smoke(monkeypatch):
+    pytest.importorskip(
+        "sentence_transformers",
+        reason="sentence-transformers not installed in this image",
+    )
+    path = _cached_weights_path()
+    if path is None:
+        pytest.skip(f"{MODEL} weights not cached locally (no egress to fetch)")
+
+    from anovos_tpu.feature_recommender import featrec_init as fi
+
+    monkeypatch.setenv("FR_MODEL_PATH", path)
+    monkeypatch.setenv("FR_BACKEND", "sentence-transformers")
+    fi.reset_model()
+    try:
+        model = fi.get_model()
+        assert model.backend == "sentence-transformers"
+        texts = [
+            "credit card outstanding balance",
+            "amount due on the credit card",
+            "daily rainfall in millimeters",
+        ]
+        emb = model.encode(texts)
+        assert emb.shape[0] == 3 and emb.shape[1] >= 128
+        norm = emb / np.linalg.norm(emb, axis=1, keepdims=True)
+        sim = norm @ norm.T
+        # semantic sanity: the two card descriptions are closer to each
+        # other than either is to the weather line
+        assert sim[0, 1] > sim[0, 2] and sim[0, 1] > sim[1, 2]
+
+        # the hashed-JL stand-in must agree on this easy ranking — that is
+        # the claim that lets weightless environments trust the JL path
+        jl = fi._HashedProjectionEncoder().encode(texts)
+        jl = jl / np.linalg.norm(jl, axis=1, keepdims=True)
+        jsim = jl @ jl.T
+        assert jsim[0, 1] > jsim[0, 2]
+    finally:
+        fi.reset_model()

@@ -1,0 +1,77 @@
+"""Record the per-block wall-time budget for the configs_full e2e run
+(VERDICT r4 next-round #6).
+
+Runs configs_full twice in one process on the SAME 8-virtual-device CPU
+mesh the test suite uses (cold pass compiles, warm pass is the measured
+steady state), then writes tests/golden/e2e_block_budget.csv with one row
+per workflow block: the recorded warm wall and a budget of
+3 x warm + 0.5 s (floor 1.0 s — sub-second blocks jitter up to ~2.5x
+under full-suite memory/cache contention, measured; the tripwire targets
+round-4-class regressions, which were 5-10x).  tests/test_workflow_e2e.py
+asserts a fresh warm run stays inside the budget, so a block-level perf
+regression fails the suite instead of waiting for the next round of
+manual profiling.
+
+Usage:
+    python tools/record_block_budget.py       # writes the budget CSV
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import pandas as pd  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG = os.path.join(REPO, "config", "configs_full.yaml")
+BUDGET_CSV = os.path.join(REPO, "tests", "golden", "e2e_block_budget.csv")
+
+
+def run_cold_warm() -> dict:
+    import tempfile
+
+    from anovos_tpu import workflow
+
+    cwd = os.getcwd()
+    times = {}
+    for label in ("cold", "warm"):
+        with tempfile.TemporaryDirectory() as d:
+            os.chdir(d)
+            try:
+                workflow.run(CONFIG, "local")
+                times[label] = dict(workflow.BLOCK_TIMES)
+            finally:
+                os.chdir(cwd)
+    return times
+
+
+def main() -> None:
+    times = run_cold_warm()
+    warm = times["warm"]
+    rows = [
+        {
+            "block": k,
+            "warm_s": round(v, 3),
+            "budget_s": max(1.0, round(3.0 * v + 0.5, 1)),
+        }
+        for k, v in warm.items()
+    ]
+    pd.DataFrame(rows).to_csv(BUDGET_CSV, index=False)
+    total = sum(warm.values())
+    print(f"warm configs_full: {total:.1f}s over {len(rows)} blocks -> {BUDGET_CSV}")
+    for r in sorted(rows, key=lambda r: -r["warm_s"])[:10]:
+        print(f"  {r['block']}: {r['warm_s']}s (budget {r['budget_s']}s)")
+
+
+if __name__ == "__main__":
+    main()

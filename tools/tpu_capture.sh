@@ -42,11 +42,51 @@ section() {  # name, timeout, cmd...
   fi
 }
 
+tests_phase() {  # budgeted, RESUMABLE on-chip correctness sweep
+  # (VERDICT r4 next-round #5): runs the suite file-by-file with
+  # ANOVOS_TEST_TPU=1 on the real chip, appending one line per file to a
+  # manifest that survives across capture windows — a file whose latest
+  # verdict is already "pass" is skipped on resume, so interrupted windows
+  # accumulate into a complete hardware-correctness record.  The sweep has
+  # caught TPU-only failure classes the CPU mesh can never see (bf16 MXU
+  # defaults, f32 transcendentals — PERF.md "On-hardware correctness").
+  local budget="${TPU_TESTS_BUDGET:-2400}" per_file="${TPU_TESTS_FILE_TIMEOUT:-420}"
+  local manifest="tpu_tests_manifest.tsv" deadline=$(( $(date +%s) + budget ))
+  echo "== tests (budget ${budget}s, manifest ${manifest}) =="
+  [ -f "$manifest" ] || echo -e "file\tstatus\tseconds\tunix" > "$manifest"
+  for f in tests/test_*.py; do
+    # resumable: latest verdict for this file wins
+    if [ "$(awk -F'\t' -v f="$f" '$1==f{s=$2} END{print s}' "$manifest")" = "pass" ]; then
+      continue
+    fi
+    if [ "$(date +%s)" -ge "$deadline" ]; then
+      echo "tests budget exhausted; manifest resumes next window"; break
+    fi
+    if [ "$(probe)" != "tpu-ok" ]; then
+      echo -e "$f\tskip-probe\t0\t$(date +%s)" >> "$manifest"
+      echo "tunnel down mid-sweep; stopping tests phase"; break
+    fi
+    local t0=$(date +%s) status
+    if timeout --signal=KILL "$per_file" env ANOVOS_TEST_TPU=1 \
+         python -m pytest "$f" -q > "${OUT}_tests_$(basename "$f" .py).log" 2>&1; then
+      status=pass
+    else
+      status=fail
+    fi
+    echo -e "$f\t$status\t$(( $(date +%s) - t0 ))\t$(date +%s)" >> "$manifest"
+    echo "  $f: $status"
+  done
+  echo "== tests manifest summary =="
+  awk -F'\t' 'NR>1{s[$1]=$2} END{for (f in s) c[s[f]]++; for (k in c) print k, c[k]}' "$manifest"
+}
+
 if [ "$(probe)" != "tpu-ok" ]; then echo "tunnel down; aborting"; exit 1; fi
+if [ "${1:-}" = "--tests" ]; then tests_phase; exit 0; fi
 # the ae sweep runs 4 configs (each a remote compile); it flushes a
 # cumulative result line per config, so even a timeout keeps the finished
 # part — but give it room for the compiles
 section ae 1200 python perf_report.py --section ae
 section bench 3500 env BENCH_TPU_PROBE_TIMEOUT=300 python bench.py
 section pallas 580 env ANOVOS_USE_PALLAS=1 python perf_report.py --section hist
+if [ "${1:-}" != "--no-tests" ]; then tests_phase; fi
 echo "== done: ${OUT}_*.json =="
