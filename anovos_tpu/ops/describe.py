@@ -24,15 +24,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from anovos_tpu.shared.runtime import column_parallel, wants_column_parallel
 from anovos_tpu.shared.table import Table
 
 # the percentile grid every consumer shares (measures_of_percentiles order)
 PCTL_QS = (0.0, 0.01, 0.05, 0.10, 0.25, 0.50, 0.75, 0.90, 0.95, 0.99, 1.0)
 
 
-@jax.jit
 def describe_numeric(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
-    """One program: moments + percentiles + distinct counts for (rows, k)."""
+    """One program: moments + percentiles + distinct counts for (rows, k).
+
+    The sort-based statistics run column-parallel on a multi-device mesh
+    (see runtime.column_parallel); moments stay on the input's row
+    sharding (partial-sum + psum)."""
+    return _describe_numeric(X, M, cp=wants_column_parallel(X, M))
+
+
+@functools.partial(jax.jit, static_argnames=("cp",))
+def _describe_numeric(X: jax.Array, M: jax.Array, *, cp: bool = False) -> Dict[str, jax.Array]:
     dt = jnp.float32
     Xf = X.astype(dt)
     # exact integer valid count — a float32 ones-sum plateaus at 2^24 rows
@@ -53,9 +62,13 @@ def describe_numeric(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
     kurt = jnp.where(m2p > 0, (m4 / safe_n) / jnp.maximum(m2p * m2p, 1e-38) - 3.0, jnp.nan)
     nonzero = (M & (Xf != 0)).sum(axis=0, dtype=jnp.int32).astype(dt)
 
-    # ONE sort feeds percentiles AND distinct counts
+    # ONE sort feeds percentiles AND distinct counts.  The sort input is
+    # re-laid column-parallel first: a sort along the row-sharded axis
+    # would emit O(log n) cross-device partition exchanges, while one
+    # small all-to-all makes the sort and everything derived from it
+    # device-local (runtime.column_parallel).
     big = jnp.asarray(jnp.finfo(dt).max, dt)
-    Xs = jnp.sort(jnp.where(M, Xf, big), axis=0)
+    Xs = jnp.sort(column_parallel(jnp.where(M, Xf, big), cp), axis=0)
     rows = X.shape[0]
     pos_idx = jnp.arange(rows, dtype=jnp.int32)[:, None]
     valid_sorted = pos_idx < n_int[None, :]
@@ -171,18 +184,23 @@ def _compensated_enabled(rows: int) -> bool:
     return rows >= _COMPENSATED_AUTO_ROWS
 
 
-@jax.jit
 def describe_wide_int(hi: jax.Array, lo: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
     """Exact order statistics for wide-int64 columns stored as (hi, lo) int32
     pairs (Table docstring encoding: signed lexicographic pair order == int64
     numeric order).  One program: lexicographic sort via two stable argsorts,
     then distinct count, percentile grid, and mode — all int32 ops, no f32
     precision loss (TPUs have no native int64)."""
+    return _describe_wide_int(hi, lo, M, cp=wants_column_parallel(hi, lo, M))
+
+
+@functools.partial(jax.jit, static_argnames=("cp",))
+def _describe_wide_int(hi: jax.Array, lo: jax.Array, M: jax.Array, *, cp: bool = False) -> Dict[str, jax.Array]:
     rows, k = hi.shape
     n_int = M.sum(axis=0, dtype=jnp.int32)
     big = jnp.iinfo(jnp.int32).max
-    hi_s = jnp.where(M, hi, big)
-    lo_s = jnp.where(M, lo, big)
+    # column-parallel re-lay before the double argsort (runtime.column_parallel)
+    hi_s = column_parallel(jnp.where(M, hi, big), cp)
+    lo_s = column_parallel(jnp.where(M, lo, big), cp)
     perm1 = jnp.argsort(lo_s, axis=0, stable=True)
     hi1 = jnp.take_along_axis(hi_s, perm1, axis=0)
     lo1 = jnp.take_along_axis(lo_s, perm1, axis=0)

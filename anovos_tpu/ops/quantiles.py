@@ -18,8 +18,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from anovos_tpu.shared.runtime import column_parallel, wants_column_parallel
 
-@functools.partial(jax.jit, static_argnames=("interpolation",))
+
 def masked_quantiles(
     X: jax.Array, M: jax.Array, qs: jax.Array, interpolation: str = "linear"
 ) -> jax.Array:
@@ -29,10 +30,22 @@ def masked_quantiles(
     Returns (q, k).  Invalid entries sort to +inf; the gather index is scaled
     by each column's true valid count.  ``interpolation``: 'linear' (numpy
     default) or 'lower' (Spark approxQuantile returns actual elements).
+    On a multi-device mesh the sort runs column-parallel
+    (runtime.column_parallel).
     """
+    return _masked_quantiles(
+        X, M, qs, interpolation=interpolation, cp=wants_column_parallel(X, M)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpolation", "cp"))
+def _masked_quantiles(
+    X: jax.Array, M: jax.Array, qs: jax.Array,
+    interpolation: str = "linear", cp: bool = False,
+) -> jax.Array:
     dt = X.dtype if X.dtype in (jnp.float32, jnp.float64) else jnp.float32
     big = jnp.asarray(jnp.finfo(dt).max, dt)
-    Xs = jnp.sort(jnp.where(M, X.astype(dt), big), axis=0)  # (rows, k)
+    Xs = jnp.sort(column_parallel(jnp.where(M, X.astype(dt), big), cp), axis=0)  # (rows, k)
     n = M.sum(axis=0)  # (k,)
     pos = qs[:, None] * jnp.maximum(n[None, :] - 1, 0)  # (q, k)
     lo = jnp.floor(pos).astype(jnp.int32)

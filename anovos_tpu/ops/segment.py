@@ -15,18 +15,25 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from anovos_tpu.shared.runtime import column_parallel, wants_column_parallel
 
-@jax.jit
+
 def masked_nunique(X: jax.Array, M: jax.Array) -> jax.Array:
     """Exact distinct count per column (valid entries only).
 
     X: (rows, k) — any numeric (cat codes included); M: (rows, k) bool.
     Sort each column with invalid → +inf, count value transitions among the
-    first n valid slots.
+    first n valid slots.  On a multi-device mesh the sort runs
+    column-parallel (runtime.column_parallel).
     """
+    return _masked_nunique(X, M, cp=wants_column_parallel(X, M))
+
+
+@functools.partial(jax.jit, static_argnames=("cp",))
+def _masked_nunique(X: jax.Array, M: jax.Array, cp: bool = False) -> jax.Array:
     dt = jnp.float32 if X.dtype not in (jnp.float32, jnp.float64) else X.dtype
     big = jnp.asarray(jnp.finfo(dt).max, dt)
-    Xs = jnp.sort(jnp.where(M, X.astype(dt), big), axis=0)
+    Xs = jnp.sort(column_parallel(jnp.where(M, X.astype(dt), big), cp), axis=0)
     n = M.sum(axis=0)  # (k,)
     rows = X.shape[0]
     pos = jnp.arange(rows)[:, None]

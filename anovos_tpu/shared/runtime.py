@@ -55,6 +55,11 @@ class Runtime:
         """Sharding for (rows,) or (rows, cols) arrays: rows over 'data'."""
         return NamedSharding(self.mesh, P(self.data_axis))
 
+    def column_parallel_sharding(self) -> NamedSharding:
+        """(rows, k) re-laid column-parallel: each device holds whole
+        columns (columns spread over the data axis)."""
+        return NamedSharding(self.mesh, P(None, self.data_axis))
+
     def row_col_sharding(self, shard_cols: bool = False) -> NamedSharding:
         spec = P(self.data_axis, self.model_axis if shard_cols else None)
         return NamedSharding(self.mesh, spec)
@@ -141,3 +146,53 @@ def get_runtime() -> Runtime:
     if _RUNTIME is None:
         _RUNTIME = init_runtime()
     return _RUNTIME
+
+
+def column_parallel(a: jax.Array, cp: bool = True) -> jax.Array:
+    """Order-statistics layout constraint for a (rows, k) block.
+
+    A sort along the row-sharded axis is the worst collective pattern
+    GSPMD can emit — O(log n) cross-device partition exchanges per sort
+    (measured: describe_numeric 6.5 s vs 0.07 s on the 8-virtual-device
+    mesh at 32k x 9).  Re-laying the block column-parallel costs ONE small
+    all-to-all, after which every downstream sort / take_along_axis /
+    cummax is device-local; column-wise reductions of the result come back
+    over the same axis.  Moments and other row-reductions should stay on
+    the row sharding (partial-sum + psum is optimal there) — apply this
+    only to the input of sort-based statistics.
+
+    Apply INSIDE a jit, passing the kernel's static ``cp`` argument —
+    computed by :func:`wants_column_parallel` on the jit's CONCRETE inputs
+    (a committed single-device array constrained onto a multi-device mesh
+    is an incompatible-devices error).  No-op when ``cp`` is false, on a
+    1-device mesh, or before the runtime exists.
+    """
+    if not cp or _RUNTIME is None or _RUNTIME.mesh.size == 1:
+        return a
+    return jax.lax.with_sharding_constraint(
+        a, _RUNTIME.column_parallel_sharding()
+    )
+
+
+def wants_column_parallel(*arrays) -> bool:
+    """Gate for :func:`column_parallel`, evaluated on CONCRETE jit inputs.
+
+    True iff the runtime mesh is multi-device and every given array
+    verifiably lives on exactly that mesh's devices.  Tracers (nested-jit
+    callers) and committed single-device arrays return False — the
+    constraint would either be unverifiable or an incompatible-devices
+    error; the kernel then runs unconstrained, which is merely the old
+    layout, never wrong.
+    """
+    rt = _RUNTIME
+    if rt is None or rt.mesh.size == 1:
+        return False
+    mesh_devs = set(rt.mesh.devices.flat)
+    for a in arrays:
+        try:
+            ds = a.sharding.device_set
+        except Exception:
+            return False
+        if set(ds) != mesh_devs:
+            return False
+    return True
