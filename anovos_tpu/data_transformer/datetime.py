@@ -614,14 +614,30 @@ def _bucket_start_secs(ids: np.ndarray, grain: str) -> np.ndarray:
     return ids * mult
 
 
-@_functools.partial(jax.jit, static_argnames=("nseg",))
 def _segment_aggregate(ids0: jax.Array, valid: jax.Array, V: jax.Array, Mv: jax.Array, nseg: int):
     """Per-bucket count/sum/sumsq/min/max/median for every value column.
 
     ids0: (rows,) int32 bucket ids already offset to [0, nseg); valid:
     (rows,) row validity; V: (rows, k) f32 values; Mv: (rows, k) value
     validity.  Median comes from a per-column sort by (bucket, value) +
-    cumulative-count indexed gathers — one program, no host loop."""
+    cumulative-count indexed gathers — one program, no host loop.  On a
+    multi-device mesh the block is re-laid column-parallel (each device
+    lexsorts whole columns locally; ids/validity replicate) — see
+    runtime.column_parallel."""
+    from anovos_tpu.shared.runtime import wants_column_parallel
+
+    return _segment_aggregate_jit(
+        ids0, valid, V, Mv, nseg, cp=wants_column_parallel(ids0, valid, V, Mv)
+    )
+
+
+@_functools.partial(jax.jit, static_argnames=("nseg", "cp"))
+def _segment_aggregate_jit(ids0: jax.Array, valid: jax.Array, V: jax.Array,
+                           Mv: jax.Array, nseg: int, cp: bool = False):
+    from anovos_tpu.shared.runtime import column_parallel, replicated
+
+    V, Mv = column_parallel(V, cp), column_parallel(Mv, cp)
+    ids0, valid = replicated(ids0, cp), replicated(valid, cp)
     seg = jnp.where(valid, ids0, nseg)
     k = V.shape[1]
     ones = jnp.ones_like(seg, jnp.float32)

@@ -174,6 +174,17 @@ def column_parallel(a: jax.Array, cp: bool = True) -> jax.Array:
     )
 
 
+def replicated(a: jax.Array, cp: bool = True) -> jax.Array:
+    """Replicate a small array across the mesh (companion to
+    :func:`column_parallel` for the (rows,) id/validity vectors that every
+    column-parallel lane needs in full).  Same gating contract."""
+    if not cp or _RUNTIME is None or _RUNTIME.mesh.size == 1:
+        return a
+    return jax.lax.with_sharding_constraint(
+        a, NamedSharding(_RUNTIME.mesh, P(*([None] * a.ndim)))
+    )
+
+
 def wants_column_parallel(*arrays) -> bool:
     """Gate for :func:`column_parallel`, evaluated on CONCRETE jit inputs.
 

@@ -389,20 +389,26 @@ def _si_score(cv):
     return 0
 
 
-def golden_stability():
-    """stability_index_computation semantics (reference stability.py:15-334)
-    on a DETERMINISTIC synthetic 3-dataset history (seeded; the test rebuilds
-    the same datasets): per-dataset mean/stddev/kurtosis(+3), CV of each
-    metric across datasets (SAMPLE stddev ddof=1 — Spark's F.stddev), CV→SI
-    map, weighted SI with the 50/30/20 default weights."""
+def stability_datasets():
+    """The deterministic synthetic 3-dataset history shared by the pandas
+    encoding, the framework test, and the Spark oracle (spark_oracle.py)."""
     rng = np.random.default_rng(99)
-    datasets = [
+    return [
         pd.DataFrame({
             "steady": rng.normal(100.0, 5.0, 2000),
             "drifty": rng.normal(100.0 + 40.0 * i, 5.0 + 3.0 * i, 2000),
         })
         for i in range(3)
     ]
+
+
+def golden_stability():
+    """stability_index_computation semantics (reference stability.py:15-334)
+    on a DETERMINISTIC synthetic 3-dataset history (seeded; the test rebuilds
+    the same datasets): per-dataset mean/stddev/kurtosis(+3), CV of each
+    metric across datasets (SAMPLE stddev ddof=1 — Spark's F.stddev), CV→SI
+    map, weighted SI with the 50/30/20 default weights."""
+    datasets = stability_datasets()
     rows = []
     for c in ("steady", "drifty"):
         means, stds, kurts = [], [], []
