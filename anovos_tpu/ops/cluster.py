@@ -346,8 +346,9 @@ def dbscan_host_grid_multi(
     grid — and every smaller eps filters the edge arrays (O(E)); per-eps
     neighbor counts come from edge bincounts, not an n² reduction.
     Returns (len(eps_list), len(min_samples_list), n) labels."""
-    from scipy.sparse import coo_matrix
-    from scipy.sparse.csgraph import connected_components
+    # call through the module: the native-vs-fallback parity test patches
+    # nat.native_edge_components_minc, so the name must resolve at call time
+    from anovos_tpu.shared import native as nat
 
     n = len(D2)
     if not eps_list:  # empty grid (e.g. inverted eps range) → empty labels
@@ -366,20 +367,39 @@ def dbscan_host_grid_multi(
         eia, eja = ei[within], ej[within]
         # +1: a point is its own neighbor (the dense adj diagonal)
         counts = np.bincount(eia, minlength=n) + np.bincount(eja, minlength=n) + 1
+        # an edge is core-core for ms iff BOTH endpoint counts reach ms:
+        # precompute the min endpoint count once per eps so each ms level
+        # costs one O(E) compare instead of two O(E) gathers + and (the
+        # gathers dominated the grid at ~3M edges x 7 ms levels)
+        edge_min_count = np.minimum(counts[eia], counts[eja])
         for b, ms in enumerate(min_samples_list):
             core = counts >= ms
             ci = np.nonzero(core)[0]
             if len(ci) == 0:
                 continue
+            # components via the native union-find: ONE O(E α) pass with the
+            # ms threshold applied edge-by-edge in C++ — no Python-side edge
+            # compress, no remap gathers, no sparse-matrix construction (the
+            # per-combo coo→csr→csc conversions and the two O(E) fancy
+            # gathers dominated the 35-combo grid at ~3M edges).  A core
+            # cluster's native label equals the first-touch position of its
+            # smallest member, so ranking the core labels (np.unique) yields
+            # exactly scipy's weak-connectivity ids on the remapped graph —
+            # pinned in test_native.py; scipy remains the fallback.
             remap = np.full(n, -1, np.int64)
-            remap[ci] = np.arange(len(ci))
-            ek = core[eia] & core[eja]
-            ri, rj = remap[eia[ek]], remap[eja[ek]]
-            g = coo_matrix((np.ones(len(ri), np.int8), (ri, rj)), shape=(len(ci), len(ci)))
-            # weak connectivity on the upper-triangular edge set equals
-            # undirected components (verified bit-identical) and skips
-            # scipy's csr→csc symmetrization pass per combo
-            _, comp = connected_components(g, directed=True, connection="weak")
+            remap[ci] = np.arange(len(ci))  # border adoption indexes by core rank
+            res = nat.native_edge_components_minc(eia, eja, edge_min_count, ms, n)
+            if res is not None:
+                _, comp = np.unique(res[1][ci], return_inverse=True)
+            else:
+                from scipy.sparse import coo_matrix
+                from scipy.sparse.csgraph import connected_components
+
+                ek = edge_min_count >= ms
+                ri, rj = remap[eia[ek]], remap[eja[ek]]
+                g = coo_matrix((np.ones(len(ri), np.int8), (ri, rj)),
+                               shape=(len(ci), len(ci)))
+                _, comp = connected_components(g, directed=True, connection="weak")
             out[a, b, ci] = comp
             bi = np.nonzero(~core)[0]
             if len(bi):

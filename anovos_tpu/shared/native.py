@@ -93,6 +93,12 @@ def get_native() -> Optional[ctypes.CDLL]:
             dpp, i64pp, u8pp, i64pp, u8pp,
             ctypes.c_int32, u8p, ctypes.c_int64, u8p, ctypes.c_int64,
         ]
+        lib.edge_components.restype = ctypes.c_int64
+        lib.edge_components.argtypes = [i64p, i64p, ctypes.c_int64,
+                                        ctypes.c_int64, i64p]
+        lib.edge_components_minc.restype = ctypes.c_int64
+        lib.edge_components_minc.argtypes = [i64p, i64p, i64p, ctypes.c_int64,
+                                             ctypes.c_int64, ctypes.c_int64, i64p]
         _LIB = lib
     except (OSError, subprocess.CalledProcessError):
         _LIB = None
@@ -314,3 +320,40 @@ def native_avro_encode(df, sync: bytes, codec: str, block_rows: int):
     if used < 0:
         return None
     return out[:used].tobytes()
+
+
+def native_edge_components(ei: np.ndarray, ej: np.ndarray, n_nodes: int):
+    """Connected components over an undirected edge list (union-find in the
+    C++ layer, O(E a(N))) — dense labels in smallest-member order, matching
+    scipy.sparse.csgraph.connected_components on the same graph.  Returns
+    (n_components, labels) or None when the native library is unavailable
+    (callers fall back to scipy).  Unfiltered view of the thresholded
+    variant — one marshaling path."""
+    ei = np.ascontiguousarray(ei, np.int64)
+    return native_edge_components_minc(
+        ei, ej, ei, np.iinfo(np.int64).min, n_nodes
+    )
+
+
+def native_edge_components_minc(ei: np.ndarray, ej: np.ndarray,
+                                minc: np.ndarray, thresh: int, n_nodes: int):
+    """Union-find components using only edges with minc >= thresh (both
+    endpoints core at this min_samples level) — one native pass per DBSCAN
+    grid combo, no Python-side edge compress.  Returns (n_components,
+    labels over ALL n_nodes) or None when the library is unavailable."""
+    lib = get_native()
+    if lib is None:
+        return None
+    ei = np.ascontiguousarray(ei, np.int64)
+    ej = np.ascontiguousarray(ej, np.int64)
+    minc = np.ascontiguousarray(minc, np.int64)
+    out = np.empty(n_nodes, np.int64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    ncomp = lib.edge_components_minc(
+        ei.ctypes.data_as(i64p), ej.ctypes.data_as(i64p),
+        minc.ctypes.data_as(i64p), len(ei), int(thresh), n_nodes,
+        out.ctypes.data_as(i64p),
+    )
+    if ncomp < 0:
+        return None
+    return int(ncomp), out

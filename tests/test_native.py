@@ -109,3 +109,69 @@ def test_native_avro_encode_roundtrip(tmp_path):
     np.testing.assert_array_equal(np.asarray(dec["i"]).astype(np.int64), df["i"].to_numpy())
     np.testing.assert_array_equal(np.asarray(dec["b"]).astype(bool), df["b"].to_numpy())
     assert all((a == b) or (a is None and pd.isna(b)) for a, b in zip(got_s, df["s"]))
+
+
+def test_edge_components_matches_scipy():
+    """The native union-find (plain and min-count-thresholded) must label
+    components exactly as scipy's weak connectivity on the same
+    upper-triangular edge set — it replaces scipy in the DBSCAN
+    hyperparameter grid (ops/cluster.dbscan_host_grid_multi)."""
+    import numpy as np
+    import pytest
+    from scipy.sparse import coo_matrix
+    from scipy.sparse.csgraph import connected_components
+
+    from anovos_tpu.shared.native import (
+        native_edge_components, native_edge_components_minc)
+
+    if native_edge_components(np.array([0]), np.array([1]), 2) is None:
+        pytest.skip("native library unavailable")
+
+    rng = np.random.default_rng(7)
+    n = 400
+    for trial in range(5):
+        m = rng.integers(0, 1200)
+        ei = rng.integers(0, n, m)
+        ej = rng.integers(0, n, m)
+        keep = ei < ej  # upper-triangular, self-loops dropped (grid contract)
+        ei, ej = ei[keep], ej[keep]
+        nc, lab = native_edge_components(ei, ej, n)
+        g = coo_matrix((np.ones(len(ei)), (ei, ej)), shape=(n, n))
+        nc_ref, lab_ref = connected_components(g, directed=True, connection="weak")
+        assert nc == nc_ref
+        np.testing.assert_array_equal(lab, lab_ref)
+
+        # thresholded variant == filter-then-plain on the kept edges
+        minc = rng.integers(0, 10, len(ei))
+        for thresh in (0, 3, 7, 11):
+            nct, labt = native_edge_components_minc(ei, ej, minc, thresh, n)
+            k = minc >= thresh
+            ncp, labp = native_edge_components(ei[k], ej[k], n)
+            assert nct == ncp
+            np.testing.assert_array_equal(labt, labp)
+
+
+def test_dbscan_grid_native_equals_scipy_fallback():
+    """End-to-end grid parity: the native path and the scipy fallback must
+    produce identical label grids (core labeling AND border adoption)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    import anovos_tpu.shared.native as nat
+    from anovos_tpu.ops.cluster import dbscan_host_grid_multi, pairwise_d2
+
+    rng = np.random.default_rng(5)
+    X = np.concatenate([
+        rng.normal([0, 0], 0.2, (300, 2)), rng.normal([2, 2], 0.2, (300, 2)),
+        rng.uniform(-1, 3, (100, 2)),
+    ]).astype(np.float32)
+    D2 = np.asarray(pairwise_d2(jnp.asarray(X)))
+    eps, ms = [0.2, 0.3, 0.4], [3, 6, 9, 12]
+    native = dbscan_host_grid_multi(D2, eps, ms)
+    orig = nat.native_edge_components_minc
+    nat.native_edge_components_minc = lambda *a, **k: None
+    try:
+        fallback = dbscan_host_grid_multi(D2, eps, ms)
+    finally:
+        nat.native_edge_components_minc = orig
+    np.testing.assert_array_equal(native, fallback)
