@@ -93,14 +93,14 @@ def get_native() -> Optional[ctypes.CDLL]:
             dpp, i64pp, u8pp, i64pp, u8pp,
             ctypes.c_int32, u8p, ctypes.c_int64, u8p, ctypes.c_int64,
         ]
-        lib.edge_components.restype = ctypes.c_int64
-        lib.edge_components.argtypes = [i64p, i64p, ctypes.c_int64,
-                                        ctypes.c_int64, i64p]
         lib.edge_components_minc.restype = ctypes.c_int64
         lib.edge_components_minc.argtypes = [i64p, i64p, i64p, ctypes.c_int64,
                                              ctypes.c_int64, ctypes.c_int64, i64p]
         _LIB = lib
-    except (OSError, subprocess.CalledProcessError):
+    except (OSError, subprocess.CalledProcessError, AttributeError):
+        # AttributeError: a stale prebuilt .so lacking newer exports (mtime
+        # check defeated by rsync -a / tar deployment) must degrade to the
+        # Python fallbacks, not crash every native caller
         _LIB = None
     return _LIB
 

@@ -449,11 +449,7 @@ int64_t edge_components_minc(const int64_t* ei, const int64_t* ej,
   return next;
 }
 
-// Unfiltered view: every edge participates (minc := the edge list itself,
-// thresh := INT64_MIN) — single union-find implementation to keep in sync.
-int64_t edge_components(const int64_t* ei, const int64_t* ej, int64_t n_edges,
-                        int64_t n_nodes, int64_t* out) {
-  return edge_components_minc(ei, ej, ei, n_edges, INT64_MIN, n_nodes, out);
-}
+// (the unfiltered view lives in Python: native_edge_components delegates to
+// edge_components_minc with minc := ei, thresh := INT64_MIN)
 
 }  // extern "C"
