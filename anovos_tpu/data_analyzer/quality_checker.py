@@ -11,6 +11,7 @@ here).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import re
 import warnings
 from typing import Dict, List, Tuple, Union
@@ -527,13 +528,33 @@ def _is_invalid_value(
     return False
 
 
-@jax.jit
 def _unique_compact(data: jax.Array, mask: jax.Array):
     """Sorted distinct values scattered to a prefix buffer, on device.
     Returns (buffer (rows+1,), nu) — callers slice buffer[:nu] so only the
     distinct values transfer to host.  Integer columns stay integer: an f32
     cast would collapse distinct ints above 2^24 (the exact failure this
     codebase documents for 1e9-range ids)."""
+    from anovos_tpu.shared.runtime import wants_column_parallel
+
+    return _unique_compact_jit(
+        data, mask,
+        cp=wants_column_parallel(
+            data, mask,
+            replicated_nbytes=int(data.size) * data.dtype.itemsize
+            + int(mask.size) * mask.dtype.itemsize,
+        ),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cp",))
+def _unique_compact_jit(data: jax.Array, mask: jax.Array, cp: bool = False):
+    # a (rows,) column has no column axis to spread, so the multi-device
+    # analogue of the column-parallel re-lay is replication: one all-gather,
+    # then the sort is device-local instead of a distributed-sort exchange
+    # ladder (see runtime.column_parallel)
+    from anovos_tpu.shared.runtime import replicated
+
+    data, mask = replicated(data, cp), replicated(mask, cp)
     rows = data.shape[0]
     if jnp.issubdtype(data.dtype, jnp.integer):
         dt = data.dtype

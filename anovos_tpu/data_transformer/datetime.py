@@ -627,7 +627,12 @@ def _segment_aggregate(ids0: jax.Array, valid: jax.Array, V: jax.Array, Mv: jax.
     from anovos_tpu.shared.runtime import wants_column_parallel
 
     return _segment_aggregate_jit(
-        ids0, valid, V, Mv, nseg, cp=wants_column_parallel(ids0, valid, V, Mv)
+        ids0, valid, V, Mv, nseg,
+        cp=wants_column_parallel(
+            ids0, valid, V, Mv,
+            replicated_nbytes=int(ids0.size) * ids0.dtype.itemsize
+            + int(valid.size) * valid.dtype.itemsize,
+        ),
     )
 
 

@@ -24,7 +24,6 @@ import pandas as pd
 
 from anovos_tpu.data_transformer.model_io import load_model_df, save_model_df
 from anovos_tpu.ops.histogram import digitize, masked_bincount
-from anovos_tpu.ops.mode import masked_mode
 from anovos_tpu.ops.quantiles import masked_quantiles
 from anovos_tpu.ops.reductions import masked_moments
 from anovos_tpu.ops.segment import code_counts, code_label_counts, masked_nunique

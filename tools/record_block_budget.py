@@ -37,21 +37,32 @@ CONFIG = os.path.join(REPO, "config", "configs_full.yaml")
 BUDGET_CSV = os.path.join(REPO, "tests", "golden", "e2e_block_budget.csv")
 
 
-def run_cold_warm() -> dict:
+def run_cold_warm(warm_runs: int = 2) -> dict:
+    """One cold pass (compiles) then ``warm_runs`` warm passes; the
+    reported warm time per block is the MIN across warm passes — best-of-N
+    measures the code's speed, not transient machine contention (a single
+    contended pass has been observed 3.7x the quiet wall, tripping the
+    budget gate spuriously)."""
     import tempfile
 
     from anovos_tpu import workflow
 
     cwd = os.getcwd()
     times = {}
-    for label in ("cold", "warm"):
+    for label in ["cold"] + ["warm"] * warm_runs:
         with tempfile.TemporaryDirectory() as d:
             os.chdir(d)
             try:
                 workflow.run(CONFIG, "local")
-                times[label] = dict(workflow.BLOCK_TIMES)
+                run_times = dict(workflow.BLOCK_TIMES)
             finally:
                 os.chdir(cwd)
+        if label == "warm" and "warm" in times:
+            times["warm"] = {
+                k: min(v, run_times.get(k, v)) for k, v in times["warm"].items()
+            }
+        else:
+            times[label] = run_times
     return times
 
 
