@@ -538,11 +538,7 @@ def _unique_compact(data: jax.Array, mask: jax.Array):
 
     return _unique_compact_jit(
         data, mask,
-        cp=wants_column_parallel(
-            data, mask,
-            replicated_nbytes=int(data.size) * data.dtype.itemsize
-            + int(mask.size) * mask.dtype.itemsize,
-        ),
+        cp=wants_column_parallel(data, mask, replicate=(data, mask)),
     )
 
 

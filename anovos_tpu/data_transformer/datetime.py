@@ -628,11 +628,7 @@ def _segment_aggregate(ids0: jax.Array, valid: jax.Array, V: jax.Array, Mv: jax.
 
     return _segment_aggregate_jit(
         ids0, valid, V, Mv, nseg,
-        cp=wants_column_parallel(
-            ids0, valid, V, Mv,
-            replicated_nbytes=int(ids0.size) * ids0.dtype.itemsize
-            + int(valid.size) * valid.dtype.itemsize,
-        ),
+        cp=wants_column_parallel(ids0, valid, V, Mv, replicate=(ids0, valid)),
     )
 
 

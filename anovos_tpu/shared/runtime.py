@@ -185,7 +185,7 @@ def replicated(a: jax.Array, cp: bool = True) -> jax.Array:
     )
 
 
-def wants_column_parallel(*arrays, replicated_nbytes: int = 0) -> bool:
+def wants_column_parallel(*arrays, replicate=()) -> bool:
     """Gate for :func:`column_parallel`, evaluated on CONCRETE jit inputs.
 
     True iff the runtime mesh is multi-device and every given array
@@ -195,18 +195,20 @@ def wants_column_parallel(*arrays, replicated_nbytes: int = 0) -> bool:
     error; the kernel then runs unconstrained, which is merely the old
     layout, never wrong.
 
-    ``replicated_nbytes``: total bytes the kernel would REPLICATE to every
-    device under the re-lay (1-D id/value vectors fed to
-    :func:`replicated`).  Above ``ANOVOS_REPLICATE_MAX_BYTES`` (default
-    256 MB) the gate refuses — a row-sharded sort is slow but
-    memory-bounded, while an unbounded per-device replica of a billion-row
-    id column is an OOM.  The (rows, k) column-parallel re-lay itself does
-    not change total footprint and needs no guard.
+    ``replicate``: the arrays the kernel will feed to :func:`replicated`
+    under the re-lay (1-D id/value vectors).  The gate sums their sizes
+    itself — callers name the arrays, not a hand-computed byte count —
+    and refuses above ``ANOVOS_REPLICATE_MAX_BYTES`` (default 256 MB):
+    a row-sharded sort is slow but memory-bounded, while an unbounded
+    per-device replica of a billion-row id column is an OOM.  The
+    (rows, k) column-parallel re-lay itself does not change total
+    footprint and needs no guard.
     """
     rt = _RUNTIME
     if rt is None or rt.mesh.size == 1:
         return False
-    if replicated_nbytes > int(os.environ.get("ANOVOS_REPLICATE_MAX_BYTES", 1 << 28)):
+    rep_bytes = sum(int(a.size) * a.dtype.itemsize for a in replicate)
+    if rep_bytes > int(os.environ.get("ANOVOS_REPLICATE_MAX_BYTES", 1 << 28)):
         return False
     mesh_devs = set(rt.mesh.devices.flat)
     for a in arrays:

@@ -73,6 +73,50 @@ SCRIPT = textwrap.dedent(
     """
 ).format(repo=REPO)
 
+# forces the stall path deterministically: the CHILD disables its own
+# backend probe (so it proceeds on the default backend instead of falling
+# back), then wedges until the parent's silence watchdog kills the group
+# and retries on CPU — the parent still supervises because its env has the
+# probe enabled
+STALL_SCRIPT = textwrap.dedent(
+    """
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    if os.environ.get("ANOVOS_SUPERVISED") == "1":
+        os.environ["ANOVOS_BACKEND_PROBE"] = "0"
+    from anovos_tpu.shared.backend_probe import supervise_demo
+    supervise_demo(stall_timeout_s=3)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        print("completed-on-cpu-after-stall")
+    else:
+        print("pre-stall-output", flush=True)
+        time.sleep(90)  # wedge: no output until far past the stall timeout
+        print("never-reached")
+    """
+).format(repo=REPO)
+
+
+def test_stall_watchdog_kills_and_retries_on_cpu(tmp_path):
+    """The silence watchdog specifically: a child that passes the probe and
+    then wedges mid-run must be killed after the stall timeout and retried
+    once on CPU, with the retry completing."""
+    import time
+
+    script = tmp_path / "stall.py"
+    script.write_text(STALL_SCRIPT)
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    wall = time.monotonic() - t0
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "completed-on-cpu-after-stall" in r.stdout
+    assert "never-reached" not in r.stdout
+    assert "retrying once on CPU" in r.stderr
+    assert wall < 60  # killed at ~stall timeout, not the 90s sleep
+
 
 def test_supervised_script_always_completes(tmp_path):
     """End-to-end supervisor contract: on a wedged host the probe falls

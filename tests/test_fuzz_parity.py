@@ -1,0 +1,120 @@
+"""Randomized parity sweep: the fused stats path vs a pandas oracle over
+many generated frames (SURVEY §4 "numerical parity vs oracles", widened
+beyond the fixed golden fixtures).
+
+Each trial draws a frame with a random mix of dtypes, null patterns, and
+degenerate shapes (constant columns, single-distinct, heavy ties, tiny
+row counts relative to the mesh) and checks the fused describe program —
+the kernel every stats_generator function dispatches — against pandas on
+the same data.  The golden fixtures pin exact reference semantics on one
+dataset; this sweep guards the kernel against shape/null edge cases the
+fixtures never visit (padding leaks, mask handling, sort sentinels).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from anovos_tpu.shared import Table
+
+
+def _random_frame(rng: np.random.Generator) -> pd.DataFrame:
+    n = int(rng.choice([3, 17, 100, 997, 4096]))
+    cols = {}
+    k = rng.integers(2, 6)
+    for j in range(k):
+        kind = rng.choice(["normal", "ties", "constant", "intlike", "gamma"])
+        if kind == "normal":
+            v = rng.normal(rng.uniform(-50, 50), rng.uniform(0.1, 100), n)
+        elif kind == "ties":
+            v = rng.choice([1.0, 2.5, 2.5, 7.0, -3.0], n)
+        elif kind == "constant":
+            v = np.full(n, float(rng.integers(-5, 5)))
+        elif kind == "intlike":
+            v = rng.integers(-1000, 1000, n).astype(float)
+        else:
+            v = rng.gamma(2.0, 3.0, n)
+        v = v.astype(np.float32).astype(float)  # Table stores f32: quantize first
+        null_frac = float(rng.choice([0.0, 0.02, 0.5, 0.95]))
+        if null_frac:
+            v[rng.random(n) < null_frac] = np.nan
+        cols[f"c{j}"] = v
+    return pd.DataFrame(cols)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_describe_matches_pandas_on_random_frames(seed):
+    from anovos_tpu.ops.describe import PCTL_QS, describe_numeric
+
+    rng = np.random.default_rng(1000 + seed)
+    df = _random_frame(rng)
+    t = Table.from_pandas(df)
+    num_cols = list(df.columns)
+    X, M = t.numeric_block(num_cols)
+    out = {k: np.asarray(v) for k, v in describe_numeric(X, M).items()}
+
+    for i, c in enumerate(num_cols):
+        s = df[c].dropna()
+        n = len(s)
+        assert out["count"][i] == n, c
+        if n == 0:
+            assert np.isnan(out["mean"][i])
+            continue
+        v = s.to_numpy()
+        np.testing.assert_allclose(out["mean"][i], v.mean(), rtol=2e-5, err_msg=c)
+        if n > 1 and v.std(ddof=1) > 0:
+            np.testing.assert_allclose(
+                out["stddev"][i], v.std(ddof=1), rtol=1e-4, err_msg=c)
+        assert out["min"][i] == v.min() and out["max"][i] == v.max(), c
+        assert out["nunique"][i] == len(np.unique(v)), c
+        assert out["nonzero"][i] == (v != 0).sum(), c
+        # percentile grid: 'lower' interpolation — an actual element at the
+        # exact index pandas' method='lower' picks
+        want = np.quantile(v, PCTL_QS, method="lower")
+        np.testing.assert_array_equal(out["percentiles"][:, i], want, err_msg=c)
+        # mode: most frequent value, smallest on count ties
+        vc = pd.Series(v).value_counts()
+        top = vc[vc == vc.iloc[0]].index.min()
+        assert out["mode_value"][i] == top, c
+        assert out["mode_count"][i] == vc.iloc[0], c
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_drift_matches_pandas_loop_on_random_frames(seed):
+    """The full drift pipeline (binning with source cutoffs, union-vocab
+    cat counts, PSI) vs bench.py's pandas per-column oracle on random
+    mixed frames with disjoint vocab tails and nulls."""
+    import importlib.util
+    import os
+    import tempfile
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    from anovos_tpu.drift_stability import statistics
+
+    rng = np.random.default_rng(7000 + seed)
+    n = int(rng.choice([400, 2000]))
+    src = pd.DataFrame({
+        "x": rng.normal(0, 1, n).astype(np.float32).astype(float),
+        "y": rng.gamma(2, 3, n).astype(np.float32).astype(float),
+        "c": rng.choice(["a", "b", "c", "src_only"], n),
+    })
+    tgt = pd.DataFrame({
+        "x": rng.normal(0.4, 1.3, n).astype(np.float32).astype(float),
+        "y": rng.gamma(2, 4, n).astype(np.float32).astype(float),
+        "c": rng.choice(["a", "b", "d", "tgt_only"], n),
+    })
+    src.loc[rng.random(n) < 0.05, "x"] = np.nan
+    ref = bench.pandas_reference_psi(src, tgt, bin_size=10)
+    with tempfile.TemporaryDirectory() as d:
+        odf = statistics(
+            Table.from_pandas(tgt), Table.from_pandas(src),
+            method_type="PSI", use_sampling=False,
+            source_path=os.path.join(d, "s"), bin_size=10,
+        )
+    ours = dict(zip(odf["attribute"], odf["PSI"]))
+    for c, want in ref.items():
+        assert abs(ours[c] - want) < 0.02, (c, ours[c], want)

@@ -30,6 +30,7 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
+import numpy as np  # noqa: E402
 import pandas as pd  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -58,8 +59,12 @@ def run_cold_warm(warm_runs: int = 2) -> dict:
             finally:
                 os.chdir(cwd)
         if label == "warm" and "warm" in times:
+            # union of keys: a block that only engages on a later pass must
+            # not vanish from the table
+            prev = times["warm"]
             times["warm"] = {
-                k: min(v, run_times.get(k, v)) for k, v in times["warm"].items()
+                k: min(prev.get(k, np.inf), run_times.get(k, np.inf))
+                for k in set(prev) | set(run_times)
             }
         else:
             times[label] = run_times
