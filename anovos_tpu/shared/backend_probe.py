@@ -17,7 +17,7 @@ Contract:
     re-asserted via ``jax.config`` for hosts whose sitecustomize
     pre-registers an accelerator plugin that would otherwise win.
   * Any accelerator platform — explicit env or default — gets a bounded
-    subprocess probe (``ANOVOS_BACKEND_PROBE_TIMEOUT``, default 45 s)
+    subprocess probe (``ANOVOS_BACKEND_PROBE_TIMEOUT``, default 90 s)
     running a real jitted computation.  The ambient environment here sets
     ``JAX_PLATFORMS=<plugin>`` for every process, so a non-cpu env value
     is NOT evidence of a deliberate user pin.  On success the process
