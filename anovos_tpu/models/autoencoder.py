@@ -220,7 +220,15 @@ class AutoEncoder:
             x_hat, new_params = self.forward(params, batch, train=True)
             return jnp.mean((x_hat - batch) ** 2), new_params
 
-        @jax.jit
+        # donate params + opt_state: XLA updates the weight/optimizer
+        # buffers in place instead of allocating fresh ones every step —
+        # halves the per-step HBM traffic and footprint for the model
+        # state.  The fit loop rebinds both on every call, so the donated
+        # (invalidated) inputs are never touched again.  CPU ignores
+        # donation and warns about it, so only donate on accelerators.
+        donate = () if jax.default_backend() == "cpu" else (0, 1)
+
+        @functools.partial(jax.jit, donate_argnums=donate)
         def train_step(params, opt_state, batch):
             (loss, new_params), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
             updates, opt_state = optimizer.update(grads, opt_state, params)
