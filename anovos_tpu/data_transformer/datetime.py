@@ -828,12 +828,30 @@ def _segmented_cummin(x, newseg):
     return out
 
 
-@_functools.partial(jax.jit, static_argnames=("agg", "window_type", "w"))
 def _window_program(osecs, omask, v, mv, row_valid, agg, window_type, w, pcode=None):
     """``pcode`` (int32 partition codes) makes every window restart at its
     partition boundary: rows lex-sort by (partition, ts) and cumulatives
     subtract their value at the segment start (reference :1899-1905
-    Window.partitionBy)."""
+    Window.partitionBy).  On a multi-device mesh the 1-D arrays replicate
+    (size-guarded) so the ts argsorts stay device-local."""
+    from anovos_tpu.shared.runtime import replicate_gate
+
+    return _window_program_jit(
+        osecs, omask, v, mv, row_valid, agg, window_type, w, pcode,
+        cp=replicate_gate(osecs, omask, v, mv, row_valid, pcode),
+    )
+
+
+@_functools.partial(jax.jit, static_argnames=("agg", "window_type", "w", "cp"))
+def _window_program_jit(osecs, omask, v, mv, row_valid, agg, window_type, w,
+                        pcode=None, *, cp=False):
+    from anovos_tpu.shared.runtime import replicated
+
+    osecs, omask = replicated(osecs, cp), replicated(omask, cp)
+    v, mv = replicated(v, cp), replicated(mv, cp)
+    row_valid = replicated(row_valid, cp)
+    if pcode is not None:
+        pcode = replicated(pcode, cp)
     rows = v.shape[0]
     key = jnp.where(omask, osecs, _I32_BIG)
     order = jnp.argsort(key, stable=True)
@@ -917,7 +935,14 @@ def _window_program(osecs, omask, v, mv, row_valid, agg, window_type, w, pcode=N
     inv = jnp.zeros(rows, jnp.int32).at[order].set(jnp.arange(rows, dtype=jnp.int32))
     out = res[inv]
     okb = ok[inv] & row_valid
-    return jnp.where(okb, out, 0.0).astype(jnp.float32), okb
+    # results persist as Table columns: hand them back ROW-sharded, not
+    # replicated — N resident copies per appended column otherwise
+    from anovos_tpu.shared.runtime import row_sharded
+
+    return (
+        row_sharded(jnp.where(okb, out, 0.0).astype(jnp.float32), cp),
+        row_sharded(okb, cp),
+    )
 
 
 def lagged_ts(
@@ -962,8 +987,26 @@ def lagged_ts(
     return odf
 
 
-@_functools.partial(jax.jit, static_argnames=("lag",))
 def _lag_program(secs, mask, ksecs, kmask, row_valid, lag, pcode=None):
+    """Mesh note: 1-D inputs replicate (size-guarded) so the ts argsorts
+    stay device-local — see _window_program."""
+    from anovos_tpu.shared.runtime import replicate_gate
+
+    return _lag_program_jit(
+        secs, mask, ksecs, kmask, row_valid, lag, pcode,
+        cp=replicate_gate(secs, mask, ksecs, kmask, row_valid, pcode),
+    )
+
+
+@_functools.partial(jax.jit, static_argnames=("lag", "cp"))
+def _lag_program_jit(secs, mask, ksecs, kmask, row_valid, lag, pcode=None, *, cp=False):
+    from anovos_tpu.shared.runtime import replicated
+
+    secs, mask = replicated(secs, cp), replicated(mask, cp)
+    ksecs, kmask = replicated(ksecs, cp), replicated(kmask, cp)
+    row_valid = replicated(row_valid, cp)
+    if pcode is not None:
+        pcode = replicated(pcode, cp)
     rows = secs.shape[0]
     key = jnp.where(kmask, ksecs, _I32_BIG)
     order = jnp.argsort(key, stable=True)
@@ -978,8 +1021,11 @@ def _lag_program(secs, mask, ksecs, kmask, row_valid, lag, pcode=None):
         shift_p = jnp.concatenate([jnp.full(lag, -1, po.dtype), po])[:rows]
         shift_m = shift_m & (shift_p == po)
     inv = jnp.zeros(rows, jnp.int32).at[order].set(jnp.arange(rows, dtype=jnp.int32))
-    # padding rows sort last and would inherit the tail's mask — re-mask them
-    return shift_s[inv], shift_m[inv] & row_valid
+    # padding rows sort last and would inherit the tail's mask — re-mask them;
+    # row-sharded returns (persisted as Table columns — see _window_program_jit)
+    from anovos_tpu.shared.runtime import row_sharded
+
+    return row_sharded(shift_s[inv], cp), row_sharded(shift_m[inv] & row_valid, cp)
 
 
 @jax.jit

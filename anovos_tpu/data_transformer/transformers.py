@@ -11,6 +11,7 @@ kernel over the λ grid.
 
 from __future__ import annotations
 
+import functools
 import math
 import os
 import warnings
@@ -770,10 +771,21 @@ def feature_transformation(
 _BOXCOX_LAMBDAS = [1.0, -1.0, 0.5, -0.5, 2.0, -2.0, 0.25, -0.25, 3.0, -3.0, 4.0, -4.0, 5.0, -5.0, 0.0]
 
 
-@jax.jit
 def _ks_vs_normal(X: jax.Array, M: jax.Array) -> jax.Array:
     """Per-column KS statistic of standardized data vs N(0,1) — the MLlib
-    kolmogorovSmirnovTest call site (reference transformers.py:3424-3443)."""
+    kolmogorovSmirnovTest call site (reference transformers.py:3424-3443).
+    The per-column sort runs column-parallel on a multi-device mesh
+    (runtime.column_parallel)."""
+    from anovos_tpu.shared.runtime import wants_column_parallel
+
+    return _ks_vs_normal_jit(X, M, cp=wants_column_parallel(X, M))
+
+
+@functools.partial(jax.jit, static_argnames=("cp",))
+def _ks_vs_normal_jit(X: jax.Array, M: jax.Array, cp: bool = False) -> jax.Array:
+    from anovos_tpu.shared.runtime import column_parallel
+
+    X, M = column_parallel(X, cp), column_parallel(M, cp)
     mom_n = M.sum(0).astype(jnp.float32)
     mean = jnp.where(M, X, 0).sum(0) / jnp.maximum(mom_n, 1)
     d = jnp.where(M, X - mean, 0)

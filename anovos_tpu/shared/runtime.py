@@ -185,6 +185,25 @@ def replicated(a: jax.Array, cp: bool = True) -> jax.Array:
     )
 
 
+def row_sharded(a: jax.Array, cp: bool = True) -> jax.Array:
+    """Constrain a (rows, ...) result back onto the row sharding.  Kernels
+    that replicate their inputs for device-local sorts must NOT return
+    row-length outputs replicated — a persisted replicated column occupies
+    every device for the table's lifetime, unbounded by the transient
+    replication guard.  Same gating contract as :func:`column_parallel`."""
+    if not cp or _RUNTIME is None or _RUNTIME.mesh.size == 1:
+        return a
+    return jax.lax.with_sharding_constraint(a, _RUNTIME.row_sharding())
+
+
+def replicate_gate(*arrays) -> bool:
+    """Gate for kernels whose whole input set replicates for device-local
+    sorts (1-D ts/window programs): drops Nones and applies the size guard
+    to everything."""
+    arrs = tuple(a for a in arrays if a is not None)
+    return wants_column_parallel(*arrs, replicate=arrs)
+
+
 def wants_column_parallel(*arrays, replicate=()) -> bool:
     """Gate for :func:`column_parallel`, evaluated on CONCRETE jit inputs.
 
