@@ -17,6 +17,39 @@ import logging
 from anovos_tpu.data_report.report_generation import anovos_report
 from anovos_tpu.data_report.report_preprocessing import charts_to_objects, save_stats
 from anovos_tpu.shared.table import Table
+from anovos_tpu.shared.utils import ends_with
+
+
+# the ONE copy of the saved-stats wiring tables (workflow.stats_args builds
+# its superset mapping from these — a filename renamed in only one consumer
+# would silently read a nonexistent CSV)
+ARGS_TO_STATSFUNC = {
+    "stats_unique": "measures_of_cardinality",
+    "stats_mode": "measures_of_centralTendency",
+    "stats_missing": "measures_of_counts",
+}
+CHECKER_STATS_ARGS = {
+    "biasedness_detection": ["stats_mode"],
+    "IDness_detection": ["stats_unique"],
+    "nullColumns_detection": ["stats_unique", "stats_mode", "stats_missing"],
+    "variable_clustering": ["stats_mode"],
+}
+
+
+def stats_args(path, func) -> dict:
+    """Read-spec kwargs pointing a quality-checker function at the basic
+    report's pre-saved stats CSVs (reference basic_report_generation.py:55-93)
+    — {stats_unique/stats_mode/stats_missing: read_dataset kwargs} so the
+    checker reuses saved cardinality/centralTendency/counts instead of
+    recomputing them."""
+    return {
+        arg: {
+            "file_path": ends_with(path) + ARGS_TO_STATSFUNC[arg] + ".csv",
+            "file_type": "csv",
+            "file_configs": {"header": True, "inferSchema": True},
+        }
+        for arg in CHECKER_STATS_ARGS.get(func, [])
+    }
 
 
 def anovos_basic_report(
@@ -54,6 +87,13 @@ def anovos_basic_report(
         except TypeError as e:
             logging.getLogger(__name__).warning("basic report: %s skipped (%s)", fn, e)
 
+    # checkers reuse the stats CSVs the loop above just saved (reference
+    # :55-93 stats_args wiring) instead of recomputing counts/cardinality/
+    # centralTendency per checker; the store's staging dir is where
+    # save_stats wrote them for this run_type
+    from anovos_tpu.shared.artifact_store import for_run_type
+
+    stats_dir = for_run_type(run_type, auth_key).staging_dir(output_path)
     for fn in (
         "duplicate_detection",
         "nullRows_detection",
@@ -64,7 +104,9 @@ def anovos_basic_report(
         "invalidEntries_detection",
     ):
         try:
-            _, stats = getattr(qc, fn)(idf, drop_cols=drop, treatment=False)
+            _, stats = getattr(qc, fn)(
+                idf, drop_cols=drop, treatment=False, **stats_args(stats_dir, fn)
+            )
             save_stats(stats, output_path, fn, run_type=run_type, auth_key=auth_key)
         except TypeError as e:
             logging.getLogger(__name__).warning("basic report: %s skipped (%s)", fn, e)

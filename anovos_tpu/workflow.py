@@ -129,20 +129,19 @@ def stats_args(
     result = {}
     if not stats_configs:
         return result
+    # shared wiring tables (basic_report_generation is the one copy); the
+    # workflow path additionally routes stats into transformers and charts
+    from anovos_tpu.data_report.basic_report_generation import (
+        ARGS_TO_STATSFUNC as args_to_statsfunc,
+        CHECKER_STATS_ARGS,
+    )
+
     mainfunc_to_args = {
-        "biasedness_detection": ["stats_mode"],
-        "IDness_detection": ["stats_unique"],
-        "nullColumns_detection": ["stats_unique", "stats_mode", "stats_missing"],
-        "variable_clustering": ["stats_mode"],
+        **CHECKER_STATS_ARGS,
         "charts_to_objects": ["stats_unique"],
         "cat_to_num_unsupervised": ["stats_unique"],
         "PCA_latentFeatures": ["stats_missing"],
         "autoencoder_latentFeatures": ["stats_missing"],
-    }
-    args_to_statsfunc = {
-        "stats_unique": "measures_of_cardinality",
-        "stats_mode": "measures_of_centralTendency",
-        "stats_missing": "measures_of_counts",
     }
     if report_input_path:
         from anovos_tpu.shared.artifact_store import for_run_type

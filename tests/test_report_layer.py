@@ -174,3 +174,16 @@ def test_report_self_contained_offline(rep_table, tmp_path, monkeypatch):
     html = open(out).read()
     assert "cdn.plot.ly" not in html
     assert "/*vendored*/" in html
+
+
+def test_basic_report_stats_args_contract():
+    """stats_args (reference basic_report_generation.py:55-93): read-spec
+    kwargs pointing quality checkers at the pre-saved stats CSVs."""
+    from anovos_tpu.data_report.basic_report_generation import stats_args
+
+    out = stats_args("/tmp/rpt", "nullColumns_detection")
+    assert set(out) == {"stats_unique", "stats_mode", "stats_missing"}
+    assert out["stats_missing"]["file_path"].endswith("measures_of_counts.csv")
+    assert out["stats_unique"]["file_type"] == "csv"
+    assert stats_args("/tmp/rpt", "IDness_detection").keys() == {"stats_unique"}
+    assert stats_args("/tmp/rpt", "unknown_func") == {}
