@@ -131,48 +131,80 @@ def _num_viz_small_grain(idf: Table, ts_col: str, num_cols: List[str], grain: st
 
 def _cat_viz(idf: Table, ts_col: str, cat_cols: List[str], n_cat: int = 10) -> pd.DataFrame:
     """Top-N + Others category counts per day per categorical column
-    (reference's string branch of ts_viz_data)."""
+    (reference's string branch of ts_viz_data).
+
+    Batched (round 5): ONE vocab-padded histogram program for every column
+    and ONE stacked day×category combo program — two device dispatches
+    total instead of two per column (remote dispatch is the dominant cost
+    on the tunnel backend, PERF.md)."""
     from anovos_tpu.data_transformer.datetime import _bucket_ids, _bucket_start_secs, _col_min_max
-    from anovos_tpu.ops.segment import code_counts
 
     tcol = idf.columns[ts_col]
     day_ids = _bucket_ids(tcol.data, "day")
     lo, hi = _col_min_max(day_ids, tcol.mask)
-    if lo > hi:
+    if lo > hi or not cat_cols:
         return pd.DataFrame(columns=["date", "attribute", "category", "count"])
     ndays = hi - lo + 1
+    k = len(cat_cols)
+    # power-of-two size classes for the static jit dims (the
+    # _bucket_segments discipline, ops/segment.py): one compiled program
+    # per row shape instead of one per distinct vocab size / day span —
+    # each novel shape is a multi-second remote XLA compile on the tunnel
+    nv = max(max(len(idf.columns[c].vocab) for c in cat_cols), 1)
+    nv_b = max(8, 1 << (nv - 1).bit_length())
+    ndays_b = max(8, 1 << (int(ndays) - 1).bit_length())
+    C = jnp.stack([idf.columns[c].data for c in cat_cols], axis=1)
+    Mc = jnp.stack([idf.columns[c].mask for c in cat_cols], axis=1)
+    cnts = np.asarray(jax.device_get(_all_code_counts(C, Mc, nv_b)))  # (k, nv_b)
+    # top-N per column (codes beyond a column's own vocab count zero)
+    lut = np.full((k, nv_b), n_cat, np.int32)  # → Others
+    tops = []
+    for j, c in enumerate(cat_cols):
+        v = len(idf.columns[c].vocab)
+        top = np.argsort(-cnts[j, :v])[:n_cat]
+        lut[j, top] = np.arange(len(top), dtype=np.int32)
+        tops.append(top)
+    combo = np.asarray(jax.device_get(_combo_counts_all(
+        C, Mc & tcol.mask[:, None], jnp.asarray(lut), day_ids - lo, ndays_b, n_cat + 1
+    ))).reshape(k, ndays_b, n_cat + 1)[:, :ndays, :]
     rows = []
-    for c in cat_cols:
-        col = idf.columns[c]
-        nv = max(len(col.vocab), 1)
-        cnts = np.asarray(jax.device_get(code_counts(col.data, col.mask, nv)))
-        top = np.argsort(-cnts)[:n_cat]
-        lut = np.full(nv, n_cat, np.int32)  # → Others
-        lut[top] = np.arange(len(top), dtype=np.int32)
-        combo = _combo_counts(
-            col.data, col.mask & tcol.mask, jnp.asarray(lut), day_ids - lo, ndays, n_cat + 1
-        )
-        combo = np.asarray(jax.device_get(combo)).reshape(ndays, n_cat + 1)
-        labels = [str(col.vocab[j]) for j in top] + ["Others"]
-        day_idx, cat_idx = np.nonzero(combo)
+    for j, c in enumerate(cat_cols):
+        labels = [str(idf.columns[c].vocab[t]) for t in tops[j]] + ["Others"]
+        day_idx, cat_idx = np.nonzero(combo[j])
         dates = pd.Series(
             _bucket_start_secs(day_idx + lo, "day").astype("datetime64[s]")
         ).dt.strftime("%Y-%m-%d")
-        for d, k, cval in zip(dates, cat_idx, combo[day_idx, cat_idx]):
-            rows.append({"date": d, "attribute": c, "category": labels[k], "count": int(cval)})
+        for d, ci, cval in zip(dates, cat_idx, combo[j][day_idx, cat_idx]):
+            rows.append({"date": d, "attribute": c, "category": labels[ci], "count": int(cval)})
     return pd.DataFrame(rows, columns=["date", "attribute", "category", "count"])
 
 
-@functools.partial(jax.jit, static_argnames=("ndays", "ncat"))
-def _combo_counts(codes, mask, lut, day0, ndays: int, ncat: int):
-    # module-level jit: a per-call closure jit object would discard the
-    # compile cache and re-pay ~0.1s × n_cat_cols on EVERY ts_analyzer call
-    valid = mask & (codes >= 0)
-    cb = lut[jnp.clip(codes, 0, lut.shape[0] - 1)]
-    seg = jnp.where(valid, day0 * ncat + cb, ndays * ncat)
+@functools.partial(jax.jit, static_argnames=("nv",))
+def _all_code_counts(C, M, nv: int):
+    """(rows, k) codes → (k, nv) histograms in one segment_sum."""
+    k = C.shape[1]
+    valid = M & (C >= 0)
+    seg = jnp.where(valid, C + jnp.arange(k, dtype=C.dtype)[None, :] * nv, k * nv)
     return jax.ops.segment_sum(
-        valid.astype(jnp.float32), seg, num_segments=ndays * ncat + 1
-    )[: ndays * ncat]
+        valid.astype(jnp.float32).ravel(), seg.ravel(), num_segments=k * nv + 1
+    )[: k * nv].reshape(k, nv)
+
+
+@functools.partial(jax.jit, static_argnames=("ndays", "ncat"))
+def _combo_counts_all(C, M, lut, day0, ndays: int, ncat: int):
+    """Stacked day×category counts for every column in one segment_sum:
+    (rows, k) codes + per-column (k, nv) LUT → (k, ndays·ncat)."""
+    k = C.shape[1]
+    valid = M & (C >= 0)
+    cb = jnp.take_along_axis(
+        lut.T, jnp.clip(C, 0, lut.shape[1] - 1), axis=0
+    )  # (rows, k): lut[j, C[:, j]]
+    base = jnp.arange(k, dtype=jnp.int32)[None, :] * (ndays * ncat)
+    seg = jnp.where(valid, base + day0[:, None] * ncat + cb, k * ndays * ncat)
+    return jax.ops.segment_sum(
+        valid.astype(jnp.float32).ravel(), seg.ravel(),
+        num_segments=k * ndays * ncat + 1,
+    )[: k * ndays * ncat]
 
 
 def ts_viz_data(
