@@ -250,3 +250,40 @@ def test_noisy_grid_winner_selection_stable():
     # bad labelings score far below every good one under both estimators
     assert max(batched[3], batched[4]) < min(batched[:3]) - 0.2
     assert max(per_combo[3], per_combo[4]) < min(per_combo[:3]) - 0.2
+
+
+def test_offcity_assertion_flips_green_with_a_dense_table(tmp_path, monkeypatch):
+    """The upgrade branch of the off-city error test must be SATISFIABLE:
+    with a genuinely dense table (synthetic 0.5-degree global grid —
+    ~geonames density near the sample points) the same protocol must land
+    under the median<50km / p90<150km bounds it promises.  Guards against
+    the unsatisfiable-branch class of bug (the sample is pinned to the
+    bundled fallback table, so a dense ACTIVE table changes only the
+    nearest-centroid distances)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "measure_geocode_error",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "measure_geocode_error.py"),
+    )
+    mge = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mge)
+
+    # dense synthetic table covering the sampler's land boxes at 0.5 deg
+    rows = []
+    for name, (lo0, la0, lo1, la1) in mge.LAND_BOXES.items():
+        lons, lats = np.meshgrid(np.arange(lo0, lo1 + 1e-9, 0.5),
+                                 np.arange(la0, la1 + 1e-9, 0.5))
+        for la, lo in zip(lats.ravel(), lons.ravel()):
+            rows.append({"name": f"{name}_{la:.1f}_{lo:.1f}", "admin1": "",
+                         "cc": "XX", "lat": la, "lon": lo})
+    table = pd.DataFrame(rows)
+    assert len(table) > 5000  # takes the geonames-scale branch
+    path = tmp_path / "dense.csv"
+    table.to_csv(path, index=False)
+    monkeypatch.setenv("ANOVOS_GEOCODE_TABLE", str(path))
+    got = mge.measure(write=False)
+    assert got["table_rows"] == len(table)
+    assert got["median_km"] < 50, got
+    assert got["p90_km"] < 150, got
