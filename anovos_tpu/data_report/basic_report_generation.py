@@ -104,9 +104,14 @@ def anovos_basic_report(
         "invalidEntries_detection",
     ):
         try:
-            _, stats = getattr(qc, fn)(
-                idf, drop_cols=drop, treatment=False, **stats_args(stats_dir, fn)
-            )
+            # only reference CSVs that actually landed — a stats pass that
+            # was skipped above must degrade this checker to recomputation,
+            # not crash the whole report on a missing file
+            extra = {
+                k: v for k, v in stats_args(stats_dir, fn).items()
+                if os.path.exists(v["file_path"])
+            }
+            _, stats = getattr(qc, fn)(idf, drop_cols=drop, treatment=False, **extra)
             save_stats(stats, output_path, fn, run_type=run_type, auth_key=auth_key)
         except TypeError as e:
             logging.getLogger(__name__).warning("basic report: %s skipped (%s)", fn, e)

@@ -46,14 +46,56 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.pa
 _SO_PATH = os.path.join(_NATIVE_DIR, "libanovos_native.so")
 
 
+def _build_so(src: str, out: Optional[str] = None) -> None:
+    subprocess.run(
+        ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", src,
+         "-o", out or _SO_PATH, "-lz"],
+        check=True,
+        capture_output=True,
+    )
+
+
+def _load_and_register(path: Optional[str] = None) -> ctypes.CDLL:
+    """CDLL + full argtypes.  Raises AttributeError if the .so predates a
+    newer export (the caller rebuilds from source and retries once)."""
+    lib = ctypes.CDLL(path or _SO_PATH)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    dpp = ctypes.POINTER(ctypes.POINTER(ctypes.c_double))
+    u8pp = ctypes.POINTER(u8p)
+    i64pp = ctypes.POINTER(i64p)
+    lib.avro_decode.restype = ctypes.c_int64
+    # full argtypes — ctypes' default c_int marshaling would truncate the
+    # int64_t length/offset params
+    lib.avro_decode.argtypes = [
+        u8p, ctypes.c_int64, i32p, i32p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int64, u8p, ctypes.c_int32, dpp, u8pp, i64pp, u8pp, i64p,
+    ]
+    lib.dict_encode.restype = ctypes.c_int64
+    lib.dict_encode.argtypes = [
+        u8p, i64p, u8p, ctypes.c_int64, i32p, i64p, u8p, ctypes.c_int64, i64p,
+    ]
+    lib.avro_encode.restype = ctypes.c_int64
+    lib.avro_encode.argtypes = [
+        i32p, ctypes.c_int32, ctypes.c_int64,
+        dpp, i64pp, u8pp, i64pp, u8pp,
+        ctypes.c_int32, u8p, ctypes.c_int64, u8p, ctypes.c_int64,
+    ]
+    lib.edge_components_minc.restype = ctypes.c_int64
+    lib.edge_components_minc.argtypes = [i64p, i64p, i64p, ctypes.c_int64,
+                                         ctypes.c_int64, ctypes.c_int64, i64p]
+    return lib
+
+
 def get_native() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native library; None if unavailable."""
     global _LIB, _TRIED
     if _LIB is not None or _TRIED:
         return _LIB
     _TRIED = True
+    src = os.path.join(_NATIVE_DIR, "anovos_native.cpp")
     try:
-        src = os.path.join(_NATIVE_DIR, "anovos_native.cpp")
         stale = (
             os.path.exists(_SO_PATH)
             and os.path.exists(src)
@@ -64,43 +106,23 @@ def get_native() -> Optional[ctypes.CDLL]:
                 return None
             # rebuild whenever the source is newer — a stale cached .so would
             # silently lack newer exports and route callers to slow fallbacks
-            subprocess.run(
-                ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", src, "-o", _SO_PATH, "-lz"],
-                check=True,
-                capture_output=True,
-            )
-        lib = ctypes.CDLL(_SO_PATH)
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        i32p = ctypes.POINTER(ctypes.c_int32)
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        dpp = ctypes.POINTER(ctypes.POINTER(ctypes.c_double))
-        u8pp = ctypes.POINTER(u8p)
-        i64pp = ctypes.POINTER(i64p)
-        lib.avro_decode.restype = ctypes.c_int64
-        # full argtypes — ctypes' default c_int marshaling would truncate the
-        # int64_t length/offset params
-        lib.avro_decode.argtypes = [
-            u8p, ctypes.c_int64, i32p, i32p, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int64, u8p, ctypes.c_int32, dpp, u8pp, i64pp, u8pp, i64p,
-        ]
-        lib.dict_encode.restype = ctypes.c_int64
-        lib.dict_encode.argtypes = [
-            u8p, i64p, u8p, ctypes.c_int64, i32p, i64p, u8p, ctypes.c_int64, i64p,
-        ]
-        lib.avro_encode.restype = ctypes.c_int64
-        lib.avro_encode.argtypes = [
-            i32p, ctypes.c_int32, ctypes.c_int64,
-            dpp, i64pp, u8pp, i64pp, u8pp,
-            ctypes.c_int32, u8p, ctypes.c_int64, u8p, ctypes.c_int64,
-        ]
-        lib.edge_components_minc.restype = ctypes.c_int64
-        lib.edge_components_minc.argtypes = [i64p, i64p, i64p, ctypes.c_int64,
-                                             ctypes.c_int64, ctypes.c_int64, i64p]
-        _LIB = lib
+            _build_so(src)
+        try:
+            _LIB = _load_and_register()
+        except AttributeError:
+            # a prebuilt .so missing a newer export with mtimes the staleness
+            # check can't see (rsync -a / tar deployment): rebuild from the
+            # source sitting right next to it and retry ONCE — disabling the
+            # whole native layer over one missing symbol would silently drop
+            # every avro ingest to the slow Python path.  The retry loads
+            # from a FRESH filename: dlopen refcounts by path, so reloading
+            # the overwritten original would hand back the stale mapping.
+            if not os.path.exists(src):
+                raise
+            rebuilt = _SO_PATH + ".rebuilt.so"
+            _build_so(src, out=rebuilt)
+            _LIB = _load_and_register(rebuilt)
     except (OSError, subprocess.CalledProcessError, AttributeError):
-        # AttributeError: a stale prebuilt .so lacking newer exports (mtime
-        # check defeated by rsync -a / tar deployment) must degrade to the
-        # Python fallbacks, not crash every native caller
         _LIB = None
     return _LIB
 

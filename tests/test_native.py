@@ -175,3 +175,41 @@ def test_dbscan_grid_native_equals_scipy_fallback():
     finally:
         nat.native_edge_components_minc = orig
     np.testing.assert_array_equal(native, fallback)
+
+
+def test_stale_so_rebuilds_instead_of_disabling(tmp_path, monkeypatch):
+    """A prebuilt .so missing a newer export (mtimes equal — rsync -a/tar
+    deployment defeats the staleness check) must trigger a rebuild from
+    the adjacent source and load, not silently disable the whole native
+    layer."""
+    import os
+    import shutil
+    import subprocess
+
+    import anovos_tpu.shared.native as nat
+
+    if nat.get_native() is None:
+        import pytest
+
+        pytest.skip("no toolchain")
+    src = os.path.join(tmp_path, "anovos_native.cpp")
+    shutil.copy(os.path.join(os.path.dirname(__file__), "..", "native",
+                             "anovos_native.cpp"), src)
+    stale_src = tmp_path / "old.cpp"
+    stale_src.write_text('extern "C" { long long avro_decode() { return -9; } }\n')
+    so = os.path.join(tmp_path, "libanovos_native.so")
+    subprocess.run(["g++", "-O3", "-shared", "-fPIC", str(stale_src), "-o", so],
+                   check=True)
+    # equal mtimes: the src-newer check must NOT fire; only the missing
+    # edge_components_minc symbol reveals the staleness
+    t = os.path.getmtime(src)
+    os.utime(so, (t, t))
+    monkeypatch.setattr(nat, "_NATIVE_DIR", str(tmp_path))
+    monkeypatch.setattr(nat, "_SO_PATH", so)
+    monkeypatch.setattr(nat, "_LIB", None)
+    monkeypatch.setattr(nat, "_TRIED", False)
+    lib = nat.get_native()
+    assert lib is not None and hasattr(lib, "edge_components_minc")
+    # restore the module-level cache for other tests in this process
+    monkeypatch.setattr(nat, "_LIB", None)
+    monkeypatch.setattr(nat, "_TRIED", False)

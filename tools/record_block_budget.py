@@ -5,9 +5,10 @@ Runs configs_full twice in one process on the SAME 8-virtual-device CPU
 mesh the test suite uses (cold pass compiles, warm pass is the measured
 steady state), then writes tests/golden/e2e_block_budget.csv with one row
 per workflow block: the recorded warm wall and a budget of
-3 x warm + 0.5 s (floor 1.0 s — sub-second blocks jitter up to ~2.5x
-under full-suite memory/cache contention, measured; the tripwire targets
-round-4-class regressions, which were 5-10x).  tests/test_workflow_e2e.py
+5 x warm + 0.5 s (floor 1.0 s — host-heavy blocks have been
+measured up to ~4.2x their quiet wall under full-suite memory/cache
+contention; the tripwire targets round-4-class regressions, which were
+5-10x on top of that).  tests/test_workflow_e2e.py
 asserts a fresh warm run stays inside the budget, so a block-level perf
 regression fails the suite instead of waiting for the next round of
 manual profiling.
@@ -78,7 +79,7 @@ def main() -> None:
         {
             "block": k,
             "warm_s": round(v, 3),
-            "budget_s": max(1.0, round(3.0 * v + 0.5, 1)),
+            "budget_s": max(1.0, round(5.0 * v + 0.5, 1)),
         }
         for k, v in warm.items()
     ]
