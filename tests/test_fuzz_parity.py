@@ -232,3 +232,42 @@ def test_binning_matches_golden_encoder_on_random_frames(seed, monkeypatch, tmp_
             w = want.loc[(c, method)]
             for j in range(1, 11):
                 assert counts[j - 1] == w[f"bin_{j}"], (method, c, j)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_drift_all_metrics_match_golden_encoder(seed, monkeypatch):
+    """All four drift metrics (PSI, HD, JSD, KS) + the flagged verdict vs
+    the golden encoder on random frames — the PSI-only fuzz above uses the
+    bench oracle; this one pins the full metric family including the
+    1e-4 zero-replacement and the cumulative KS ordering."""
+    import tempfile
+
+    from anovos_tpu.drift_stability import statistics
+
+    rng = np.random.default_rng(8000 + seed)
+    n = int(rng.choice([600, 2400]))
+    src = pd.DataFrame({
+        "x": rng.normal(0, 1, n).astype(np.float32).astype(float),
+        "c": rng.choice(["a", "b", "c", "only_src"], n, p=[0.5, 0.3, 0.15, 0.05]),
+    })
+    tgt = pd.DataFrame({
+        "x": rng.normal(0.6, 1.2, n).astype(np.float32).astype(float),
+        "c": rng.choice(["a", "b", "d"], n, p=[0.4, 0.3, 0.3]),
+    })
+    gg = _golden_module()
+    monkeypatch.setattr(gg, "NUM_COLS", ["x"])
+    monkeypatch.setattr(gg, "CAT_COLS", ["c"])
+    want = gg.golden_drift(src, tgt).set_index("attribute")
+
+    import os as _os
+
+    with tempfile.TemporaryDirectory() as d:
+        odf = statistics(
+            Table.from_pandas(tgt), Table.from_pandas(src),
+            method_type="all", use_sampling=False,
+            source_path=_os.path.join(d, "s"), bin_size=10,
+        ).set_index("attribute")
+    for col in ("x", "c"):
+        for m in ("PSI", "HD", "JSD", "KS"):
+            assert abs(float(odf.loc[col, m]) - float(want.loc[col, m])) < 5e-3, (col, m)
+        assert int(odf.loc[col, "flagged"]) == int(want.loc[col, "flagged"]), col
