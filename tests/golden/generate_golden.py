@@ -402,15 +402,17 @@ def stability_datasets():
     ]
 
 
-def golden_stability():
+def golden_stability(datasets=None):
     """stability_index_computation semantics (reference stability.py:15-334)
     on a DETERMINISTIC synthetic 3-dataset history (seeded; the test rebuilds
     the same datasets): per-dataset mean/stddev/kurtosis(+3), CV of each
     metric across datasets (SAMPLE stddev ddof=1 — Spark's F.stddev), CV→SI
-    map, weighted SI with the 50/30/20 default weights."""
-    datasets = stability_datasets()
+    map, weighted SI with the 50/30/20 default weights.  ``datasets``
+    overrides the fixture history (the fuzz sweep feeds random histories)."""
+    if datasets is None:
+        datasets = stability_datasets()
     rows = []
-    for c in ("steady", "drifty"):
+    for c in datasets[0].columns:
         means, stds, kurts = [], [], []
         for d in datasets:
             v = d[c].to_numpy(float)

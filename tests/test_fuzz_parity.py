@@ -271,3 +271,38 @@ def test_drift_all_metrics_match_golden_encoder(seed, monkeypatch):
         for m in ("PSI", "HD", "JSD", "KS"):
             assert abs(float(odf.loc[col, m]) - float(want.loc[col, m])) < 5e-3, (col, m)
         assert int(odf.loc[col, "flagged"]) == int(want.loc[col, "flagged"]), col
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_stability_matches_golden_encoder_on_random_histories(seed):
+    """stability_index_computation vs the golden encoder on RANDOM
+    multi-dataset histories (3-5 periods, drifting and steady columns,
+    varying lengths) — CV computation (sample stddev), the CV->SI score
+    map, and the 50/30/20 weighted index."""
+    from anovos_tpu.drift_stability import stability_index_computation
+
+    rng = np.random.default_rng(9000 + seed)
+    periods = int(rng.integers(3, 6))
+    datasets = [
+        pd.DataFrame({
+            "s": rng.normal(50.0, 2.0, 1500).astype(np.float32).astype(float),
+            "d": rng.normal(50.0 + 25.0 * i, 2.0 + 1.5 * i, 1500)
+                 .astype(np.float32).astype(float),
+            "w": rng.gamma(2.0 + 0.2 * i, 3.0, 1500).astype(np.float32).astype(float),
+        })
+        for i in range(periods)
+    ]
+    gg = _golden_module()
+    want = gg.golden_stability(datasets).set_index("attribute")
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        got = stability_index_computation(
+            *[Table.from_pandas(p) for p in datasets],
+            appended_metric_path=d,
+        ).set_index("attribute")
+    for c in ("s", "d", "w"):
+        for m in ("mean_si", "stddev_si", "kurtosis_si"):
+            assert int(got.loc[c, m]) == int(want.loc[c, m]), (c, m, got.loc[c], want.loc[c])
+        assert abs(float(got.loc[c, "stability_index"]) - float(want.loc[c, "stability_index"])) < 1e-6, c
