@@ -528,6 +528,53 @@ def _is_invalid_value(
     return False
 
 
+_AUTO_VOCAB_ARR = np.array(_NULL_VOCAB + _SPECIAL_CHARS)
+
+
+def _is_invalid_values_bulk(
+    values, detection_type: str, invalid_entries: List[str], valid_entries: List[str],
+    partial_match: bool, normalized: bool = False
+) -> np.ndarray:
+    """Vectorized ``_is_invalid_value`` over a batch of distinct values.
+
+    The scan is the per-distinct hot loop of invalidEntries_detection
+    (~10⁵ Python calls on a high-cardinality numeric column).  In auto mode
+    a numpy pre-filter keeps only values that CAN be invalid — vocab/
+    special-char membership, ≥3 identical adjacent chars (a necessary
+    condition for the repeated-token regex), or a full consecutive-ordinal
+    run (computed exactly) — and the reference per-value check runs only on
+    those survivors, so semantics are byte-identical to the scalar loop.
+    Manual allow/deny lists check every value, as before."""
+    n = len(values)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    # normalization in C (np.char) — the scalar loop pays three Python
+    # string methods per value here, which dominates its runtime.  Numeric
+    # reprs (str(int)/str(float)) are lowercase and space-free by
+    # construction; their call sites pass normalized=True to skip the pass.
+    U = np.array([v if isinstance(v, str) else str(v) for v in values], dtype="U")
+    if not normalized:
+        U = np.char.strip(np.char.lower(U))
+    if detection_type in ("manual", "both") and (invalid_entries or valid_entries):
+        cand = np.ones(n, dtype=bool)  # manual regexes: no cheap necessary condition
+    elif detection_type not in ("auto", "both"):
+        return np.zeros(n, dtype=bool)
+    else:
+        width = U.dtype.itemsize // 4
+        cand = np.isin(U, _AUTO_VOCAB_ARR)
+        if width >= 3:
+            M = np.ascontiguousarray(U).view(np.uint32).reshape(n, width)
+            eq3 = (M[:, 2:] == M[:, 1:-1]) & (M[:, 1:-1] == M[:, :-2]) & (M[:, 2:] != 0)
+            cand |= eq3.any(axis=1)
+            lens = np.char.str_len(U)
+            steps = ((M[:, 1:].astype(np.int64) - M[:, :-1].astype(np.int64)) == 1) & (M[:, 1:] != 0)
+            cand |= (lens >= 3) & (steps.sum(axis=1) == lens - 1)
+    out = np.zeros(n, dtype=bool)
+    for i in np.flatnonzero(cand):
+        out[i] = _is_invalid_value(str(U[i]), detection_type, invalid_entries, valid_entries, partial_match)
+    return out
+
+
 def _unique_compact(data: jax.Array, mask: jax.Array):
     """Sorted distinct values scattered to a prefix buffer, on device.
     Returns (buffer (rows+1,), nu) — callers slice buffer[:nu] so only the
@@ -617,11 +664,11 @@ def invalidEntries_detection(
     for c in cols:
         col = idf.columns[c]
         if col.kind == "cat":
-            bad_codes = [
-                i
-                for i, v in enumerate(col.vocab)
-                if _is_invalid_value(v, detection_type, invalid_entries, valid_entries, partial_match)
-            ]
+            bad_codes = np.flatnonzero(
+                _is_invalid_values_bulk(
+                    list(col.vocab), detection_type, invalid_entries, valid_entries, partial_match
+                )
+            ).tolist()
             bad_vals = [str(col.vocab[i]) for i in bad_codes]
             lut = np.zeros(max(len(col.vocab), 1), dtype=bool)
             lut[bad_codes] = True
@@ -634,9 +681,9 @@ def invalidEntries_detection(
             hmask = np.asarray(jax.device_get(col.mask))[: idf.nrows]
             uniq = np.unique(host[hmask])
             reprs = [str(int(u)) for u in uniq]
-            bad_u = np.array(
-                [_is_invalid_value(r, detection_type, invalid_entries, valid_entries, partial_match) for r in reprs],
-                dtype=bool,
+            bad_u = _is_invalid_values_bulk(
+                reprs, detection_type, invalid_entries, valid_entries, partial_match,
+                normalized=True,
             )
             bad_vals = [r for r, b in zip(reprs, bad_u) if b]
             inv_host = np.isin(host, uniq[bad_u]) & hmask
@@ -657,9 +704,9 @@ def invalidEntries_detection(
             uniq = np.asarray(jax.device_get(buf))[:nu]
             is_int = col.data.dtype in (jnp.int32, jnp.int16, jnp.int8)
             reprs = [str(int(u)) if is_int else str(float(u)) for u in uniq]
-            bad_u = np.array(
-                [_is_invalid_value(r, detection_type, invalid_entries, valid_entries, partial_match) for r in reprs],
-                dtype=bool,
+            bad_u = _is_invalid_values_bulk(
+                reprs, detection_type, invalid_entries, valid_entries, partial_match,
+                normalized=True,
             )
             bad_vals = [r for r, b in zip(reprs, bad_u) if b]
             bad_full = np.zeros(buf.shape[0], dtype=bool)

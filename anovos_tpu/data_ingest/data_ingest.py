@@ -220,7 +220,9 @@ def write_dataset(
     df = idf.to_pandas()
     parts = np.array_split(np.arange(len(df)), max(repartition, 1))
     for i, part_idx in enumerate(parts):
-        part = df.iloc[part_idx]
+        # single-part writes (the checkpoint default) skip the fancy-index
+        # row copy — df.iloc[arange] materializes a full second frame
+        part = df if len(parts) == 1 else df.iloc[part_idx]
         stem = os.path.join(file_path, f"part-{i:05d}")
         if file_type == "csv":
             header = str(cfg.get("header", True)).lower() in ("true", "1")

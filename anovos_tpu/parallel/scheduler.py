@@ -1,0 +1,351 @@
+"""Dependency-aware DAG executor for the workflow runner.
+
+The reference pipeline inherits overlap for free from Spark's scheduler;
+this framework's runner used to walk the YAML blocks one at a time on a
+single host thread, so the pipeline ran as slow as the SUM of its blocks
+instead of its critical path.  Here each config block registers as a node
+declaring the resources it ``reads`` and ``writes`` (the current ``df``
+version, stats CSVs, report subtrees), and nodes whose inputs are ready run
+concurrently on a bounded worker pool.  Heavy work lives in XLA/NumPy/
+pyarrow, which release the GIL, so device compute from one block overlaps
+host-side CSV/plotting work from another.
+
+Design properties:
+
+* **Edges are derived, not declared.**  ``add()`` wires read-after-write,
+  write-after-write and write-after-read dependencies from the declared
+  resource sets, always pointing at ALREADY-registered nodes — so the graph
+  is acyclic by construction and registration order is a valid topological
+  order.  Sequential mode simply executes that order, which is exactly the
+  YAML walk the runner performed before.
+* **Failure semantics match the sequential runner.**  A node registered
+  with ``on_error="raise"`` aborts the run: no new nodes start, in-flight
+  nodes finish, and the ORIGINAL exception is re-raised.  ``"continue"``
+  nodes log and are treated as done.  NOTE: the workflow registers every
+  node as ``"raise"`` and keeps the reference's best-effort try/except
+  INSIDE the geo/ts node bodies (so both executors share one isolation
+  path); ``"continue"`` is the generic policy for other graph authors.
+* **Hang watchdog.**  ``node_timeout`` bounds any single node; a stuck
+  node raises :class:`NodeTimeout` naming the block instead of deadlocking
+  the suite.  Workers are daemon threads so a wedged node cannot block
+  interpreter exit either.
+* **Observability.**  Per-node start/end/thread spans are recorded and
+  ``run()`` returns a summary with the measured critical path (longest
+  dependency chain by wall time) and the parallel speedup — surfaced in the
+  run log and in ``bench.py``'s e2e section.
+
+Caveat: concurrent mode must only run device work against a SINGLE-device
+runtime.  On a multi-device mesh, two concurrently dispatched programs that
+both carry cross-device collectives can enqueue onto the per-device streams
+in different orders and deadlock at their AllReduce rendezvous —
+``workflow.main`` enforces this by degrading to sequential when it sees
+more than one device.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Iterable, List, Optional
+
+logger = logging.getLogger("anovos_tpu.parallel.scheduler")
+
+__all__ = ["DagScheduler", "Node", "NodeTimeout", "default_workers"]
+
+
+class NodeTimeout(RuntimeError):
+    """A node exceeded the scheduler's per-node timeout (names the block)."""
+
+
+def default_workers() -> int:
+    """Worker-pool width: env override, else a small pool sized to the host.
+
+    On a single-core host a wide pool only timeshares compute and inflates
+    per-block walls; two workers still overlap device compute with host
+    file I/O (both release the GIL) without distorting block timings.
+    """
+    env = os.environ.get("ANOVOS_TPU_EXECUTOR_WORKERS", "")
+    if env:
+        return max(1, int(env))
+    return max(2, min(8, available_cpus()))
+
+
+def available_cpus() -> int:
+    """CPUs this process may actually run on — cgroup/cpuset-aware where the
+    platform supports it (os.cpu_count() reports the host's cores even in a
+    container pinned to one)."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except AttributeError:  # pragma: no cover - non-Linux
+        return os.cpu_count() or 1
+
+
+class Node:
+    __slots__ = (
+        "name", "fn", "reads", "writes", "on_error", "deps", "dependents",
+        "pending", "state", "start", "end", "thread", "error",
+    )
+
+    def __init__(self, name: str, fn: Callable[[], None], reads, writes, on_error: str):
+        self.name = name
+        self.fn = fn
+        self.reads = tuple(reads)
+        self.writes = tuple(writes)
+        self.on_error = on_error
+        self.deps: List["Node"] = []
+        self.dependents: List["Node"] = []
+        self.pending = 0            # unfinished deps (concurrent mode)
+        self.state = "pending"      # pending|running|done|failed|failed-continued|skipped
+        self.start = self.end = 0.0
+        self.thread = ""
+        self.error: Optional[BaseException] = None
+
+
+class DagScheduler:
+    """Register nodes with resource reads/writes, then ``run()`` them."""
+
+    def __init__(self, name: str = "dag"):
+        self.name = name
+        self._nodes: List[Node] = []
+        self._by_name: Dict[str, Node] = {}
+        self._last_writer: Dict[str, Node] = {}
+        self._readers_since_write: Dict[str, List[Node]] = {}
+
+    # -- registration ----------------------------------------------------
+    def add(
+        self,
+        name: str,
+        fn: Callable[[], None],
+        reads: Iterable[str] = (),
+        writes: Iterable[str] = (),
+        on_error: str = "raise",
+    ) -> Node:
+        """Register ``fn`` as node ``name``.
+
+        A read of a resource nobody has written yet is treated as an
+        external input (immediately available) — mirroring the sequential
+        runner, where a consumer registered before its producer would also
+        find only whatever pre-exists on disk.
+        """
+        if on_error not in ("raise", "continue"):
+            raise ValueError(f"on_error must be 'raise' or 'continue', got {on_error!r}")
+        if name in self._by_name:
+            raise ValueError(f"duplicate node name {name!r}")
+        node = Node(name, fn, reads, writes, on_error)
+        deps: "dict[int, Node]" = {}  # id -> Node, insertion-ordered, deduped
+        for r in node.reads:
+            w = self._last_writer.get(r)
+            if w is not None:
+                deps[id(w)] = w  # read-after-write
+        for w in node.writes:
+            prev = self._last_writer.get(w)
+            if prev is not None:
+                deps[id(prev)] = prev  # write-after-write
+            for rd in self._readers_since_write.get(w, ()):
+                deps[id(rd)] = rd  # write-after-read
+        deps.pop(id(node), None)
+        node.deps = list(deps.values())
+        for d in node.deps:
+            d.dependents.append(node)
+        # update resource maps AFTER wiring so a node never depends on itself
+        for r in node.reads:
+            self._readers_since_write.setdefault(r, []).append(node)
+        for w in node.writes:
+            self._last_writer[w] = node
+            self._readers_since_write[w] = []
+        self._nodes.append(node)
+        self._by_name[name] = node
+        return node
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    # -- execution -------------------------------------------------------
+    def run(
+        self,
+        mode: Optional[str] = None,
+        max_workers: Optional[int] = None,
+        node_timeout: Optional[float] = None,
+    ) -> dict:
+        """Execute all nodes; returns the run summary (see ``_summary``).
+
+        ``mode`` defaults to ``ANOVOS_TPU_EXECUTOR`` (``concurrent`` unless
+        set to ``sequential``).  ``node_timeout`` defaults to
+        ``ANOVOS_TPU_NODE_TIMEOUT`` seconds (0 disables the watchdog).
+        """
+        mode = mode or os.environ.get("ANOVOS_TPU_EXECUTOR", "concurrent")
+        if mode not in ("concurrent", "sequential"):
+            raise ValueError(f"unknown executor mode {mode!r} (concurrent|sequential)")
+        if node_timeout is None:
+            node_timeout = float(os.environ.get("ANOVOS_TPU_NODE_TIMEOUT", "900"))
+        t0 = time.monotonic()
+        if mode == "sequential":
+            workers = 1
+            self._run_sequential()
+        else:
+            workers = min(max_workers or default_workers(), max(len(self._nodes), 1))
+            self._run_concurrent(workers, node_timeout)
+        return self._summary(time.monotonic() - t0, mode, workers)
+
+    def _execute(self, node: Node) -> None:
+        node.state = "running"
+        node.thread = threading.current_thread().name
+        node.start = time.monotonic()
+        try:
+            node.fn()
+            node.state = "done"
+        except BaseException as e:
+            node.error = e
+            if node.on_error == "continue":
+                node.state = "failed-continued"
+                logger.exception("node %r failed; continuing (on_error=continue)", node.name)
+            else:
+                node.state = "failed"
+                raise
+        finally:
+            node.end = time.monotonic()
+
+    def _run_sequential(self) -> None:
+        for node in self._nodes:
+            self._execute(node)
+
+    def _run_concurrent(self, max_workers: int, node_timeout: float) -> None:
+        cv = threading.Condition()
+        ready: "deque[Node]" = deque()
+        running: Dict[str, float] = {}
+        state = {"stop": False, "fatal": None, "done": 0}
+        total = len(self._nodes)
+        for n in self._nodes:
+            n.pending = len(n.deps)
+            if n.pending == 0:
+                ready.append(n)
+
+        def finish(node: Node) -> None:
+            with cv:
+                running.pop(node.name, None)
+                state["done"] += 1
+                if node.state == "failed" and state["fatal"] is None:
+                    state["fatal"] = node.error
+                    state["stop"] = True
+                elif node.state in ("done", "failed-continued"):
+                    for dep in node.dependents:
+                        dep.pending -= 1
+                        if dep.pending == 0 and not state["stop"]:
+                            ready.append(dep)
+                cv.notify_all()
+
+        def worker() -> None:
+            while True:
+                with cv:
+                    while not ready and not state["stop"] and state["done"] < total:
+                        cv.wait(0.05)
+                    if state["stop"] or not ready:
+                        return
+                    node = ready.popleft()
+                    node.state = "claimed"
+                    running[node.name] = time.monotonic()
+                try:
+                    self._execute(node)
+                except BaseException:
+                    pass  # recorded on the node; surfaced via state["fatal"]
+                finish(node)
+
+        threads = [
+            threading.Thread(target=worker, name=f"{self.name}-w{i}", daemon=True)
+            for i in range(min(max_workers, max(total, 1)))
+        ]
+        for t in threads:
+            t.start()
+        with cv:
+            while state["done"] < total:
+                if state["stop"] and not running:
+                    break
+                cv.wait(0.1)
+                if node_timeout and node_timeout > 0:
+                    now = time.monotonic()
+                    for name, started in running.items():
+                        if now - started > node_timeout:
+                            state["stop"] = True
+                            state["fatal"] = NodeTimeout(
+                                f"scheduler node {name!r} still running after "
+                                f"{node_timeout:.0f}s — likely hung; aborting the run "
+                                f"(raise ANOVOS_TPU_NODE_TIMEOUT if the block is "
+                                f"legitimately slow)"
+                            )
+                            cv.notify_all()
+                            break
+                    if isinstance(state["fatal"], NodeTimeout):
+                        break
+        for n in self._nodes:
+            if n.state in ("pending", "claimed"):
+                n.state = "skipped"
+        if state["fatal"] is not None:
+            raise state["fatal"]
+        # workers exit on their own once done == total (daemon threads)
+
+    # -- observability ---------------------------------------------------
+    def _summary(self, wall_s: float, mode: str, workers: int) -> dict:
+        executed = [n for n in self._nodes if n.end > 0.0]
+        origin = min((n.start for n in executed), default=0.0)
+        durs = {n.name: n.end - n.start for n in executed}
+        serial = sum(durs.values())
+        # longest dependency chain by measured duration; registration order
+        # is a topological order so one forward pass suffices
+        best: Dict[str, float] = {}
+        prev: Dict[str, Optional[str]] = {}
+        for n in self._nodes:
+            d = durs.get(n.name, 0.0)
+            pick, plen = None, 0.0
+            for dep in n.deps:
+                if best.get(dep.name, 0.0) > plen:
+                    pick, plen = dep.name, best[dep.name]
+            best[n.name] = d + plen
+            prev[n.name] = pick
+        chain: List[str] = []
+        if best:
+            cur: Optional[str] = max(best, key=lambda k: best[k])
+            cp_len = best[cur]
+            while cur is not None:
+                chain.append(cur)
+                cur = prev[cur]
+            chain.reverse()
+        else:
+            cp_len = 0.0
+        return {
+            "mode": mode,
+            "workers": workers,  # the pool width this run actually used
+            "wall_s": round(wall_s, 4),
+            "serial_s": round(serial, 4),
+            "critical_path_s": round(cp_len, 4),
+            "parallel_speedup": round(serial / wall_s, 3) if wall_s > 0 else 0.0,
+            "critical_path": chain,
+            "nodes": {
+                n.name: {
+                    "start_s": round(n.start - origin, 4) if n.end else None,
+                    "end_s": round(n.end - origin, 4) if n.end else None,
+                    "dur_s": round(n.end - n.start, 4) if n.end else None,
+                    "thread": n.thread,
+                    "state": n.state,
+                }
+                for n in self._nodes
+            },
+        }
+
+    @staticmethod
+    def format_summary(summary: dict) -> str:
+        """One-paragraph critical-path report for the run log."""
+        chain = summary.get("critical_path", [])
+        nodes = summary.get("nodes", {})
+        hops = " -> ".join(
+            f"{name} ({nodes.get(name, {}).get('dur_s') or 0.0:.2f}s)" for name in chain
+        )
+        return (
+            f"scheduler[{summary.get('mode')}]: wall={summary.get('wall_s'):.2f}s "
+            f"serial={summary.get('serial_s'):.2f}s "
+            f"critical_path={summary.get('critical_path_s'):.2f}s "
+            f"parallel_speedup={summary.get('parallel_speedup'):.2f}x "
+            f"longest chain: {hops}"
+        )

@@ -9,6 +9,26 @@ blocks, nested keys are function names resolved by ``getattr`` (ref ETL
 (``shared/artifact_store.py``): local/databricks are path mappings,
 emr/ak8s stage locally and shell out to aws/azcopy like the reference;
 mlflow hooks activate when the package is importable.
+
+Execution model: the YAML walk REGISTERS each block as a node on a
+dependency-aware DAG scheduler (``parallel/scheduler.py``) instead of
+executing it inline.  Blocks that mutate ``df`` (ingest, quality
+treatments, transformers, ts auto-detection) form the sequential spine —
+each reads ``df`` version N and writes version N+1 — while read-only
+analyzers (stats metrics, associations, drift, geo, ts inspection, charts)
+fan out from the spine version current at their YAML position and run
+concurrently.  ``report_generation`` waits only on the analyzer nodes whose
+outputs it reads.  Artifact persistence (stats CSVs, chart JSONs,
+intermediate checkpoints) rides an async write queue
+(``shared.artifact_store.AsyncArtifactWriter``) drained at a single barrier
+before the report reads and before ``main()`` returns.
+
+``ANOVOS_TPU_EXECUTOR=sequential`` runs the registered nodes in
+registration order on the caller thread with synchronous writes — byte-for-
+byte the pre-scheduler behavior, and the golden-comparison mode for the
+concurrent executor.  ``ANOVOS_TPU_NODE_TIMEOUT`` (seconds, default 900)
+is the per-node hang watchdog; ``ANOVOS_TPU_EXECUTOR_WORKERS`` bounds the
+pool.
 """
 
 from __future__ import annotations
@@ -17,7 +37,8 @@ import contextlib
 import copy
 import logging
 import os
-import timeit
+import threading
+import time
 from typing import Optional
 
 import pandas as pd
@@ -26,12 +47,18 @@ import yaml
 from anovos_tpu.data_ingest import data_ingest
 from anovos_tpu.data_ingest.ts_auto_detection import ts_preprocess
 from anovos_tpu.data_analyzer import association_evaluator, quality_checker, stats_generator
-from anovos_tpu.data_report.basic_report_generation import anovos_basic_report
+from anovos_tpu.data_report.basic_report_generation import (
+    ARGS_TO_STATSFUNC,
+    CHECKER_STATS_ARGS,
+    anovos_basic_report,
+)
 from anovos_tpu.data_report.report_generation import anovos_report
 from anovos_tpu.data_report.report_preprocessing import charts_to_objects, save_stats
 from anovos_tpu.data_transformer import transformers
 from anovos_tpu.drift_stability import drift_detector as ddetector
 from anovos_tpu.drift_stability import stability as dstability
+from anovos_tpu.parallel.scheduler import DagScheduler
+from anovos_tpu.shared.artifact_store import AsyncArtifactWriter
 from anovos_tpu.shared.table import Table
 
 logger = logging.getLogger("anovos_tpu.workflow")
@@ -39,13 +66,34 @@ logger = logging.getLogger("anovos_tpu.workflow")
 # per-block wall times of the most recent main() run — the reference logs
 # these per block (workflow.py:227-244); recording them machine-readably as
 # well lets the e2e suite assert a committed per-block budget
-# (tests/golden/e2e_block_budget.csv) so perf regressions fail loudly
+# (tests/golden/e2e_block_budget.csv) so perf regressions fail loudly.
+# Concurrent-executor nodes log from worker threads, so updates go through
+# a lock; timestamps are monotonic-clock based (immune to wall clock steps).
 BLOCK_TIMES: dict = {}
+_BLOCK_TIMES_LOCK = threading.Lock()
+
+# scheduler summary (mode, wall/serial/critical-path seconds, speedup,
+# per-node spans) of the most recent main() run — bench.py's e2e section
+# surfaces these fields so the trajectory JSONs capture the win
+LAST_RUN_SUMMARY: dict = {}
+
+# stats CSVs each downstream function reads (via stats_args):
+# CHECKER_STATS_ARGS is the shared wiring table (one copy, used by the
+# basic report too); the workflow path additionally routes stats into
+# transformers and charts
+MAINFUNC_TO_ARGS = {
+    **CHECKER_STATS_ARGS,
+    "charts_to_objects": ["stats_unique"],
+    "cat_to_num_unsupervised": ["stats_unique"],
+    "PCA_latentFeatures": ["stats_missing"],
+    "autoencoder_latentFeatures": ["stats_missing"],
+}
 
 
 def _log_block_time(label: str, start: float) -> None:
-    secs = round(timeit.default_timer() - start, 4)
-    BLOCK_TIMES[label] = round(BLOCK_TIMES.get(label, 0.0) + secs, 4)
+    secs = round(time.monotonic() - start, 4)
+    with _BLOCK_TIMES_LOCK:
+        BLOCK_TIMES[label] = round(BLOCK_TIMES.get(label, 0.0) + secs, 4)
     logger.info(f"{label}: execution time (in secs) = {secs}")
 logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
 
@@ -63,9 +111,20 @@ def ETL(args: dict) -> Table:
     return df
 
 
-def save(data, write_configs: Optional[dict], folder_name: str, reread: bool = False):
+def save(
+    data,
+    write_configs: Optional[dict],
+    folder_name: str,
+    reread: bool = False,
+    writer: Optional[AsyncArtifactWriter] = None,
+    key: Optional[str] = None,
+):
     """Checkpoint a Table (or stats frame) under the write config's path
     (reference :64-88).
+
+    No write config → return the data untouched, before any path handling
+    (every intermediate step calls this; constructing paths for a ``None``
+    config would be pure waste).
 
     The reference's ``reread`` loads the checkpoint back to CUT THE SPARK
     LINEAGE — a lazy-DAG concern this framework does not have: a Table is
@@ -73,7 +132,12 @@ def save(data, write_configs: Optional[dict], folder_name: str, reread: bool = F
     artifact (same files on disk) and returns the in-memory data, skipping
     ~15 disk read-backs per configs_full run.  ``ANOVOS_REREAD_FROM_DISK=1``
     restores the literal read-back (for chasing a writer/reader parity bug:
-    it re-applies the CSV round-trip's dtype coercions mid-pipeline)."""
+    it re-applies the CSV round-trip's dtype coercions mid-pipeline).
+
+    With ``writer`` (and no read-back requested) the disk write is queued on
+    the async artifact writer under ``key`` and the in-memory data returns
+    immediately; the queue is drained before ``main()`` returns.
+    """
     if not write_configs:
         return data
     if "file_path" not in write_configs:
@@ -82,20 +146,29 @@ def save(data, write_configs: Optional[dict], folder_name: str, reread: bool = F
     write.pop("mlflow_run_id", "")
     write.pop("log_mlflow", False)
     write["file_path"] = os.path.join(write["file_path"], folder_name)
-    from_disk = os.environ.get("ANOVOS_REREAD_FROM_DISK", "0") == "1"
+    from_disk = reread and os.environ.get("ANOVOS_REREAD_FROM_DISK", "0") == "1"
     if isinstance(data, pd.DataFrame):
         from anovos_tpu.shared.table import Table as _T
 
+        if writer is not None and not from_disk:
+            writer.submit(
+                key or f"ckpt:{folder_name}",
+                lambda: data_ingest.write_dataset(_T.from_pandas(data), **write),
+            )
+            return data
         data_t = _T.from_pandas(data)
         data_ingest.write_dataset(data_t, **write)
-        if reread and from_disk:
+        if from_disk:
             return data_ingest.read_dataset(
                 write["file_path"], write.get("file_type", "csv"),
                 _clean_read_cfg(write.get("file_configs")),
             ).to_pandas()
         return data
+    if writer is not None and not from_disk:
+        writer.submit(key or f"ckpt:{folder_name}", data_ingest.write_dataset, data, **write)
+        return data
     data_ingest.write_dataset(data, **write)
-    if reread and from_disk:
+    if from_disk:
         return data_ingest.read_dataset(
             write["file_path"], write.get("file_type", "csv"), _clean_read_cfg(write.get("file_configs"))
         )
@@ -129,20 +202,6 @@ def stats_args(
     result = {}
     if not stats_configs:
         return result
-    # shared wiring tables (basic_report_generation is the one copy); the
-    # workflow path additionally routes stats into transformers and charts
-    from anovos_tpu.data_report.basic_report_generation import (
-        ARGS_TO_STATSFUNC as args_to_statsfunc,
-        CHECKER_STATS_ARGS,
-    )
-
-    mainfunc_to_args = {
-        **CHECKER_STATS_ARGS,
-        "charts_to_objects": ["stats_unique"],
-        "cat_to_num_unsupervised": ["stats_unique"],
-        "PCA_latentFeatures": ["stats_missing"],
-        "autoencoder_latentFeatures": ["stats_missing"],
-    }
     if report_input_path:
         from anovos_tpu.shared.artifact_store import for_run_type
 
@@ -159,10 +218,10 @@ def stats_args(
                 report_input_path = store.pull_dir(configured, report_input_path)
             except Exception as e:  # nothing remote yet: same-process flow
                 logger.warning("stats pull from %s failed (%s); using staging", configured, e)
-    for arg in mainfunc_to_args.get(func, []):
+    for arg in MAINFUNC_TO_ARGS.get(func, []):
         if report_input_path:
             result[arg] = {
-                "file_path": os.path.join(report_input_path, args_to_statsfunc[arg] + ".csv"),
+                "file_path": os.path.join(report_input_path, ARGS_TO_STATSFUNC[arg] + ".csv"),
                 "file_type": "csv",
                 "file_configs": {"header": True, "inferSchema": True},
             }
@@ -170,23 +229,124 @@ def stats_args(
             read = copy.deepcopy(write_configs)
             read["file_configs"] = _clean_read_cfg(read.get("file_configs"))
             read["file_path"] = os.path.join(
-                read["file_path"], "data_analyzer/stats_generator", args_to_statsfunc[arg]
+                read["file_path"], "data_analyzer/stats_generator", ARGS_TO_STATSFUNC[arg]
             )
             result[arg] = read
     return result
 
 
-def _auth_key(auth_key_val: dict) -> str:
+def _stats_deps(all_configs: dict, func: str) -> tuple:
+    """Scheduler resources ``func`` will READ through ``stats_args`` — the
+    ``stats:<metric>`` CSVs the configured stats_generator produces.  Only
+    resources some node actually writes become edges (the scheduler ignores
+    reads of never-written resources, mirroring the sequential runner where
+    a consumer simply finds whatever pre-exists on disk)."""
+    stats_configs = all_configs.get("stats_generator") or {}
+    if not stats_configs:
+        return ()
+    if not (all_configs.get("report_preprocessing") or all_configs.get("write_stats")):
+        return ()
+    metrics = set(stats_configs.get("metric", []) or [])
+    return tuple(
+        f"stats:{ARGS_TO_STATSFUNC[a]}"
+        for a in MAINFUNC_TO_ARGS.get(func, [])
+        if ARGS_TO_STATSFUNC[a] in metrics
+    )
+
+
+def _auth_key(auth_key_val: Optional[dict]) -> str:
     """The SAS token is the last value of the auth dict (reference :148-157
     sets each pair on the spark conf and keeps the last value as auth_key)."""
     return list(auth_key_val.values())[-1] if auth_key_val else "NA"
 
 
-def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) -> None:
-    start_main = timeit.default_timer()
-    BLOCK_TIMES.clear()  # the table always describes the most recent run
+class _PipelineRun:
+    """Per-run registrar: turns the YAML walk into scheduler nodes.
+
+    Spine nodes thread ``df`` through explicit versions (``df:N`` →
+    ``df:N+1``); fan-out nodes pin the version current at their YAML
+    position, so a later spine mutation can never leak backwards into a
+    concurrently-running analyzer.  Versions are dropped once their last
+    registered reader releases them, bounding peak memory to the live
+    working set instead of the whole version history."""
+
+    def __init__(self, sched: DagScheduler, writer: AsyncArtifactWriter, df0: Table):
+        self.sched = sched
+        self.writer = writer
+        self._versions = {0: df0}
+        self._planned_readers: dict = {}
+        self._ver = 0
+        self._lock = threading.Lock()
+        self.artifact_keys: list = []  # registration-ordered unique resources
+
+    # -- df version store ------------------------------------------------
+    def _claim(self, v: int) -> None:
+        self._planned_readers[v] = self._planned_readers.get(v, 0) + 1
+
+    def _release(self, v: int) -> None:
+        with self._lock:
+            self._planned_readers[v] -= 1
+            if self._planned_readers[v] <= 0 and v != self._ver:
+                self._versions.pop(v, None)
+
+    def current_df(self) -> Table:
+        return self._versions[self._ver]
+
+    def _track(self, writes) -> None:
+        for w in writes:
+            if w not in self.artifact_keys:
+                self.artifact_keys.append(w)
+
+    # -- node registration -------------------------------------------------
+    def spine(self, name, fn, reads=(), writes=(), timed=None) -> None:
+        """``fn(df) -> df`` mutates the table: df version N → N+1."""
+        v, out_v = self._ver, self._ver + 1
+        self._ver = out_v
+        self._claim(v)
+        reads = tuple(reads)
+
+        def body():
+            self.writer.wait(reads)
+            df_in = self._versions[v]
+            t0 = time.monotonic()
+            df_out = fn(df_in)
+            if timed:
+                _log_block_time(timed, t0)
+            self._versions[out_v] = df_out if df_out is not None else df_in
+            self._release(v)
+
+        self.sched.add(name, body, reads=(f"df:{v}",) + reads,
+                       writes=(f"df:{out_v}",) + tuple(writes))
+        self._track(writes)
+
+    def fanout(self, name, fn, reads=(), writes=(), timed=None) -> None:
+        """``fn(df)`` only reads the table: pinned to the current version."""
+        v = self._ver
+        self._claim(v)
+        reads = tuple(reads)
+
+        def body():
+            self.writer.wait(reads)
+            df_in = self._versions[v]
+            t0 = time.monotonic()
+            fn(df_in)
+            if timed:
+                _log_block_time(timed, t0)
+            self._release(v)
+
+        self.sched.add(name, body, reads=(f"df:{v}",) + reads, writes=tuple(writes))
+        self._track(writes)
+
+
+def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict] = None) -> None:
+    global LAST_RUN_SUMMARY
+    start_main = time.monotonic()
+    with _BLOCK_TIMES_LOCK:
+        BLOCK_TIMES.clear()  # the table always describes the most recent run
+    LAST_RUN_SUMMARY = {}
     auth_key = _auth_key(auth_key_val)
     df = ETL(all_configs.get("input_dataset"))
+    base_df = df  # pre-treatment ingest result (drift source reuse)
 
     write_main = all_configs.get("write_main", None)
     write_intermediate = all_configs.get("write_intermediate", None)
@@ -215,70 +375,109 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
     basic_report_flag = all_configs.get("anovos_basic_report", {}) or {}
     basic_report_flag = basic_report_flag.get("basic_report", False)
 
+    # executor selection: ANOVOS_TPU_EXECUTOR wins; the auto default runs
+    # the DAG concurrently wherever a second core exists and degenerates to
+    # the sequential schedule on single-core hosts, where worker threads
+    # can only timeshare the core and inflate the wall (measured +4-15%)
+    from anovos_tpu.parallel.scheduler import available_cpus
+
+    mode = os.environ.get("ANOVOS_TPU_EXECUTOR", "") or (
+        "concurrent" if available_cpus() > 1 else "sequential"
+    )
+    if mode == "concurrent":
+        # HARD constraint: concurrent block execution needs a single-device
+        # runtime.  On a multi-device mesh most kernels carry cross-device
+        # collectives, and two programs dispatched from different threads
+        # can enqueue onto the per-device streams in different orders —
+        # both then wait forever at their AllReduce rendezvous (observed as
+        # a watchdog-killed IV_calculation/charts pair on the 8-virtual-
+        # device test mesh).  Multi-chip block placement needs disjoint
+        # per-node device subsets — future work, not thread overlap.
+        try:
+            import jax
+
+            n_dev = len(jax.devices())
+        except Exception:  # pragma: no cover - no backend at all
+            n_dev = 1
+        if n_dev > 1:
+            logger.warning(
+                "concurrent executor requires a single-device runtime "
+                "(%d devices present): cross-device collective rendezvous "
+                "from concurrently dispatched programs deadlock; running "
+                "the DAG sequentially", n_dev,
+            )
+            mode = "sequential"
+    writer = AsyncArtifactWriter(
+        workers=int(os.environ.get("ANOVOS_TPU_WRITER_WORKERS", "2")),
+        sync=(mode == "sequential"),
+    )
+    sched = DagScheduler(name="workflow")
+    pipe = _PipelineRun(sched, writer, df)
+
     with mlflow_ctx:
         for key, args in all_configs.items():
             if key == "concatenate_dataset" and args is not None:
-                start = timeit.default_timer()
-                idfs = [df] + [ETL(args[k]) for k in args if k not in ("method", "method_type")]
-                df = data_ingest.concatenate_dataset(
-                    *idfs, method_type=args.get("method", args.get("method_type", "name"))
-                )
-                df = save(df, write_intermediate, "data_ingest/concatenate_dataset", reread=True)
-                _log_block_time(key, start)
+                def _concat(df, args=args):
+                    idfs = [df] + [ETL(args[k]) for k in args if k not in ("method", "method_type")]
+                    out = data_ingest.concatenate_dataset(
+                        *idfs, method_type=args.get("method", args.get("method_type", "name"))
+                    )
+                    return save(out, write_intermediate, "data_ingest/concatenate_dataset",
+                                reread=True, writer=writer)
+                pipe.spine("concatenate_dataset", _concat, timed="concatenate_dataset")
                 continue
 
             if key == "join_dataset" and args is not None:
-                start = timeit.default_timer()
-                idfs = [df] + [ETL(args[k]) for k in args if k not in ("join_type", "join_cols")]
-                df = data_ingest.join_dataset(
-                    *idfs, join_cols=args.get("join_cols"), join_type=args.get("join_type")
-                )
-                df = save(df, write_intermediate, "data_ingest/join_dataset", reread=True)
-                _log_block_time(key, start)
+                def _join(df, args=args):
+                    idfs = [df] + [ETL(args[k]) for k in args if k not in ("join_type", "join_cols")]
+                    out = data_ingest.join_dataset(
+                        *idfs, join_cols=args.get("join_cols"), join_type=args.get("join_type")
+                    )
+                    return save(out, write_intermediate, "data_ingest/join_dataset",
+                                reread=True, writer=writer)
+                pipe.spine("join_dataset", _join, timed="join_dataset")
                 continue
 
             if key == "timeseries_analyzer" and args is not None:
-                start = timeit.default_timer()
                 # omit None-valued config keys so callee defaults apply
                 opt = {k: v for k, v in args.items() if v is not None}
-                # auto-detection is best-effort in the reference too
-                # (ts_auto_detection.py:707 swallows per-column failures):
-                # a malformed timestamp column must not kill the pipeline,
-                # and a detection failure must not also cost the inspection
-                try:
-                    if opt.get("auto_detection", False):
-                        df = ts_preprocess(
-                            df, opt.get("id_col"), output_path=report_input_path or ".",
-                            tz_offset=opt.get("tz_offset", "local"), run_type=run_type,
-                        )
-                except Exception:
-                    logger.exception("ts auto-detection failed; continuing with the raw table")
-                try:
-                    if opt.get("inspection", False):
-                        from anovos_tpu.data_analyzer.ts_analyzer import ts_analyzer
+                if opt.get("auto_detection", False):
+                    # auto-detection is best-effort in the reference too
+                    # (ts_auto_detection.py:707 swallows per-column failures):
+                    # a malformed timestamp column must not kill the pipeline,
+                    # and a detection failure must not also cost the inspection
+                    def _ts_auto(df, opt=opt):
+                        try:
+                            return ts_preprocess(
+                                df, opt.get("id_col"), output_path=report_input_path or ".",
+                                tz_offset=opt.get("tz_offset", "local"), run_type=run_type,
+                            )
+                        except Exception:
+                            logger.exception("ts auto-detection failed; continuing with the raw table")
+                            return df
+                    pipe.spine("timeseries_analyzer/auto_detection", _ts_auto,
+                               writes=("report:ts_autodetect",), timed="timeseries_analyzer")
+                if opt.get("inspection", False):
+                    def _ts_inspect(df, opt=opt):
+                        try:
+                            from anovos_tpu.data_analyzer.ts_analyzer import ts_analyzer
 
-                        kw = {
-                            k: opt[k]
-                            for k in ("max_days", "tz_offset")
-                            if k in opt
-                        }
-                        if "analysis_level" in opt:
-                            kw["output_type"] = opt["analysis_level"]
-                        ts_analyzer(
-                            df, opt.get("id_col"), output_path=report_input_path or ".",
-                            run_type=run_type, **kw,
-                        )
-                except Exception:
-                    logger.exception("ts inspection failed; continuing without ts analysis")
-                _log_block_time(key, start)
+                            kw = {k: opt[k] for k in ("max_days", "tz_offset") if k in opt}
+                            if "analysis_level" in opt:
+                                kw["output_type"] = opt["analysis_level"]
+                            ts_analyzer(
+                                df, opt.get("id_col"), output_path=report_input_path or ".",
+                                run_type=run_type, **kw,
+                            )
+                        except Exception:
+                            logger.exception("ts inspection failed; continuing without ts analysis")
+                    pipe.fanout("timeseries_analyzer/inspection", _ts_inspect,
+                                writes=("report:ts_inspection",), timed="timeseries_analyzer")
                 continue
 
             if key == "geospatial_controller" and args is not None:
                 ga = args.get("geospatial_analyzer", {}) or {}
                 if ga.get("auto_detection_analyzer", False):
-                    start = timeit.default_timer()
-                    from anovos_tpu.data_analyzer.geospatial_analyzer import geospatial_autodetection
-
                     kw = {
                         k: ga[k]
                         for k in (
@@ -287,19 +486,25 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                         )
                         if ga.get(k) is not None
                     }
-                    try:
-                        geospatial_autodetection(
-                            df, ga.get("id_col"), report_input_path or ".", run_type=run_type, **kw
-                        )
-                    except Exception:
-                        logger.exception("geospatial_analyzer failed; continuing without geo analysis")
-                    _log_block_time(key, start)
+
+                    def _geo(df, ga=ga, kw=kw):
+                        from anovos_tpu.data_analyzer.geospatial_analyzer import geospatial_autodetection
+
+                        try:
+                            geospatial_autodetection(
+                                df, ga.get("id_col"), report_input_path or ".", run_type=run_type, **kw
+                            )
+                        except Exception:
+                            logger.exception("geospatial_analyzer failed; continuing without geo analysis")
+                    pipe.fanout("geospatial_controller", _geo,
+                                writes=("report:geo",), timed="geospatial_controller")
                 continue
 
             if key == "anovos_basic_report" and args is not None and args.get("basic_report", False):
-                start = timeit.default_timer()
-                anovos_basic_report(df, **args.get("report_args", {}), run_type=run_type, auth_key=auth_key)
-                _log_block_time("Basic Report", start)
+                def _basic(df, args=args):
+                    anovos_basic_report(df, **args.get("report_args", {}), run_type=run_type, auth_key=auth_key)
+                pipe.fanout("anovos_basic_report", _basic,
+                            writes=("report:basic",), timed="Basic Report")
                 continue
 
             if basic_report_flag:
@@ -307,84 +512,118 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
 
             if key == "stats_generator" and args is not None:
                 for m in args["metric"]:
-                    start = timeit.default_timer()
-                    df_stats = getattr(stats_generator, m)(df, **args["metric_args"])
-                    if report_input_path:
-                        save_stats(df_stats, report_input_path, m, reread=True, run_type=run_type, auth_key=auth_key)
-                    else:
-                        save(df_stats, write_stats, "data_analyzer/stats_generator/" + m, reread=True)
-                    _log_block_time(f"{key}, {m}", start)
+                    def _stat(df, m=m, args=args):
+                        df_stats = getattr(stats_generator, m)(df, **args["metric_args"])
+                        if report_input_path:
+                            save_stats(df_stats, report_input_path, m, run_type=run_type,
+                                       auth_key=auth_key, async_writer=writer, async_key=f"stats:{m}")
+                        else:
+                            save(df_stats, write_stats, "data_analyzer/stats_generator/" + m,
+                                 reread=True, writer=writer, key=f"stats:{m}")
+                    pipe.fanout(f"stats_generator/{m}", _stat,
+                                writes=(f"stats:{m}",), timed=f"stats_generator, {m}")
 
             if key == "quality_checker" and args is not None:
                 for subkey, value in args.items():
                     if value is None:
                         continue
-                    start = timeit.default_timer()
-                    extra_args = stats_args(all_configs, subkey, run_type, auth_key)
-                    if subkey == "nullColumns_detection":
-                        # upstream treatments invalidate cached missing stats (ref :552-566)
-                        if (args.get("invalidEntries_detection") or {}).get("treatment"):
-                            extra_args["stats_missing"] = {}
-                        if (args.get("outlier_detection") or {}).get("treatment") and (
-                            args.get("outlier_detection") or {}
-                        ).get("treatment_method") == "null_replacement":
-                            extra_args["stats_missing"] = {}
-                    df, df_stats = getattr(quality_checker, subkey)(df, **value, **extra_args)
-                    df = save(
-                        df, write_intermediate,
-                        "data_analyzer/quality_checker/" + subkey + "/dataset", reread=True,
-                    )
-                    if report_input_path:
-                        save_stats(df_stats, report_input_path, subkey, reread=True, run_type=run_type, auth_key=auth_key)
-                    else:
-                        save(df_stats, write_stats, "data_analyzer/quality_checker/" + subkey, reread=True)
-                    _log_block_time(f"{key}, {subkey}", start)
+
+                    def _qc(df, subkey=subkey, value=value, args=args):
+                        extra_args = stats_args(all_configs, subkey, run_type, auth_key)
+                        if subkey == "nullColumns_detection":
+                            # upstream treatments invalidate cached missing stats (ref :552-566)
+                            if (args.get("invalidEntries_detection") or {}).get("treatment"):
+                                extra_args["stats_missing"] = {}
+                            if (args.get("outlier_detection") or {}).get("treatment") and (
+                                args.get("outlier_detection") or {}
+                            ).get("treatment_method") == "null_replacement":
+                                extra_args["stats_missing"] = {}
+                        df_out, df_stats = getattr(quality_checker, subkey)(df, **value, **extra_args)
+                        df_out = save(
+                            df_out, write_intermediate,
+                            "data_analyzer/quality_checker/" + subkey + "/dataset",
+                            reread=True, writer=writer,
+                        )
+                        if report_input_path:
+                            save_stats(df_stats, report_input_path, subkey, run_type=run_type,
+                                       auth_key=auth_key, async_writer=writer, async_key=f"stats:{subkey}")
+                        else:
+                            save(df_stats, write_stats, "data_analyzer/quality_checker/" + subkey,
+                                 reread=True, writer=writer, key=f"stats:{subkey}")
+                        return df_out
+                    pipe.spine(f"quality_checker/{subkey}", _qc,
+                               reads=_stats_deps(all_configs, subkey),
+                               writes=(f"stats:{subkey}",), timed=f"quality_checker, {subkey}")
 
             if key == "association_evaluator" and args is not None:
                 for subkey, value in args.items():
                     if value is None:
                         continue
-                    start = timeit.default_timer()
-                    extra_args = stats_args(all_configs, subkey, run_type, auth_key)
-                    if subkey == "correlation_matrix":
-                        cat_params = all_configs.get("cat_to_num_transformer", None)
-                        df_in = (
-                            transformers.cat_to_num_transformer(df, **cat_params) if cat_params else df
-                        )
-                    else:
-                        df_in = df
-                    df_stats = getattr(association_evaluator, subkey)(df_in, **value, **extra_args)
-                    if report_input_path:
-                        save_stats(df_stats, report_input_path, subkey, reread=True, run_type=run_type, auth_key=auth_key)
-                    else:
-                        save(df_stats, write_stats, "data_analyzer/association_evaluator/" + subkey, reread=True)
-                    _log_block_time(f"{key}, {subkey}", start)
+
+                    def _assoc(df, subkey=subkey, value=value):
+                        extra_args = stats_args(all_configs, subkey, run_type, auth_key)
+                        if subkey == "correlation_matrix":
+                            cat_params = all_configs.get("cat_to_num_transformer", None)
+                            df_in = (
+                                transformers.cat_to_num_transformer(df, **cat_params) if cat_params else df
+                            )
+                        else:
+                            df_in = df
+                        df_stats = getattr(association_evaluator, subkey)(df_in, **value, **extra_args)
+                        if report_input_path:
+                            save_stats(df_stats, report_input_path, subkey, run_type=run_type,
+                                       auth_key=auth_key, async_writer=writer, async_key=f"stats:{subkey}")
+                        else:
+                            save(df_stats, write_stats, "data_analyzer/association_evaluator/" + subkey,
+                                 reread=True, writer=writer, key=f"stats:{subkey}")
+                    pipe.fanout(f"association_evaluator/{subkey}", _assoc,
+                                reads=_stats_deps(all_configs, subkey),
+                                writes=(f"stats:{subkey}",), timed=f"{key}, {subkey}")
 
             if key == "drift_detector" and args is not None:
                 for subkey, value in args.items():
-                    if value is None:
+                    if value is None or subkey not in ("drift_statistics", "stability_index"):
                         continue
-                    start = timeit.default_timer()
-                    if subkey == "drift_statistics":
-                        source = None
-                        if not value["configs"].get("pre_existing_source", False):
-                            source = ETL(value.get("source_dataset"))
-                        df_stats = ddetector.statistics(df, source, **value["configs"])
-                    elif subkey == "stability_index":
-                        idfs = [ETL(value[k]) for k in value if k != "configs"]
-                        df_stats = dstability.stability_index_computation(*idfs, **value["configs"])
-                    else:
-                        continue
-                    if report_input_path:
-                        save_stats(df_stats, report_input_path, subkey, reread=True, run_type=run_type, auth_key=auth_key)
-                        if subkey == "stability_index":
-                            amp = value["configs"].get("appended_metric_path", "")
-                            if amp:
-                                metrics = data_ingest.read_dataset(amp, "csv", {"header": True})
-                                save_stats(metrics.to_pandas(), report_input_path, "stabilityIndex_metrics", run_type=run_type, auth_key=auth_key)
-                    else:
-                        save(df_stats, write_stats, "drift_detector/" + subkey, reread=True)
-                    _log_block_time(f"{key}, {subkey}", start)
+
+                    def _drift(df, subkey=subkey, value=value):
+                        if subkey == "drift_statistics":
+                            source = None
+                            if not value["configs"].get("pre_existing_source", False):
+                                src_spec = value.get("source_dataset")
+                                # the demo configs diff the dataset against
+                                # itself: an identical source spec reuses the
+                                # already-ingested base table instead of
+                                # re-paying the read + device upload.  None-
+                                # valued keys are ignored by ETL, so they are
+                                # ignored by the comparison too.
+                                _clean = lambda d: {k: v for k, v in (d or {}).items() if v is not None}
+                                if src_spec and _clean(src_spec) == _clean(all_configs.get("input_dataset")):
+                                    source = base_df
+                                else:
+                                    source = ETL(src_spec)
+                            df_stats = ddetector.statistics(df, source, **value["configs"])
+                        else:
+                            idfs = [ETL(value[k]) for k in value if k != "configs"]
+                            df_stats = dstability.stability_index_computation(*idfs, **value["configs"])
+                        if report_input_path:
+                            save_stats(df_stats, report_input_path, subkey, run_type=run_type,
+                                       auth_key=auth_key, async_writer=writer, async_key=f"stats:{subkey}")
+                            if subkey == "stability_index":
+                                amp = value["configs"].get("appended_metric_path", "")
+                                if amp:
+                                    metrics = data_ingest.read_dataset(amp, "csv", {"header": True})
+                                    save_stats(metrics.to_pandas(), report_input_path,
+                                               "stabilityIndex_metrics", run_type=run_type,
+                                               auth_key=auth_key, async_writer=writer,
+                                               async_key="stats:stabilityIndex_metrics")
+                        else:
+                            save(df_stats, write_stats, "drift_detector/" + subkey,
+                                 reread=True, writer=writer, key=f"stats:{subkey}")
+                    extra_writes = ("drift:model",) if subkey == "drift_statistics" else (
+                        "stats:stabilityIndex_metrics",)
+                    pipe.fanout(f"drift_detector/{subkey}", _drift,
+                                writes=(f"stats:{subkey}",) + extra_writes,
+                                timed=f"{key}, {subkey}")
 
             if key == "transformers" and args is not None:
                 for subkey, value in args.items():
@@ -393,27 +632,66 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
                     for subkey2, value2 in value.items():
                         if value2 is None:
                             continue
-                        start = timeit.default_timer()
-                        extra_args = stats_args(all_configs, subkey2, run_type, auth_key)
-                        f = getattr(transformers, subkey2)
-                        df = f(df, **value2, **extra_args)
-                        df = save(
-                            df, write_intermediate, "data_transformer/transformers/" + subkey2, reread=True
-                        )
-                        _log_block_time(f"{key}, {subkey2}", start)
+
+                        def _tf(df, subkey2=subkey2, value2=value2):
+                            extra_args = stats_args(all_configs, subkey2, run_type, auth_key)
+                            f = getattr(transformers, subkey2)
+                            df_out = f(df, **value2, **extra_args)
+                            return save(
+                                df_out, write_intermediate,
+                                "data_transformer/transformers/" + subkey2,
+                                reread=True, writer=writer,
+                            )
+                        pipe.spine(f"transformers/{subkey2}", _tf,
+                                   reads=_stats_deps(all_configs, subkey2),
+                                   timed=f"{key}, {subkey2}")
 
             if key == "report_preprocessing" and args is not None:
                 for subkey, value in args.items():
                     if subkey == "charts_to_objects" and value is not None:
-                        start = timeit.default_timer()
-                        extra_args = stats_args(all_configs, subkey, run_type, auth_key)
-                        charts_to_objects(df, **value, **extra_args, master_path=report_input_path, run_type=run_type, auth_key=auth_key)
-                        _log_block_time(f"{key}, {subkey}", start)
+                        chart_reads = _stats_deps(all_configs, subkey)
+                        if value.get("drift_detector", False):
+                            # the drift tab reuses the frequency model the
+                            # drift_statistics node persists under
+                            # intermediate_data/drift_statistics
+                            chart_reads = chart_reads + ("drift:model",)
+
+                        def _charts(df, subkey=subkey, value=value):
+                            extra_args = stats_args(all_configs, subkey, run_type, auth_key)
+                            charts_to_objects(df, **value, **extra_args, master_path=report_input_path,
+                                              run_type=run_type, auth_key=auth_key,
+                                              async_writer=writer, async_key="charts:objects")
+                        pipe.fanout(f"report_preprocessing/{subkey}", _charts,
+                                    reads=chart_reads, writes=("charts:objects",),
+                                    timed=f"{key}, {subkey}")
 
             if key == "report_generation" and args is not None:
-                start = timeit.default_timer()
-                anovos_report(**args, run_type=run_type, auth_key=auth_key)
-                _log_block_time(f"{key}, full_report", start)
+                # the report reads the whole master_path subtree: wait on
+                # every artifact-producing node registered so far, and on
+                # the async write queue having flushed them (the barrier)
+                art_reads = tuple(pipe.artifact_keys)
+
+                def _report(df, args=args):
+                    anovos_report(**args, run_type=run_type, auth_key=auth_key)
+                pipe.fanout("report_generation", _report, reads=art_reads,
+                            timed=f"{key}, full_report")
+
+        run_err = None
+        try:
+            summary = sched.run(mode=mode)
+        except BaseException as e:
+            run_err = e
+            raise
+        finally:
+            try:
+                writer.close()  # drain: surface any queued-write failure
+            except Exception:
+                if run_err is None:
+                    raise
+                logger.exception("async artifact writes failed during aborted run")
+        LAST_RUN_SUMMARY = summary
+        logger.info(DagScheduler.format_summary(summary))
+        df = pipe.current_df()
 
         # feast export adds its timestamp columns BEFORE the single final
         # write (reference :854-866); config validated up front (ref :173-182)
@@ -436,10 +714,10 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: dict = {}) ->
             path = os.path.join(write_main["file_path"], "final_dataset", "part*")
             files = _glob.glob(path)
             feast_exporter.generate_feature_description(df.dtypes(), write_feast, files[0] if files else "")
-    logger.info(f"execution time w/o report (in sec) = {round(timeit.default_timer() - start_main, 4)}")
+    logger.info(f"execution time w/o report (in sec) = {round(time.monotonic() - start_main, 4)}")
 
 
-def run(config_path: str, run_type: str = "local", auth_key_val: dict = {}) -> None:
+def run(config_path: str, run_type: str = "local", auth_key_val: Optional[dict] = None) -> None:
     """Entry (reference :873-888): load YAML → main.
 
     Tracing: the reference logs per-block wall times only (SURVEY.md §5);

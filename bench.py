@@ -284,6 +284,7 @@ def e2e_cold_warm() -> dict:
 
     out = {}
     blocks = {}
+    summary = {}
     cwd = os.getcwd()
     for label in ("cold", "warm"):
         with tempfile.TemporaryDirectory() as d:
@@ -293,6 +294,7 @@ def e2e_cold_warm() -> dict:
                 workflow.run(E2E_CONFIG, "local")
                 out[label] = round(time.perf_counter() - t0, 1)
                 blocks = dict(workflow.BLOCK_TIMES)
+                summary = dict(workflow.LAST_RUN_SUMMARY)
             finally:
                 os.chdir(cwd)
     try:
@@ -300,7 +302,7 @@ def e2e_cold_warm() -> dict:
     except Exception:
         n_rows = 32561  # income dataset fallback
     top_blocks = dict(sorted(blocks.items(), key=lambda kv: -kv[1])[:8])
-    return {
+    result = {
         "e2e_cold_s": out["cold"],
         "e2e_warm_s": out["warm"],
         "e2e_rows": n_rows,
@@ -310,6 +312,19 @@ def e2e_cold_warm() -> dict:
         # tests/golden/e2e_block_budget.csv)
         "e2e_warm_blocks": {k: round(v, 2) for k, v in top_blocks.items()},
     }
+    if summary:
+        # DAG-executor observability (warm run): serial work vs wall,
+        # measured critical path, and the chain itself — how much of the
+        # block graph actually overlapped
+        result.update({
+            "e2e_executor": summary.get("mode"),
+            "e2e_serial_s": summary.get("serial_s"),
+            "e2e_critical_path_s": summary.get("critical_path_s"),
+            "e2e_parallel_speedup": summary.get("parallel_speedup"),
+            "e2e_critical_path": " -> ".join(summary.get("critical_path", [])),
+        })
+        print("bench: " + workflow.DagScheduler.format_summary(summary), file=sys.stderr)
+    return result
 
 
 def measure_e2e() -> None:

@@ -223,6 +223,11 @@ def bench_e2e() -> dict:
         "rows_per_sec_per_chip": round(r["e2e_rows"] / r["e2e_cold_s"], 1),
         "warm_rows_per_sec_per_chip": r["e2e_warm_rows_per_sec_per_chip"],
         "warm_blocks": r.get("e2e_warm_blocks", {}),
+        # DAG-executor observability (scheduler critical-path summary)
+        "executor": r.get("e2e_executor"),
+        "serial_s": r.get("e2e_serial_s"),
+        "critical_path_s": r.get("e2e_critical_path_s"),
+        "parallel_speedup": r.get("e2e_parallel_speedup"),
     }
 
 

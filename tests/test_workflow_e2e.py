@@ -88,7 +88,14 @@ CFG = {
 
 
 @pytest.mark.slow
-def test_workflow_end_to_end(tmp_path, monkeypatch):
+@pytest.mark.parametrize("executor", ["concurrent", "sequential"])
+def test_workflow_end_to_end(tmp_path, monkeypatch, executor):
+    """Once per executor mode: the concurrent DAG scheduler and the
+    sequential fallback must both satisfy the full output contract.  The
+    per-node watchdog turns a scheduler deadlock into a fast failure naming
+    the stuck block instead of eating the suite budget."""
+    monkeypatch.setenv("ANOVOS_TPU_EXECUTOR", executor)
+    monkeypatch.setenv("ANOVOS_TPU_NODE_TIMEOUT", "600")
     monkeypatch.chdir(tmp_path)
     cfg_path = tmp_path / "cfg.yaml"
     # sort_keys=False: block execution follows YAML author order, exactly like
