@@ -1,8 +1,12 @@
 """``python -m anovos_tpu <config.yaml> <run_type>`` (reference: anovos/__main__.py:5)."""
 
+import logging
 import sys
 
 from anovos_tpu import workflow
 
 if __name__ == "__main__":
+    # entrypoint-only root-logger setup: library modules must never call
+    # logging.basicConfig (the importing application owns the root logger)
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     workflow.run(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else "local")

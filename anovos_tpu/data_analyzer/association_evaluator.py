@@ -13,6 +13,8 @@
 
 from __future__ import annotations
 
+import logging
+
 import math
 import warnings
 from typing import List, Union
@@ -26,6 +28,8 @@ from anovos_tpu.ops.correlation import masked_corr
 from anovos_tpu.ops.segment import code_counts, code_label_counts, masked_nunique
 from anovos_tpu.shared.table import Table
 from anovos_tpu.shared.utils import parse_cols
+
+logger = logging.getLogger(__name__)
 
 
 def correlation_matrix(
@@ -56,7 +60,7 @@ def correlation_matrix(
     ordered = sorted(cols)
     odf = odf[["attribute"] + ordered].sort_values("attribute").reset_index(drop=True)
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -160,7 +164,7 @@ def IV_calculation(
         rows.append({"attribute": c, "iv": round(iv, 4)})
     odf = pd.DataFrame(rows, columns=["attribute", "iv"])
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -207,7 +211,7 @@ def IG_calculation(
         rows.append({"attribute": c, "ig": round(float(ig), 4)})
     odf = pd.DataFrame(rows, columns=["attribute", "ig"])
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -286,5 +290,5 @@ def variable_clustering(
         }
     )
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf

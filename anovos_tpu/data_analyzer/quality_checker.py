@@ -10,6 +10,8 @@ here).
 
 from __future__ import annotations
 
+import logging
+
 import dataclasses
 import functools
 import re
@@ -27,6 +29,8 @@ from anovos_tpu.ops.reductions import masked_moments
 from anovos_tpu.ops.segment import row_signature
 from anovos_tpu.shared.table import Column, Table
 from anovos_tpu.shared.utils import parse_cols
+
+logger = logging.getLogger(__name__)
 
 _R = lambda v: round(float(v), 4)
 
@@ -97,7 +101,7 @@ def duplicate_detection(
         columns=["metric", "value"],
     )
     if print_impact:
-        print(stats.to_string(index=False))
+        logger.info(stats.to_string(index=False))
     return odf, stats
 
 
@@ -137,7 +141,7 @@ def nullRows_detection(
         odf = idf.filter_rows(~flagged)
         stats = stats.rename(columns={"flagged": "treated"})
     if print_impact:
-        print(stats.to_string(index=False))
+        logger.info(stats.to_string(index=False))
     return odf, stats
 
 
@@ -226,7 +230,7 @@ def nullColumns_detection(
             cfg = {k: v for k, v in treatment_configs.items() if k != "treatment_threshold"}
             odf = auto_imputation(idf, list_of_cols=cols, stats_missing=stats_missing, **cfg)
     if print_impact:
-        print(stats.to_string(index=False))
+        logger.info(stats.to_string(index=False))
     return odf, stats
 
 
@@ -409,7 +413,7 @@ def outlier_detection(
             for name, ncol in new_cols.items():
                 odf = odf.with_column(name if output_mode == "replace" else name + "_outliered", ncol)
     if print_impact:
-        print(stats.to_string(index=False))
+        logger.info(stats.to_string(index=False))
     return odf, stats
 
 
@@ -444,7 +448,7 @@ def IDness_detection(
         odf = idf.drop(rm)
         stats = stats.rename(columns={"flagged": "treated"})
     if print_impact:
-        print(stats.to_string(index=False))
+        logger.info(stats.to_string(index=False))
     return odf, stats
 
 
@@ -484,7 +488,7 @@ def biasedness_detection(
         odf = idf.drop(rm)
         stats = stats.rename(columns={"flagged": "treated"})
     if print_impact:
-        print(stats.to_string(index=False))
+        logger.info(stats.to_string(index=False))
     return odf, stats
 
 
@@ -751,5 +755,5 @@ def invalidEntries_detection(
                 cfg = {k: v for k, v in treatment_configs.items() if k != "treatment_threshold"}
                 odf = imputation_MMM(odf, list_of_cols=target_cols, **cfg)
     if print_impact:
-        print(stats.to_string(index=False))
+        logger.info(stats.to_string(index=False))
     return odf, stats

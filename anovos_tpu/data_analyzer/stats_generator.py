@@ -15,6 +15,8 @@ exactly like the reference's driver-collected stats DataFrames.
 
 from __future__ import annotations
 
+import logging
+
 from typing import List
 
 import jax.numpy as jnp
@@ -24,6 +26,8 @@ import pandas as pd
 from anovos_tpu.ops.describe import PCTL_QS, table_describe
 from anovos_tpu.shared.table import Table
 from anovos_tpu.shared.utils import parse_cols
+
+logger = logging.getLogger(__name__)
 
 _R = lambda v: np.round(v, 4)
 
@@ -106,7 +110,7 @@ def global_summary(idf: Table, list_of_cols="all", drop_cols=[], print_impact=Fa
     ]
     odf = pd.DataFrame(rows, columns=["metric", "value"])
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -126,7 +130,7 @@ def missingCount_computation(
         }
     )
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -153,7 +157,7 @@ def nonzeroCount_computation(
         }
     )
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -180,7 +184,7 @@ def measures_of_counts(
     )
     odf = odf.merge(nz, on="attribute", how="outer")
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -227,7 +231,7 @@ def mode_computation(
             counts.append(int(num_out["mode_count"][j]))
     odf = pd.DataFrame({"attribute": cols, "mode": modes, "mode_rows": counts})
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -264,7 +268,7 @@ def measures_of_centralTendency(
         )
     odf = pd.DataFrame(rows, columns=["attribute", "mean", "median", "mode", "mode_rows", "mode_pct"])
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -320,7 +324,7 @@ def uniqueCount_computation(
         ).astype(np.int64)
     odf = pd.DataFrame({"attribute": cols, "unique_values": nu})
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -347,7 +351,7 @@ def measures_of_cardinality(
     odf["IDness"] = _R(odf["unique_values"] / denom)
     odf = odf[["attribute", "unique_values", "IDness"]]
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -379,7 +383,7 @@ def measures_of_dispersion(
         }
     )
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -400,7 +404,7 @@ def measures_of_percentiles(
     for i, s in enumerate(_PCTL_STATS):
         odf[s] = _R(num_out["percentiles"][i][idx])
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -422,5 +426,5 @@ def measures_of_shape(
         }
     )
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf

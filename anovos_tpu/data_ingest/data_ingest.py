@@ -32,6 +32,8 @@ from anovos_tpu.shared.runtime import get_runtime
 from anovos_tpu.shared.table import Column, Table, _host_to_column, _pad_to
 from anovos_tpu.shared.utils import ends_with, pairwise_reduce, parse_cols
 
+logger = logging.getLogger(__name__)
+
 # one-shot notice when the pyarrow CSV checkpoint writer falls back to
 # pandas (mixed-format directories must be observable, not silent)
 _PANDAS_CSV_FALLBACK_LOGGED = False
@@ -112,30 +114,42 @@ def read_dataset(file_path: str, file_type: str, file_configs: Optional[dict] = 
     (always on — pyarrow infers).  Multi-file (part-file) directories are
     concatenated host-side before upload.
     """
-    cfg = dict(file_configs or {})
-    if jax.process_count() > 1:
-        # multi-host runtime: each host reads its file slice and columns are
-        # assembled into global arrays (distributed_ingest module)
-        from anovos_tpu.data_ingest.distributed_ingest import read_dataset_distributed
+    from anovos_tpu.obs import get_metrics, get_tracer
 
-        return read_dataset_distributed(file_path, file_type, file_configs)
-    files = _resolve_files(file_path, file_type)
-    if file_type == "avro":
-        # native-friendly path: per-file decode straight to Tables (string
-        # columns stay dictionary codes), row-union via concatenate_dataset's
-        # vocab-union remap.  Falls through to pandas only on decode failure.
-        tables = []
-        for f in files:
-            decoded = avro_io.read_avro(f)
-            if not decoded:
-                tables = None
-                break
-            n = len(next(iter(decoded.values())))
-            tables.append(Table.from_numpy(_coerce_numeric_strings(decoded), nrows=n))
-        if tables:
-            return tables[0] if len(tables) == 1 else concatenate_dataset(*tables, method_type="name")
-    df = read_host_frame(files, file_type, cfg)
-    return Table.from_pandas(df)
+    cfg = dict(file_configs or {})
+    with get_tracer().span("io:read_dataset", cat="io", path=str(file_path),
+                           file_type=file_type):
+        if jax.process_count() > 1:
+            # multi-host runtime: each host reads its file slice and columns
+            # are assembled into global arrays (distributed_ingest module)
+            from anovos_tpu.data_ingest.distributed_ingest import read_dataset_distributed
+
+            out = read_dataset_distributed(file_path, file_type, file_configs)
+        else:
+            out = None
+            files = _resolve_files(file_path, file_type)
+            if file_type == "avro":
+                # native-friendly path: per-file decode straight to Tables
+                # (string columns stay dictionary codes), row-union via
+                # concatenate_dataset's vocab-union remap.  Falls through to
+                # pandas only on decode failure.
+                tables = []
+                for f in files:
+                    decoded = avro_io.read_avro(f)
+                    if not decoded:
+                        tables = None
+                        break
+                    n = len(next(iter(decoded.values())))
+                    tables.append(Table.from_numpy(_coerce_numeric_strings(decoded), nrows=n))
+                if tables:
+                    out = tables[0] if len(tables) == 1 else concatenate_dataset(
+                        *tables, method_type="name")
+            if out is None:
+                df = read_host_frame(files, file_type, cfg)
+                out = Table.from_pandas(df)
+    get_metrics().counter("rows_ingested_total",
+                          "rows read into device Tables").inc(out.nrows)
+    return out
 
 
 def read_host_frame(files: List[str], file_type: str, cfg: dict) -> pd.DataFrame:
@@ -219,6 +233,7 @@ def write_dataset(
     os.makedirs(file_path, exist_ok=True)
     df = idf.to_pandas()
     parts = np.array_split(np.arange(len(df)), max(repartition, 1))
+    written: List[str] = []  # THIS call's files (append mode must not re-book pre-existing parts)
     for i, part_idx in enumerate(parts):
         # single-part writes (the checkpoint default) skip the fancy-index
         # row copy — df.iloc[arange] materializes a full second frame
@@ -252,6 +267,7 @@ def write_dataset(
                     stem + ".csv",
                     write_options=pacsv.WriteOptions(include_header=header, delimiter=delim),
                 )
+                written.append(stem + ".csv")
             except Exception as e:
                 # arrow conversion limits (mixed-type object columns,
                 # duplicate column names in the pre-format loop, ...):
@@ -267,15 +283,28 @@ def write_dataset(
                         "(%s: %s); later parts may mix formats "
                         "(quoting/boolean case)", stem, type(e).__name__, e)
                 part.to_csv(stem + ".csv", index=False, header=header, sep=delim)
+                written.append(stem + ".csv")
         elif file_type == "parquet":
             part.to_parquet(stem + ".parquet", index=False)
+            written.append(stem + ".parquet")
         elif file_type == "avro":
             avro_io.write_avro(part, stem + ".avro")
+            written.append(stem + ".avro")
         elif file_type == "json":
             part.to_json(stem + ".json", orient="records", lines=True)
+            written.append(stem + ".json")
         else:
             raise ValueError(f"unsupported file_type: {file_type}")
     open(os.path.join(file_path, "_SUCCESS"), "w").close()
+    from anovos_tpu.obs import get_metrics
+
+    try:
+        n_bytes = sum(os.path.getsize(f) for f in written)
+    except OSError:
+        n_bytes = 0
+    reg = get_metrics()
+    reg.counter("bytes_written_total", "artifact bytes written to disk").inc(n_bytes)
+    reg.counter("rows_written_total", "rows persisted by write_dataset").inc(len(df))
 
 
 # ----------------------------------------------------------------------
@@ -481,8 +510,8 @@ def delete_column(idf: Table, list_of_cols, print_impact: bool = False) -> Table
     cols = parse_cols(list_of_cols, idf.col_names)
     odf = idf.drop(cols)
     if print_impact:
-        print("Before: \nNo. of Columns- ", idf.ncols, "\n", idf.col_names)
-        print("After: \nNo. of Columns- ", odf.ncols, "\n", odf.col_names)
+        logger.info(f"Before: \nNo. of Columns-  {idf.ncols} \n {idf.col_names}")
+        logger.info(f"After: \nNo. of Columns-  {odf.ncols} \n {odf.col_names}")
     return odf
 
 
@@ -490,8 +519,8 @@ def select_column(idf: Table, list_of_cols, print_impact: bool = False) -> Table
     cols = parse_cols(list_of_cols, idf.col_names)
     odf = idf.select(cols)
     if print_impact:
-        print("Before: \nNo. of Columns- ", idf.ncols, "\n", idf.col_names)
-        print("After: \nNo. of Columns- ", odf.ncols, "\n", odf.col_names)
+        logger.info(f"Before: \nNo. of Columns-  {idf.ncols} \n {idf.col_names}")
+        logger.info(f"After: \nNo. of Columns-  {odf.ncols} \n {odf.col_names}")
     return odf
 
 
@@ -502,8 +531,8 @@ def rename_column(idf: Table, list_of_cols, list_of_newcols, print_impact: bool 
         list_of_newcols = [x.strip() for x in list_of_newcols.split("|")]
     odf = idf.rename(dict(zip(list_of_cols, list_of_newcols)))
     if print_impact:
-        print("Before: \nNo. of Columns- ", idf.ncols, "\n", idf.col_names)
-        print("After: \nNo. of Columns- ", odf.ncols, "\n", odf.col_names)
+        logger.info(f"Before: \nNo. of Columns-  {idf.ncols} \n {idf.col_names}")
+        logger.info(f"After: \nNo. of Columns-  {odf.ncols} \n {odf.col_names}")
     return odf
 
 
@@ -599,8 +628,8 @@ def recast_column(idf: Table, list_of_cols, list_of_dtypes, print_impact: bool =
             raise ValueError(f"unsupported recast dtype: {dt}")
         odf = odf.with_column(name, new)
     if print_impact:
-        print("Before: ", idf.dtypes())
-        print("After: ", odf.dtypes())
+        logger.info(f"Before:  {idf.dtypes()}")
+        logger.info(f"After:  {odf.dtypes()}")
     return odf
 
 

@@ -18,6 +18,8 @@ No Arrow round-trip, no Python per partition.
 
 from __future__ import annotations
 
+import logging
+
 import os
 import warnings
 from collections import OrderedDict
@@ -34,6 +36,8 @@ from anovos_tpu.ops.reductions import masked_moments
 from anovos_tpu.shared.runtime import get_runtime
 from anovos_tpu.shared.table import Column, Table
 from anovos_tpu.shared.utils import parse_cols
+
+logger = logging.getLogger(__name__)
 
 _KNN_TILE = 4096
 
@@ -174,7 +178,7 @@ def imputation_sklearn(
         filled = filled_all[:, jnp.asarray(tgt_idx)]
     odf = _emit_imputed(idf, cols, filled, output_mode)
     if print_impact:
-        print(f"{method_type}-imputed: {cols}")
+        logger.info(f"{method_type}-imputed: {cols}")
     return odf
 
 
@@ -263,7 +267,7 @@ def imputation_matrixFactorization(
     filled = (completed * std[None, :] + mean[None, :])[:, tgt_idx]
     odf = _emit_imputed(idf, cols, filled, output_mode)
     if print_impact:
-        print(f"MF-imputed: {cols}")
+        logger.info(f"MF-imputed: {cols}")
     return odf
 
 
@@ -330,5 +334,5 @@ def auto_imputation(
             warnings.warn(f"auto_imputation: {name} failed: {e}")
     best = min(scores, key=scores.get)
     if print_impact:
-        print("auto_imputation scores (lower better):", {k: round(v, 4) for k, v in scores.items()}, "→", best)
+        logger.info(f"auto_imputation scores (lower better): {({k: round(v, 4) for k, v in scores.items()})} → {best}")
     return candidates[best](idf, output_mode)

@@ -10,6 +10,8 @@ the same explained-variance-cutoff k selection (ref :3121-3137).
 
 from __future__ import annotations
 
+import logging
+
 import os
 import warnings
 from collections import OrderedDict
@@ -26,6 +28,8 @@ from anovos_tpu.ops.reductions import masked_moments
 from anovos_tpu.shared.runtime import get_runtime
 from anovos_tpu.shared.table import Column, Table
 from anovos_tpu.shared.utils import parse_cols
+
+logger = logging.getLogger(__name__)
 
 
 def _prep_block(idf: Table, cols: List[str], standardization: bool, imputation: bool):
@@ -109,7 +113,7 @@ def autoencoder_latentFeatures(
     if output_mode == "replace":
         odf = odf.drop(cols)
     if print_impact:
-        print(f"autoencoder latent features: {ae.n_bottleneck} from {n} columns")
+        logger.info(f"autoencoder latent features: {ae.n_bottleneck} from {n} columns")
     return odf
 
 
@@ -178,5 +182,5 @@ def PCA_latentFeatures(
     if output_mode == "replace":
         odf = odf.drop(cols)
     if print_impact:
-        print(f"PCA latent features: {int(Z.shape[1])} components (cutoff {explained_variance_cutoff})")
+        logger.info(f"PCA latent features: {int(Z.shape[1])} components (cutoff {explained_variance_cutoff})")
     return odf

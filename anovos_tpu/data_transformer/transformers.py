@@ -11,6 +11,7 @@ kernel over the λ grid.
 
 from __future__ import annotations
 
+import logging
 import functools
 import math
 import os
@@ -31,6 +32,8 @@ from anovos_tpu.ops.segment import code_counts, code_label_counts, masked_nuniqu
 from anovos_tpu.shared.runtime import get_runtime
 from anovos_tpu.shared.table import Column, Table
 from anovos_tpu.shared.utils import parse_cols
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "attribute_binning",
@@ -192,7 +195,7 @@ def attribute_binning(
         from anovos_tpu.data_analyzer.stats_generator import uniqueCount_computation
 
         out = cols if output_mode == "replace" else [c + "_binned" for c in cols]
-        print(uniqueCount_computation(odf, out).to_string(index=False))
+        logger.info(uniqueCount_computation(odf, out).to_string(index=False))
     return odf
 
 
@@ -371,7 +374,7 @@ def cat_to_num_unsupervised(
     if method_type == "label_encoding":
         odf = _emit(idf, new_cols, output_mode, "_index")
     if print_impact:
-        print(f"Encoded columns: {cols}")
+        logger.info(f"Encoded columns: {cols}")
     return odf
 
 
@@ -426,7 +429,7 @@ def cat_to_num_supervised(
             save_model_df(dfm, model_path, f"cat_to_num_supervised/{c}", fmt="csv")
     odf = _emit(idf, new_cols, output_mode, "_encoded")
     if print_impact:
-        print(f"Target-encoded columns: {cols}")
+        logger.info(f"Target-encoded columns: {cols}")
     return odf
 
 
@@ -480,7 +483,7 @@ def z_standardization(
     )
     odf = _emit(idf, new_cols, output_mode, "_scaled")
     if print_impact:
-        print(f"z-standardized: {cols}")
+        logger.info(f"z-standardized: {cols}")
     return odf
 
 
@@ -533,7 +536,7 @@ def IQR_standardization(
     )
     odf = _emit(idf, new_cols, output_mode, "_scaled")
     if print_impact:
-        print(f"IQR-standardized: {cols}")
+        logger.info(f"IQR-standardized: {cols}")
     return odf
 
 
@@ -585,7 +588,7 @@ def normalization(
     )
     odf = _emit(idf, new_cols, output_mode, "_normalized")
     if print_impact:
-        print(f"normalized: {cols}")
+        logger.info(f"normalized: {cols}")
     return odf
 
 
@@ -688,7 +691,7 @@ def imputation_MMM(
             )
     odf = _emit(idf, new_cols, output_mode, "_imputed")
     if print_impact:
-        print(f"imputed ({method_type}): {list(new_cols)}")
+        logger.info(f"imputed ({method_type}): {list(new_cols)}")
     return odf
 
 
@@ -764,7 +767,7 @@ def feature_transformation(
     for name, col in new_cols.items():
         odf = odf.with_column(name if output_mode == "replace" else name + postfix, col)
     if print_impact:
-        print(f"{method_type} applied to {cols}")
+        logger.info(f"{method_type} applied to {cols}")
     return odf
 
 
@@ -844,7 +847,7 @@ def boxcox_transformation(
     )
     odf = _emit(idf, new_cols, output_mode, "_bxcx")
     if print_impact:
-        print("boxcox lambdas:", dict(zip(cols, lam.tolist())))
+        logger.info(f"boxcox lambdas: {dict(zip(cols, lam.tolist()))}")
     return odf
 
 
@@ -908,7 +911,7 @@ def outlier_categories(
         new_cols[c] = Column("cat", data.astype(jnp.int32), col.mask, vocab=new_vocab, dtype_name="string")
     odf = _emit(idf, new_cols, output_mode, "_outliered")
     if print_impact:
-        print({c: len(v) for c, v in keep_map.items()})
+        logger.info({c: len(v) for c, v in keep_map.items()})
     return odf
 
 
@@ -1023,7 +1026,7 @@ def expression_parser(idf: Table, list_of_expr, postfix: str = "", print_impact:
         name = expr + postfix
         odf = odf.with_column(name, Column("num", jnp.where(mask, val, 0.0), mask, dtype_name="double"))
     if print_impact:
-        print(f"expressions added: {list_of_expr}")
+        logger.info(f"expressions added: {list_of_expr}")
     return odf
 
 

@@ -15,6 +15,8 @@ arithmetic is vectorized host numpy over the (cols × bins) arrays.
 
 from __future__ import annotations
 
+import logging
+
 import os
 import warnings
 from typing import Dict, List, Optional, Union
@@ -27,6 +29,8 @@ import pandas as pd
 from anovos_tpu.drift_stability.validations import check_distance_method
 from anovos_tpu.shared.table import Table
 from anovos_tpu.shared.utils import parse_cols
+
+logger = logging.getLogger(__name__)
 
 _SMOOTH = 0.0001
 
@@ -250,7 +254,7 @@ def statistics(
         odf[m] = np.round(mets[m], 4)
     odf["flagged"] = (odf[methods] > threshold).any(axis=1).astype(int)
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 

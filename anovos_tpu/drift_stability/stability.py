@@ -13,6 +13,8 @@ via sympy symbolic derivatives — pure host math, unchanged in spirit.
 
 from __future__ import annotations
 
+import logging
+
 import glob
 import os
 import warnings
@@ -31,6 +33,8 @@ from anovos_tpu.drift_stability.validations import (
 from anovos_tpu.ops.reductions import masked_moments
 from anovos_tpu.shared.table import Table
 from anovos_tpu.shared.utils import parse_cols
+
+logger = logging.getLogger(__name__)
 
 
 def stability_index_computation(
@@ -133,7 +137,7 @@ def stability_index_computation(
         )
     odf = pd.DataFrame(rows)
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -209,5 +213,5 @@ def feature_stability_estimation(
         )
     odf = pd.DataFrame(rows)
     if print_impact:
-        print(odf.to_string(index=False))
+        logger.info(odf.to_string(index=False))
     return odf

@@ -9,6 +9,7 @@ the user attributes most relevant to each.  ``sankey_visualization`` (ref
 
 from __future__ import annotations
 
+import logging
 from typing import List, Optional, Union
 
 import numpy as np
@@ -22,6 +23,8 @@ from anovos_tpu.feature_recommender.featrec_init import (
     load_corpus,
     recommendation_data_prep,
 )
+
+logger = logging.getLogger(__name__)
 
 
 def _prep_user_frame(attr_names, attr_descriptions) -> pd.DataFrame:
@@ -147,7 +150,7 @@ def sankey_visualization(
     """
     if "Recommended Input Attribute" in mapping_df.columns:
         if industry_included or usecase_included:
-            print(
+            logger.info(
                 "Input is find_attr_by_relevance output DataFrame. "
                 "There is no suggested Industry and/or Usecase."
             )

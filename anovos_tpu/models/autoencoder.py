@@ -40,6 +40,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from anovos_tpu.shared.runtime import DATA_AXIS, MODEL_AXIS
 
+logger = logging.getLogger(__name__)
+
 
 def _dense_init(key, n_in, n_out, dtype=jnp.float32):
     k1, _ = jax.random.split(key)
@@ -268,7 +270,7 @@ class AutoEncoder:
                 if validation_X is not None:
                     v = self.reconstruct(params, validation_X)
                     msg += f" val mse {float(jnp.mean((v - validation_X) ** 2)):.5f}"
-                print(msg)
+                logger.info(msg)
         return params
 
     # -- persistence -----------------------------------------------------

@@ -1,0 +1,72 @@
+"""``anovos_tpu.obs`` — tracing, metrics, and run-manifest observability.
+
+Three cooperating, stdlib-only pieces:
+
+* **Tracing** (``obs.tracing``): a thread-safe :class:`Tracer` with
+  nestable ``span()`` context managers and a Chrome-trace-format exporter
+  (open the JSON in Perfetto / ``chrome://tracing``).  The DAG scheduler
+  emits a span per node (worker lane, queue wait, deps), the hot ops emit
+  compile-vs-execute spans via :func:`timed`, and the async artifact
+  writer spans its writes and drain barrier.
+* **Metrics** (``obs.metrics``): a process-wide :class:`MetricsRegistry`
+  of counters/gauges/histograms — node wall time, queue wait, rows
+  ingested, bytes written, device-memory high-water mark, compile-cache
+  hits — with Prometheus-style text exposition and a deterministic JSON
+  snapshot.
+* **Run manifest** (``obs.manifest``): ``workflow.main`` writes
+  ``obs/run_manifest.json`` next to the run's artifacts (config hash,
+  executor mode, critical path, per-node spans, metrics snapshot);
+  ``bench.py`` / ``perf_report.py`` and the HTML report read it instead of
+  re-deriving timings.
+
+Recording is always on at negligible cost; trace-file export is gated by
+``ANOVOS_TPU_TRACE=<path|1>``.
+"""
+
+from anovos_tpu.obs.manifest import (
+    MANIFEST_VERSION,
+    build_manifest,
+    config_hash,
+    load_manifest,
+    stable_view,
+    write_manifest,
+)
+from anovos_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_metrics,
+    record_device_memory,
+)
+from anovos_tpu.obs.timed import timed
+from anovos_tpu.obs.tracing import (
+    Span,
+    Tracer,
+    get_tracer,
+    span,
+    trace_destination,
+    write_chrome_trace,
+)
+
+__all__ = [
+    "MANIFEST_VERSION",
+    "build_manifest",
+    "config_hash",
+    "load_manifest",
+    "stable_view",
+    "write_manifest",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_metrics",
+    "record_device_memory",
+    "timed",
+    "Span",
+    "Tracer",
+    "get_tracer",
+    "span",
+    "trace_destination",
+    "write_chrome_trace",
+]

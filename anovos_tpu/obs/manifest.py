@@ -1,0 +1,151 @@
+"""Run manifest: the machine-readable record of one ``workflow.main`` run.
+
+``obs/run_manifest.json`` lands next to the run's other artifacts and is
+the single source every timing consumer reads — ``bench.py`` and
+``perf_report.py`` take their e2e block/critical-path fields from it
+instead of re-deriving them from module globals, the HTML report renders
+its node-timing table from it, and a CI gate can diff two manifests
+(``stable_view`` strips the timestamp-valued fields first).
+
+Determinism contract: ``write_manifest`` serializes with sorted keys and
+fixed separators, and every non-timing field (config hash, node names,
+dependency lists, metric names, data-volume counters) is a pure function
+of the config + input data — two sequential-mode runs of the same config
+produce byte-identical manifests modulo the fields ``stable_view`` drops.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+MANIFEST_VERSION = 1
+
+__all__ = [
+    "MANIFEST_VERSION",
+    "config_hash",
+    "build_manifest",
+    "write_manifest",
+    "load_manifest",
+    "stable_view",
+]
+
+
+def config_hash(all_configs: dict) -> str:
+    """sha256 of the canonical-JSON config — identifies WHAT ran."""
+    blob = json.dumps(all_configs, sort_keys=True, default=str,
+                      separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def build_manifest(
+    all_configs: dict,
+    summary: dict,
+    metrics_snapshot: dict,
+    run_type: str = "local",
+    block_times: Optional[dict] = None,
+    trace_path: Optional[str] = None,
+    generated_unix: Optional[float] = None,
+) -> dict:
+    """Assemble the manifest dict from the scheduler summary + metrics.
+
+    ``summary`` is ``DagScheduler.run()``'s return value (mode, wall,
+    critical path, per-node spans) and is embedded verbatim under
+    ``scheduler`` so downstream consumers need no second schema.
+    """
+    import time as _time
+
+    backend = None
+    try:  # backend name is informational; never import/init jax for it
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            backend = jax.default_backend()
+    except Exception:
+        pass
+    return {
+        "manifest_version": MANIFEST_VERSION,
+        "config_hash": config_hash(all_configs),
+        "run_type": run_type,
+        "executor": {
+            "mode": summary.get("mode"),
+            "workers": summary.get("workers"),
+        },
+        "critical_path": list(summary.get("critical_path", [])),
+        "scheduler": summary,
+        "block_seconds": {k: round(v, 4) for k, v in sorted((block_times or {}).items())},
+        "metrics": metrics_snapshot,
+        "trace_path": trace_path,
+        "backend": backend,
+        "generated_unix": round(
+            _time.time() if generated_unix is None else generated_unix, 3),
+    }
+
+
+def write_manifest(manifest: dict, path: str) -> str:
+    """Serialize deterministically (sorted keys, fixed separators, LF)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(manifest, f, sort_keys=True, indent=1, separators=(",", ": "))
+        f.write("\n")
+    return path
+
+
+def load_manifest(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+# fields whose values are wall-clock/duration-derived and therefore differ
+# between two otherwise-identical runs
+_VOLATILE_NODE_FIELDS = ("start_s", "end_s", "dur_s", "queue_wait_s", "thread")
+_VOLATILE_TOP_FIELDS = (
+    "generated_unix", "block_seconds", "trace_path", "backend",
+    # the critical path is the longest chain BY MEASURED DURATION — two
+    # runs can legitimately pick different chains when durations jitter
+    "critical_path",
+)
+
+
+def stable_view(manifest: dict) -> dict:
+    """The manifest minus timestamp/duration-valued fields.
+
+    What survives is the run's *identity*: config hash, executor mode, the
+    node set with states and dependency edges, metric names, and the
+    data-volume counters (rows ingested, bytes written, artifact writes)
+    that a deterministic pipeline reproduces exactly.  Two sequential-mode
+    runs of one config must compare equal under this view.
+    """
+    out = {k: v for k, v in manifest.items() if k not in _VOLATILE_TOP_FIELDS}
+    sched = dict(out.get("scheduler") or {})
+    for k in ("wall_s", "serial_s", "critical_path_s", "parallel_speedup",
+              "critical_path"):
+        sched.pop(k, None)
+    sched["nodes"] = {
+        name: {k: v for k, v in node.items() if k not in _VOLATILE_NODE_FIELDS}
+        for name, node in (sched.get("nodes") or {}).items()
+    }
+    out["scheduler"] = sched
+    metrics = {}
+    for name, m in (out.get("metrics") or {}).items():
+        if name.startswith("op_") or name.startswith("device_"):
+            # compile-cache state (op_compile vs op_execute/op_cache_hit)
+            # depends on PROCESS history — a warm in-process rerun shifts
+            # families even though the run is identical; device-memory
+            # gauges depend on the backend.  Neither is run identity.
+            continue
+        keep_values = name in (
+            "rows_ingested_total", "bytes_written_total", "artifact_writes_total"
+        )
+        metrics[name] = {
+            "type": m.get("type"),
+            "series": (m.get("series") if keep_values
+                       else sorted((m.get("series") or {}).keys())),
+        }
+    out["metrics"] = metrics
+    return out

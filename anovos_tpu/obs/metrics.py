@@ -1,0 +1,254 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+The pipeline's quantitative health signals in one place — node wall time,
+queue wait, rows ingested, bytes written, device-memory high-water mark,
+compile-cache hits — instead of per-module ad-hoc dicts (the old
+``workflow.BLOCK_TIMES``).  Stdlib-only, thread-safe, and cheap enough to
+stay always-on: one lock acquisition + a float add per observation.
+
+Two export surfaces:
+
+* ``snapshot()`` — deterministic JSON-able dict (sorted metric names,
+  sorted label series) embedded in the run manifest so CI can diff runs;
+* ``expose_text()`` — plain-text exposition in the Prometheus line format
+  (``name{label="v"} value``) for quick ``curl``-style inspection and any
+  scraper an operator points at a dump file.
+
+Labels are kwargs at observation time (``counter.inc(1, block="ETL")``);
+each distinct label combination is an independent series.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_metrics",
+    "record_device_memory",
+]
+
+# wall-time histogram bounds (seconds): sub-ms ops through multi-minute
+# blocks; one shared default keeps every duration metric comparable
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                    30.0, 60.0, 300.0)
+
+
+def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _label_str(key: Tuple[Tuple[str, str], ...]) -> str:
+    return ",".join(f'{k}="{v}"' for k, v in key)
+
+
+class _Instrument:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series: "Dict[Tuple[Tuple[str, str], ...], object]" = {}
+
+    def series(self) -> dict:
+        """``{label_string: value}`` snapshot (values are plain numbers or,
+        for histograms, dicts)."""
+        with self._lock:
+            return {_label_str(k): self._export(v) for k, v in sorted(self._series.items())}
+
+    def items(self) -> list:
+        """``[(labels_dict, value), …]`` snapshot for programmatic readers."""
+        with self._lock:
+            return [(dict(k), self._export(v)) for k, v in sorted(self._series.items())]
+
+    def _export(self, v):
+        return v
+
+
+class Counter(_Instrument):
+    """Monotonically increasing sum per label series."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+    def _export(self, v):
+        return round(float(v), 6)
+
+
+class Gauge(_Instrument):
+    """Last-set value per label series; ``set_max`` keeps a high-water mark."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._series[_label_key(labels)] = float(value)
+
+    def set_max(self, value: float, **labels) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            prev = self._series.get(k)
+            if prev is None or value > prev:
+                self._series[k] = float(value)
+
+    def value(self, **labels) -> Optional[float]:
+        with self._lock:
+            v = self._series.get(_label_key(labels))
+            return None if v is None else float(v)
+
+    def _export(self, v):
+        return round(float(v), 6)
+
+
+class Histogram(_Instrument):
+    """Cumulative-bucket histogram with count/sum/min/max per series."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", buckets: Optional[Tuple[float, ...]] = None):
+        super().__init__(name, help)
+        self.buckets: Tuple[float, ...] = tuple(buckets or _DEFAULT_BUCKETS)
+
+    def observe(self, value: float, **labels) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            s = self._series.get(k)
+            if s is None:
+                s = self._series[k] = {
+                    "count": 0, "sum": 0.0,
+                    "min": float("inf"), "max": float("-inf"),
+                    "bucket_counts": [0] * (len(self.buckets) + 1),
+                }
+            s["count"] += 1
+            s["sum"] += float(value)
+            s["min"] = min(s["min"], float(value))
+            s["max"] = max(s["max"], float(value))
+            s["bucket_counts"][bisect.bisect_left(self.buckets, value)] += 1
+
+    def _export(self, v):
+        return {
+            "count": v["count"],
+            "sum": round(v["sum"], 6),
+            "min": round(v["min"], 6),
+            "max": round(v["max"], 6),
+            "buckets": [list(b) for b in zip(
+                [str(b) for b in self.buckets] + ["+Inf"], v["bucket_counts"])],
+        }
+
+
+class MetricsRegistry:
+    """Name → instrument map with get-or-create registration."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: "OrderedDict[str, _Instrument]" = OrderedDict()
+
+    def _get(self, name: str, cls, *args, **kwargs):
+        with self._lock:
+            inst = self._metrics.get(name)
+            if inst is None:
+                inst = self._metrics[name] = cls(name, *args, **kwargs)
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {inst.kind}, "
+                    f"requested {cls.kind}"
+                )
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, Counter, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, Gauge, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Optional[Tuple[float, ...]] = None) -> Histogram:
+        return self._get(name, Histogram, help, buckets)
+
+    # -- export ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Deterministic dict: sorted names, sorted series, rounded floats."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+        return {
+            name: {"type": inst.kind, "help": inst.help, "series": inst.series()}
+            for name, inst in sorted(metrics)
+        }
+
+    def expose_text(self) -> str:
+        """Prometheus-style plain-text exposition."""
+        lines: List[str] = []
+        for name, m in sorted(self.snapshot().items()):
+            if m["help"]:
+                lines.append(f"# HELP {name} {m['help']}")
+            lines.append(f"# TYPE {name} {m['type']}")
+            for labels, v in m["series"].items():
+                lbl = "{" + labels + "}" if labels else ""
+                if m["type"] == "histogram":
+                    cum = 0
+                    for bound, c in v["buckets"]:
+                        cum += c
+                        le = (labels + "," if labels else "") + f'le="{bound}"'
+                        lines.append(f"{name}_bucket{{{le}}} {cum}")
+                    lines.append(f"{name}_count{lbl} {v['count']}")
+                    lines.append(f"{name}_sum{lbl} {v['sum']}")
+                else:
+                    lines.append(f"{name}{lbl} {v}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Drop every instrument (workflow.main: per-run accounting)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_metrics() -> MetricsRegistry:
+    """The process-wide registry."""
+    return _REGISTRY
+
+
+def record_device_memory(registry: Optional[MetricsRegistry] = None) -> None:
+    """Record device-memory usage + high-water mark when the backend
+    exposes it (``jax.local_devices()[0].memory_stats()`` — TPU and GPU
+    runtimes do, CPU returns None).  Never raises; never imports jax unless
+    it is already loaded (keeps stdlib-only callers stdlib-only)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return
+    reg = registry or _REGISTRY
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return
+    if not stats:
+        return
+    in_use = stats.get("bytes_in_use")
+    if in_use is not None:
+        reg.gauge("device_bytes_in_use",
+                  "current device memory allocation").set(float(in_use))
+        reg.gauge("device_bytes_high_water",
+                  "max observed device memory allocation").set_max(float(in_use))
+    peak = stats.get("peak_bytes_in_use")
+    if peak is not None:
+        reg.gauge("device_peak_bytes",
+                  "allocator-reported peak device memory").set_max(float(peak))

@@ -1,0 +1,238 @@
+"""Thread-safe span tracing with Chrome-trace-format export.
+
+The concurrent executor (PR 1) made "what did this run actually do" a
+genuinely parallel question — a per-block INFO line cannot show which nodes
+overlapped, which worker lane ran what, or how long a node sat queued
+behind its dependencies.  This module records nestable spans from any
+thread at negligible cost (one ``perf_counter_ns`` pair + a deque append
+under a lock) and exports them as Chrome-trace JSON loadable in
+``chrome://tracing`` or Perfetto (https://ui.perfetto.dev).
+
+Always-on recording, gated export: spans accumulate in a bounded ring
+buffer regardless of configuration; a trace FILE is only written when
+``ANOVOS_TPU_TRACE`` is set (``1`` → ``<run output>/obs/trace.json``, any
+other value → that path).  Everything here is stdlib-only.
+
+Span events use the Trace Event Format "complete" phase (``ph: "X"``) with
+microsecond ``ts``/``dur``; worker threads appear as separate lanes via
+``tid`` plus ``thread_name`` metadata events, so per-lane span sums can be
+checked against the scheduler's reported wall time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, Iterable, List, Optional
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "get_tracer",
+    "span",
+    "trace_destination",
+    "write_chrome_trace",
+]
+
+# ring-buffer bound: ~200k spans ≈ tens of MB of export, far beyond a
+# configs_full run (~hundreds of spans) but a hard cap for pathological
+# loops (a long-lived service calling traced ops forever)
+_DEFAULT_BUFFER = 200_000
+
+
+class Span:
+    """One finished span: wall-clock interval + attributes, immutable."""
+
+    __slots__ = ("name", "cat", "start_ns", "dur_ns", "thread", "tid", "args")
+
+    def __init__(self, name: str, cat: str, start_ns: int, dur_ns: int,
+                 thread: str, tid: int, args: Optional[dict] = None):
+        self.name = name
+        self.cat = cat
+        self.start_ns = start_ns
+        self.dur_ns = dur_ns
+        self.thread = thread
+        self.tid = tid
+        self.args = args or {}
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (f"Span({self.name!r}, cat={self.cat!r}, "
+                f"dur={self.dur_ns / 1e6:.3f}ms, thread={self.thread!r})")
+
+
+class Tracer:
+    """Collects spans from any thread; nesting is tracked per thread.
+
+    ``span()`` is a context manager; the parent span's name is recorded in
+    the child's ``args["parent"]`` via a thread-local stack, so exported
+    traces keep their logical nesting even across identically-timed events.
+    """
+
+    def __init__(self, buffer: Optional[int] = None):
+        if buffer is None:
+            raw = os.environ.get("ANOVOS_TPU_TRACE_BUFFER", "")
+            try:
+                buffer = int(raw) if raw else _DEFAULT_BUFFER
+            except ValueError:
+                # a module-level Tracer() is built at import: a malformed
+                # env value must degrade to the default, not kill the
+                # whole package import with an opaque traceback
+                import warnings
+
+                warnings.warn(
+                    f"ANOVOS_TPU_TRACE_BUFFER={raw!r} is not an integer; "
+                    f"using the default {_DEFAULT_BUFFER}")
+                buffer = _DEFAULT_BUFFER
+        self._spans: "deque[Span]" = deque(maxlen=max(buffer, 1))
+        self._dropped = 0
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        # one epoch per tracer: chrome ts fields are offsets from it, so a
+        # clear() between runs re-bases the timeline at ~0
+        self._epoch_ns = time.perf_counter_ns()
+
+    # -- recording -------------------------------------------------------
+    def _stack(self) -> List[str]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    @contextmanager
+    def span(self, name: str, cat: str = "anovos", **attrs):
+        """Record ``name`` spanning the ``with`` body.  Exceptions propagate
+        (the span still lands, flagged ``error``)."""
+        stack = self._stack()
+        if stack:
+            attrs.setdefault("parent", stack[-1])
+        stack.append(name)
+        t0 = time.perf_counter_ns()
+        try:
+            yield self
+        except BaseException as e:
+            attrs["error"] = type(e).__name__
+            raise
+        finally:
+            dur = time.perf_counter_ns() - t0
+            stack.pop()
+            th = threading.current_thread()
+            self._record(Span(name, cat, t0 - self._epoch_ns, dur,
+                              th.name, th.ident or 0, attrs))
+
+    def instant(self, name: str, cat: str = "anovos", **attrs) -> None:
+        """A zero-duration marker event."""
+        th = threading.current_thread()
+        self._record(Span(name, cat, time.perf_counter_ns() - self._epoch_ns,
+                          0, th.name, th.ident or 0, attrs))
+
+    def _record(self, sp: Span) -> None:
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self._dropped += 1
+            self._spans.append(sp)
+
+    # -- reading / lifecycle --------------------------------------------
+    def snapshot(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def clear(self) -> None:
+        """Start a fresh timeline (workflow.main calls this per run)."""
+        with self._lock:
+            self._spans.clear()
+            self._dropped = 0
+            self._epoch_ns = time.perf_counter_ns()
+
+    # -- export ----------------------------------------------------------
+    def to_chrome(self, spans: Optional[Iterable[Span]] = None) -> dict:
+        """Trace Event Format document (the ``chrome://tracing`` schema)."""
+        if spans is None:
+            spans = self.snapshot()
+        pid = os.getpid()
+        events: List[dict] = []
+        seen_tids: Dict[int, str] = {}
+        for sp in spans:
+            if sp.tid not in seen_tids:
+                seen_tids[sp.tid] = sp.thread
+            ev = {
+                "name": sp.name,
+                "cat": sp.cat,
+                "ph": "X" if sp.dur_ns else "i",
+                "ts": sp.start_ns / 1e3,   # microseconds
+                "pid": pid,
+                "tid": sp.tid,
+            }
+            if sp.dur_ns:
+                ev["dur"] = sp.dur_ns / 1e3
+            else:
+                ev["s"] = "t"  # instant scope: thread
+            if sp.args:
+                ev["args"] = {k: _jsonable(v) for k, v in sp.args.items()}
+            events.append(ev)
+        meta = [
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": tname}}
+            for tid, tname in sorted(seen_tids.items())
+        ]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def export(self, path: str, spans: Optional[Iterable[Span]] = None) -> str:
+        """Write the Chrome-trace JSON; returns the path written."""
+        doc = self.to_chrome(spans)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    return str(v)
+
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide tracer (scheduler, writer, and ops all share it)."""
+    return _TRACER
+
+
+def span(name: str, cat: str = "anovos", **attrs):
+    """Shortcut: a span on the process-wide tracer."""
+    return _TRACER.span(name, cat=cat, **attrs)
+
+
+def trace_destination(default_dir: str = ".") -> Optional[str]:
+    """Resolve ``ANOVOS_TPU_TRACE`` to an export path, or None when unset.
+
+    ``1``/``true`` → ``<default_dir>/obs/trace.json``; any other non-empty
+    value is used verbatim as the path.
+    """
+    val = os.environ.get("ANOVOS_TPU_TRACE", "")
+    if not val or val.lower() in ("0", "false"):
+        return None
+    if val.lower() in ("1", "true"):
+        return os.path.join(default_dir, "obs", "trace.json")
+    return val
+
+
+def write_chrome_trace(path: str) -> str:
+    """Export the process-wide tracer's buffer to ``path``."""
+    return _TRACER.export(path)

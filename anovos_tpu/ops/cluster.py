@@ -17,12 +17,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from anovos_tpu.obs import timed
+
 # TPU MXU f32 matmuls default to bf16 inputs; the quadratic distance
 # expansion then misjudges within-eps adjacency by orders of magnitude at
 # lat/lon-scale coordinates.  Every distance/center matmul pins true f32.
 _HI = jax.lax.Precision.HIGHEST
 
 
+@timed("ops.kmeans_fit")
 @functools.partial(jax.jit, static_argnames=("k", "iters"))
 def kmeans_fit(X: jax.Array, k: int, iters: int = 50, seed: int = 0) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Lloyd's algorithm.  X: (n, d) → (centers (k, d), labels (n,), inertia)."""
@@ -111,6 +114,7 @@ def _kmeans_inertia_sweep(X: jax.Array, max_k: int, iters: int = 50, seed: int =
     return jax.lax.map(one_candidate, jnp.arange(1, max_k + 1))
 
 
+@timed("ops.kmeans_elbow")
 def kmeans_elbow(X: np.ndarray, max_k: int = 20, seed: int = 0) -> Tuple[int, np.ndarray]:
     """Pick k by the knee of the inertia curve (reference's elbow method).
     One XLA compile + one dispatch for the whole 1..max_k scan.
@@ -474,6 +478,7 @@ def dbscan_grid(
     return out
 
 
+@timed("ops.dbscan_fit")
 def dbscan_fit(
     X: np.ndarray,
     eps: float,

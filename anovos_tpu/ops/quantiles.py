@@ -18,9 +18,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from anovos_tpu.obs import timed
 from anovos_tpu.shared.runtime import column_parallel, wants_column_parallel
 
 
+@timed("ops.masked_quantiles")
 def masked_quantiles(
     X: jax.Array, M: jax.Array, qs: jax.Array, interpolation: str = "linear"
 ) -> jax.Array:
@@ -64,6 +66,7 @@ def masked_median(X: jax.Array, M: jax.Array) -> jax.Array:
     return masked_quantiles(X, M, jnp.array([0.5], X.dtype if X.dtype in (jnp.float32, jnp.float64) else jnp.float32))[0]
 
 
+@timed("ops.histogram_quantiles")
 @functools.partial(jax.jit, static_argnames=("nbins", "chunk"))
 def histogram_quantiles(
     X: jax.Array, M: jax.Array, qs: jax.Array, nbins: int = 2048, chunk: int = 262_144

@@ -22,6 +22,8 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
+from anovos_tpu.obs import timed
+
 
 def finalize_moments(n, s1, m2, m3, m4, cmin, cmax, nonzero) -> Dict[str, jax.Array]:
     """Shared finalizer: globally-reduced power sums → the moments dict.
@@ -51,6 +53,7 @@ def finalize_moments(n, s1, m2, m3, m4, cmin, cmax, nonzero) -> Dict[str, jax.Ar
     }
 
 
+@timed("ops.masked_moments")
 def masked_moments(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
     """All central moments per column of a masked block.
 

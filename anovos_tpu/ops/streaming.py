@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
+from anovos_tpu.obs import timed
+
 
 @jax.jit
 def _chunk_stats(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
@@ -136,6 +138,7 @@ def _iter_chunks(
         yield _emit(cat)
 
 
+@timed("ops.describe_streaming")
 def describe_streaming(
     file_path: str,
     file_type: str,

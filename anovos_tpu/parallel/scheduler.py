@@ -32,7 +32,11 @@ Design properties:
 * **Observability.**  Per-node start/end/thread spans are recorded and
   ``run()`` returns a summary with the measured critical path (longest
   dependency chain by wall time) and the parallel speedup — surfaced in the
-  run log and in ``bench.py``'s e2e section.
+  run log and in ``bench.py``'s e2e section.  Every node additionally emits
+  a tracer span (``anovos_tpu.obs``: worker lane, queue wait, deps waited
+  on) for the Chrome-trace export, and books wall/queue-wait time into the
+  process metrics registry (``node_wall_seconds``,
+  ``node_queue_wait_seconds``) that feeds the run manifest.
 
 Caveat: concurrent mode must only run device work against a SINGLE-device
 runtime.  On a multi-device mesh, two concurrently dispatched programs that
@@ -86,7 +90,7 @@ def available_cpus() -> int:
 class Node:
     __slots__ = (
         "name", "fn", "reads", "writes", "on_error", "deps", "dependents",
-        "pending", "state", "start", "end", "thread", "error",
+        "pending", "state", "start", "end", "ready", "thread", "error",
     )
 
     def __init__(self, name: str, fn: Callable[[], None], reads, writes, on_error: str):
@@ -100,8 +104,16 @@ class Node:
         self.pending = 0            # unfinished deps (concurrent mode)
         self.state = "pending"      # pending|running|done|failed|failed-continued|skipped
         self.start = self.end = 0.0
+        self.ready = 0.0            # when the last dep finished (queue-wait origin)
         self.thread = ""
         self.error: Optional[BaseException] = None
+
+    @property
+    def queue_wait(self) -> float:
+        """Seconds spent ready-but-unstarted (worker-pool contention)."""
+        if self.start and self.ready:
+            return max(self.start - self.ready, 0.0)
+        return 0.0
 
 
 class DagScheduler:
@@ -191,11 +203,19 @@ class DagScheduler:
         return self._summary(time.monotonic() - t0, mode, workers)
 
     def _execute(self, node: Node) -> None:
+        from anovos_tpu.obs import get_metrics, get_tracer
+
         node.state = "running"
         node.thread = threading.current_thread().name
         node.start = time.monotonic()
         try:
-            node.fn()
+            with get_tracer().span(
+                node.name, cat="node",
+                deps=[d.name for d in node.deps],
+                queue_wait_s=round(node.queue_wait, 4),
+                scheduler=self.name,
+            ):
+                node.fn()
             node.state = "done"
         except BaseException as e:
             node.error = e
@@ -207,9 +227,17 @@ class DagScheduler:
                 raise
         finally:
             node.end = time.monotonic()
+            reg = get_metrics()
+            reg.histogram("node_wall_seconds",
+                          "scheduler node execution wall time"
+                          ).observe(node.end - node.start, node=node.name)
+            reg.histogram("node_queue_wait_seconds",
+                          "ready-to-start wait behind the worker pool"
+                          ).observe(node.queue_wait, node=node.name)
 
     def _run_sequential(self) -> None:
         for node in self._nodes:
+            node.ready = time.monotonic()  # no pool: ready == start
             self._execute(node)
 
     def _run_concurrent(self, max_workers: int, node_timeout: float) -> None:
@@ -218,9 +246,11 @@ class DagScheduler:
         running: Dict[str, float] = {}
         state = {"stop": False, "fatal": None, "done": 0}
         total = len(self._nodes)
+        t_ready0 = time.monotonic()
         for n in self._nodes:
             n.pending = len(n.deps)
             if n.pending == 0:
+                n.ready = t_ready0
                 ready.append(n)
 
         def finish(node: Node) -> None:
@@ -234,6 +264,7 @@ class DagScheduler:
                     for dep in node.dependents:
                         dep.pending -= 1
                         if dep.pending == 0 and not state["stop"]:
+                            dep.ready = time.monotonic()
                             ready.append(dep)
                 cv.notify_all()
 
@@ -327,8 +358,10 @@ class DagScheduler:
                     "start_s": round(n.start - origin, 4) if n.end else None,
                     "end_s": round(n.end - origin, 4) if n.end else None,
                     "dur_s": round(n.end - n.start, 4) if n.end else None,
+                    "queue_wait_s": round(n.queue_wait, 4) if n.end else None,
                     "thread": n.thread,
                     "state": n.state,
+                    "deps": [d.name for d in n.deps],
                 }
                 for n in self._nodes
             },

@@ -201,6 +201,12 @@ class AsyncArtifactWriter:
       re-raise any failure.  Called before ``report_generation`` reads the
       master path and before ``main()`` returns, so an async write error
       can never be silently swallowed.
+
+    Observability: each write runs inside a tracer span (cat ``artifact``,
+    its own writer-thread lane in the Chrome trace) and books
+    ``artifact_writes_total`` / ``artifact_write_seconds`` into the process
+    metrics registry; ``wait``/``drain`` span the barrier time consumers
+    actually blocked.
     """
 
     def __init__(self, workers: int = 2, sync: bool = False):
@@ -219,25 +225,52 @@ class AsyncArtifactWriter:
             )
         return self._pool
 
+    @staticmethod
+    def _instrumented(key: str, fn: Callable, args, kwargs):
+        """Run one write inside its span + metrics booking (the writer
+        thread's lane in the Chrome trace shows exactly what it wrote)."""
+        from anovos_tpu.obs import get_metrics, get_tracer
+
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with get_tracer().span(f"write:{key}", cat="artifact", key=key):
+            out = fn(*args, **kwargs)
+        reg = get_metrics()
+        reg.counter("artifact_writes_total", "artifact writes queued+completed"
+                    ).inc(key=key)
+        reg.histogram("artifact_write_seconds", "one artifact write's wall time"
+                      ).observe(_time.perf_counter() - t0, key=key)
+        return out
+
     def submit(self, key: str, fn: Callable, *args, **kwargs) -> None:
         if self._sync:
-            fn(*args, **kwargs)
+            self._instrumented(key, fn, args, kwargs)
             return
-        fut = self._ensure_pool().submit(fn, *args, **kwargs)
+        fut = self._ensure_pool().submit(self._instrumented, key, fn, args, kwargs)
         with self._lock:
             self._pending.setdefault(key, []).append(fut)
 
     def wait(self, keys) -> None:
         with self._lock:
             futs = [f for k in keys for f in self._pending.get(k, ())]
-        for f in futs:
-            f.result()  # re-raises the write's exception with its traceback
+        if not futs:
+            return
+        from anovos_tpu.obs import get_tracer
+
+        with get_tracer().span("artifact:wait", cat="artifact",
+                               keys=list(keys), pending=len(futs)):
+            for f in futs:
+                f.result()  # re-raises the write's exception with its traceback
 
     def drain(self) -> None:
         with self._lock:
             futs = [f for fl in self._pending.values() for f in fl]
-        for f in futs:
-            f.result()
+        from anovos_tpu.obs import get_tracer
+
+        with get_tracer().span("artifact:drain", cat="artifact", pending=len(futs)):
+            for f in futs:
+                f.result()
         with self._lock:  # all landed: forget completed tickets
             for k in list(self._pending):
                 self._pending[k] = [f for f in self._pending[k] if not f.done()]

@@ -29,6 +29,7 @@ Call it BEFORE the first jax backend touch — config updates after backend
 initialization do not take effect.
 """
 
+import logging
 import os
 import select
 import signal
@@ -36,6 +37,11 @@ import subprocess
 import sys
 import tempfile
 import time
+
+# library notices route through the module logger; with no handlers
+# configured, logging's lastResort handler still lands WARNING+ on stderr,
+# so the CLI-visible behavior is unchanged
+logger = logging.getLogger(__name__)
 
 _PROBED: dict = {}
 
@@ -134,13 +140,12 @@ def ensure_responsive_backend(timeout_s: float | None = None, quiet: bool = Fals
     platform, diag = probe_default_backend(budget)
     if platform is None:
         if not quiet:
-            print(
-                f"anovos_tpu: default backend unresponsive ({diag}); "
+            logger.warning(
+                "anovos_tpu: default backend unresponsive (%s); "
                 "falling back to CPU for this run. Set "
                 "ANOVOS_BACKEND_PROBE=0 to trust the configured backend "
                 "without probing, or ANOVOS_BACKEND_PROBE_TIMEOUT to "
-                "lengthen the probe.",
-                file=sys.stderr,
+                "lengthen the probe.", diag,
             )
         os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
@@ -233,10 +238,9 @@ def supervise_demo(stall_timeout_s: float | None = None) -> None:
                 os.killpg(p.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 p.kill()
-            print(
+            logger.warning(
                 "anovos_tpu: run completed (output closed) but the backend "
-                "wedged during teardown; process group reaped.",
-                file=sys.stderr,
+                "wedged during teardown; process group reaped."
             )
             sys.exit(0)
     if stalled:
@@ -254,11 +258,11 @@ def supervise_demo(stall_timeout_s: float | None = None) -> None:
         # appends, report writes) for a failure that had nothing to do
         # with the backend
         sys.exit(p.returncode)
-    print(
-        f"anovos_tpu: supervised run produced no output for {stall:.0f}s "
+    logger.warning(
+        "anovos_tpu: supervised run produced no output for %.0fs "
         "(backend stalled mid-run); retrying once on CPU. Set "
         "ANOVOS_BACKEND_PROBE=0 to trust the configured backend unsupervised.",
-        file=sys.stderr,
+        stall,
     )
     env["JAX_PLATFORMS"] = "cpu"
     r = subprocess.run([sys.executable] + sys.argv, env=env)

@@ -57,25 +57,30 @@ from anovos_tpu.data_report.report_preprocessing import charts_to_objects, save_
 from anovos_tpu.data_transformer import transformers
 from anovos_tpu.drift_stability import drift_detector as ddetector
 from anovos_tpu.drift_stability import stability as dstability
+from anovos_tpu.obs import (
+    build_manifest,
+    get_metrics,
+    get_tracer,
+    record_device_memory,
+    trace_destination,
+    write_chrome_trace,
+    write_manifest,
+)
 from anovos_tpu.parallel.scheduler import DagScheduler
 from anovos_tpu.shared.artifact_store import AsyncArtifactWriter
 from anovos_tpu.shared.table import Table
 
 logger = logging.getLogger("anovos_tpu.workflow")
 
-# per-block wall times of the most recent main() run — the reference logs
-# these per block (workflow.py:227-244); recording them machine-readably as
-# well lets the e2e suite assert a committed per-block budget
-# (tests/golden/e2e_block_budget.csv) so perf regressions fail loudly.
-# Concurrent-executor nodes log from worker threads, so updates go through
-# a lock; timestamps are monotonic-clock based (immune to wall clock steps).
-BLOCK_TIMES: dict = {}
-_BLOCK_TIMES_LOCK = threading.Lock()
-
 # scheduler summary (mode, wall/serial/critical-path seconds, speedup,
 # per-node spans) of the most recent main() run — bench.py's e2e section
 # surfaces these fields so the trajectory JSONs capture the win
 LAST_RUN_SUMMARY: dict = {}
+
+# absolute path of the most recent run's obs/run_manifest.json — the
+# machine-readable record bench.py / perf_report.py / tooling read instead
+# of re-deriving timings from module globals
+LAST_MANIFEST_PATH: str = ""
 
 # stats CSVs each downstream function reads (via stats_args):
 # CHECKER_STATS_ARGS is the shared wiring table (one copy, used by the
@@ -91,11 +96,43 @@ MAINFUNC_TO_ARGS = {
 
 
 def _log_block_time(label: str, start: float) -> None:
+    """Book one block's wall time into the metrics registry (successor of
+    the module-level BLOCK_TIMES dict — the reference logs these per block,
+    workflow.py:227-244; recording them machine-readably lets the e2e suite
+    assert the committed per-block budget, tests/golden/e2e_block_budget.csv).
+    The registry is lock-protected, so concurrent-executor worker threads
+    accumulate safely; timings are monotonic-clock based."""
     secs = round(time.monotonic() - start, 4)
-    with _BLOCK_TIMES_LOCK:
-        BLOCK_TIMES[label] = round(BLOCK_TIMES.get(label, 0.0) + secs, 4)
+    get_metrics().counter(
+        "anovos_block_seconds",
+        "per-block wall time of the most recent workflow.main run",
+    ).inc(secs, block=label)
+    # device-memory high-water mark sampled at every block boundary — the
+    # cheapest cadence that still catches which block peaked HBM
+    record_device_memory()
     logger.info(f"{label}: execution time (in secs) = {secs}")
-logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
+
+
+def block_times() -> dict:
+    """Per-block wall seconds of the most recent ``main()`` run, read from
+    the metrics registry.  The canonical reader for
+    ``tools/record_block_budget.py`` and the bench harness."""
+    counter = get_metrics().counter("anovos_block_seconds")
+    return {
+        labels["block"]: round(v, 4)
+        for labels, v in counter.items()
+        if "block" in labels
+    }
+
+
+def __getattr__(name: str):
+    # compatibility shim for the retired module-level dict: BLOCK_TIMES now
+    # reads as a point-in-time snapshot derived from the MetricsRegistry.
+    # Mutating the returned dict no longer feeds the table — use
+    # ``block_times()`` (readers) / ``_log_block_time`` (writers).
+    if name == "BLOCK_TIMES":
+        return block_times()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def ETL(args: dict) -> Table:
@@ -260,6 +297,23 @@ def _auth_key(auth_key_val: Optional[dict]) -> str:
     return list(auth_key_val.values())[-1] if auth_key_val else "NA"
 
 
+def _clean_spec(d: Optional[dict]) -> dict:
+    """Spec comparison form: None-valued keys are ignored by ETL, so they
+    are ignored by equality too (shared by the registration-time check and
+    the drift node body — one comparison rule)."""
+    return {k: v for k, v in (d or {}).items() if v is not None}
+
+
+def _drift_source_matches_input(all_configs: dict) -> bool:
+    """True when drift_statistics will diff the dataset against itself —
+    the only case worth pinning the pre-treatment ingest Table for."""
+    dd = (all_configs.get("drift_detector") or {}).get("drift_statistics") or {}
+    if (dd.get("configs") or {}).get("pre_existing_source", False):
+        return False
+    src = dd.get("source_dataset")
+    return bool(src) and _clean_spec(src) == _clean_spec(all_configs.get("input_dataset"))
+
+
 class _PipelineRun:
     """Per-run registrar: turns the YAML walk into scheduler nodes.
 
@@ -339,14 +393,23 @@ class _PipelineRun:
 
 
 def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict] = None) -> None:
-    global LAST_RUN_SUMMARY
+    global LAST_RUN_SUMMARY, LAST_MANIFEST_PATH
     start_main = time.monotonic()
-    with _BLOCK_TIMES_LOCK:
-        BLOCK_TIMES.clear()  # the table always describes the most recent run
+    # per-run accounting: the metrics registry and trace buffer always
+    # describe the most recent run (the successor of BLOCK_TIMES.clear());
+    # the op-level compile caches persist, so a warm run's manifest shows
+    # cache hits instead of compiles — exactly the steady-state picture
+    get_metrics().reset()
+    get_tracer().clear()
     LAST_RUN_SUMMARY = {}
+    LAST_MANIFEST_PATH = ""
     auth_key = _auth_key(auth_key_val)
-    df = ETL(all_configs.get("input_dataset"))
-    base_df = df  # pre-treatment ingest result (drift source reuse)
+    with get_tracer().span("input_dataset/ETL", cat="node"):
+        df = ETL(all_configs.get("input_dataset"))
+    # pre-treatment ingest result, pinned ONLY when a drift_statistics spec
+    # will actually reuse it (pinning unconditionally would hold the full
+    # ingest-time table in memory through the whole run for nothing)
+    base_df = df if _drift_source_matches_input(all_configs) else None
 
     write_main = all_configs.get("write_main", None)
     write_intermediate = all_configs.get("write_intermediate", None)
@@ -511,7 +574,10 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                 continue
 
             if key == "stats_generator" and args is not None:
-                for m in args["metric"]:
+                # dedupe: a repeated metric in a hand-edited YAML must not
+                # trip the scheduler's duplicate-node check (the sequential
+                # walk used to run it twice, overwriting the same CSV)
+                for m in dict.fromkeys(args["metric"]):
                     def _stat(df, m=m, args=args):
                         df_stats = getattr(stats_generator, m)(df, **args["metric_args"])
                         if report_input_path:
@@ -593,11 +659,12 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                                 # the demo configs diff the dataset against
                                 # itself: an identical source spec reuses the
                                 # already-ingested base table instead of
-                                # re-paying the read + device upload.  None-
-                                # valued keys are ignored by ETL, so they are
-                                # ignored by the comparison too.
-                                _clean = lambda d: {k: v for k, v in (d or {}).items() if v is not None}
-                                if src_spec and _clean(src_spec) == _clean(all_configs.get("input_dataset")):
+                                # re-paying the read + device upload
+                                if (
+                                    base_df is not None
+                                    and src_spec
+                                    and _clean_spec(src_spec) == _clean_spec(all_configs.get("input_dataset"))
+                                ):
                                     source = base_df
                                 else:
                                     source = ETL(src_spec)
@@ -676,9 +743,34 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                 pipe.fanout("report_generation", _report, reads=art_reads,
                             timed=f"{key}, full_report")
 
+        # ---- obs destinations (manifest + optional chrome trace) -------
+        # the manifest lands next to the run's other artifacts: under the
+        # report master_path when one is configured, else the main output
+        # folder, else the working directory
+        from anovos_tpu.shared.artifact_store import for_run_type
+
+        obs_store = for_run_type(run_type, auth_key)
+        obs_base = report_input_path or (write_main or {}).get("file_path") or "."
+        obs_dir = obs_store.staging_dir(obs_base)
+        trace_dest = trace_destination(obs_dir)
+        manifest_path = os.path.abspath(os.path.join(obs_dir, "obs", "run_manifest.json"))
+
         run_err = None
         try:
             summary = sched.run(mode=mode)
+            # barrier BEFORE the metrics snapshot: every queued artifact
+            # write has landed and booked its counters, so sequential-mode
+            # manifests are deterministic run-to-run
+            writer.drain()
+            record_device_memory()
+            manifest = build_manifest(
+                all_configs, summary, get_metrics().snapshot(),
+                run_type=run_type, block_times=block_times(),
+                trace_path=trace_dest and os.path.abspath(trace_dest),
+            )
+            # the manifest rides the same async write queue as every other
+            # artifact; close() below drains it
+            writer.submit("obs:run_manifest", write_manifest, manifest, manifest_path)
         except BaseException as e:
             run_err = e
             raise
@@ -689,6 +781,21 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                 if run_err is None:
                     raise
                 logger.exception("async artifact writes failed during aborted run")
+            if trace_dest:
+                # export even on failure: the trace of an aborted run is
+                # exactly what the post-mortem needs
+                try:
+                    out_path = write_chrome_trace(os.path.abspath(trace_dest))
+                    logger.info(
+                        "chrome trace written to %s — open it in Perfetto "
+                        "(ui.perfetto.dev) or chrome://tracing", out_path)
+                except Exception:
+                    logger.exception("chrome trace export to %s failed", trace_dest)
+        LAST_MANIFEST_PATH = manifest_path
+        try:  # remote run_types publish the manifest next to the staged stats
+            obs_store.push(manifest_path, os.path.join(obs_base, "obs"))
+        except Exception:
+            logger.exception("manifest push failed; local copy kept at %s", manifest_path)
         LAST_RUN_SUMMARY = summary
         logger.info(DagScheduler.format_summary(summary))
         df = pipe.current_df()

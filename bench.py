@@ -293,8 +293,14 @@ def e2e_cold_warm() -> dict:
                 t0 = time.perf_counter()
                 workflow.run(E2E_CONFIG, "local")
                 out[label] = round(time.perf_counter() - t0, 1)
-                blocks = dict(workflow.BLOCK_TIMES)
-                summary = dict(workflow.LAST_RUN_SUMMARY)
+                # the run manifest (obs subsystem) is the timing record:
+                # block walls + scheduler summary are read from it instead
+                # of re-derived from module globals
+                from anovos_tpu.obs import load_manifest
+
+                man = load_manifest(workflow.LAST_MANIFEST_PATH)
+                blocks = dict(man.get("block_seconds", {}))
+                summary = dict(man.get("scheduler", {}))
             finally:
                 os.chdir(cwd)
     try:
@@ -536,6 +542,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    # entrypoint-only root-logger setup (library code no longer calls
+    # basicConfig): keeps the per-block INFO timing lines on stderr that
+    # the measured children previously inherited from workflow's import
+    import logging
+
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     if len(sys.argv) > 1 and sys.argv[1] == "--measure":
         measure()
         sys.exit(0)

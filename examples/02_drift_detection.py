@@ -59,4 +59,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    # entrypoint-only root-logger setup: surface the per-block INFO timing
+    # lines while the demo runs (library code no longer calls basicConfig)
+    import logging
+
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     main()

@@ -29,6 +29,12 @@ if __name__ == "__main__":
     # every process; ANOVOS_BACKEND_PROBE=0 trusts it unsupervised)
     supervise_demo()
 
+    # entrypoint-only root-logger setup: library modules must never call
+    # logging.basicConfig (the importing application owns the root logger)
+    import logging
+
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
+
     from anovos_tpu import workflow
     config_path = sys.argv[1]
     run_type = sys.argv[2] if len(sys.argv) > 2 else "local"

@@ -403,6 +403,11 @@ def _write_md(r: dict) -> None:
 
 
 if __name__ == "__main__":
+    # entrypoint-only root-logger setup (library code no longer calls
+    # basicConfig): keeps per-block INFO timing lines visible in sections
+    import logging
+
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     if len(sys.argv) > 2 and sys.argv[1] == "--section":
         run_one(sys.argv[2])
     else:

@@ -1,0 +1,48 @@
+"""Tier-1 wiring for tools/check_no_print.py: library modules must not call
+``print()`` (module loggers own diagnostics) or ``logging.basicConfig()``
+(the importing application owns the root logger).  ``__main__``-guarded
+blocks are entrypoints and exempt (e.g. the backend probe's stdout
+handshake protocol)."""
+
+import importlib.util
+import os
+import textwrap
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_no_print",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "check_no_print.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_package_is_print_free():
+    checker = _load_checker()
+    violations = checker.check_package()
+    assert not violations, "\n".join(
+        ["library print()/basicConfig() found — route through module loggers:"]
+        + violations
+    )
+
+
+def test_checker_flags_and_allowlists(tmp_path):
+    """The checker itself: flags library print/basicConfig, allowlists the
+    __main__ guard, and ignores prints inside string literals."""
+    checker = _load_checker()
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""\
+        import logging
+        logging.basicConfig(level=logging.INFO)
+        def f():
+            print("library chatter")
+        CODE = "print('inside a string: not a call')"
+        if __name__ == "__main__":
+            print("cli output: allowed")
+    """))
+    found = checker.check_file(str(bad))
+    assert len(found) == 2, found
+    lines = sorted(l for l, _ in found)
+    assert lines == [2, 4]

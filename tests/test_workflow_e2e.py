@@ -120,6 +120,36 @@ def test_workflow_end_to_end(tmp_path, monkeypatch, executor):
     # report + final dataset
     assert (rs / "ml_anovos_report.html").exists()
     assert (tmp_path / "output" / "final_dataset" / "_SUCCESS").exists()
+    # obs subsystem: the run manifest lands under the master path and names
+    # every executed node with a completed span
+    manifest_path = rs / "obs" / "run_manifest.json"
+    assert manifest_path.exists()
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    # on a multi-device mesh (the 8-virtual-device test runtime) main()
+    # degrades concurrent to sequential — the manifest records what RAN
+    import jax
+
+    expected_mode = "sequential" if len(jax.devices()) > 1 else executor
+    assert manifest["executor"]["mode"] == expected_mode
+    nodes = manifest["scheduler"]["nodes"]
+    expected_nodes = {
+        "stats_generator/global_summary",
+        "stats_generator/measures_of_counts",
+        "stats_generator/measures_of_centralTendency",
+        "quality_checker/duplicate_detection",
+        "quality_checker/nullColumns_detection",
+        "association_evaluator/IV_calculation",
+        "drift_detector/drift_statistics",
+        "report_preprocessing/charts_to_objects",
+        "report_generation",
+    }
+    assert expected_nodes <= set(nodes), sorted(expected_nodes - set(nodes))
+    for name, node in nodes.items():
+        assert node["state"] == "done", (name, node)
+        assert node["dur_s"] is not None, name
+    assert manifest["block_seconds"]
+    assert manifest["metrics"]["rows_ingested_total"]["series"]
 
 
 @pytest.mark.slow

@@ -1,0 +1,108 @@
+"""Lint gate: no ``print()`` and no ``logging.basicConfig()`` inside the
+``anovos_tpu`` library package.
+
+Library output goes through module loggers (the importing application owns
+stdout and the root logger); ``logging.basicConfig`` belongs in the
+entrypoints (``main.py`` / ``anovos_tpu/__main__.py``) only.  The check is
+AST-based, so prints inside string literals (e.g. subprocess probe code)
+never false-positive, and calls inside a module's ``if __name__ ==
+"__main__":`` block are allowlisted — that block IS an entrypoint (CLI
+protocols like the backend probe's stdout handshake live there).
+
+Usage:
+    python tools/check_no_print.py            # exit 1 + listing on violation
+Wired into tier-1 via tests/test_no_print.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+PACKAGE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                       "anovos_tpu")
+
+
+def _main_guard_ranges(tree: ast.Module) -> List[Tuple[int, int]]:
+    """Line ranges of top-level ``if __name__ == "__main__":`` bodies."""
+    out = []
+    for node in tree.body:
+        if not isinstance(node, ast.If):
+            continue
+        t = node.test
+        is_guard = (
+            isinstance(t, ast.Compare)
+            and isinstance(t.left, ast.Name) and t.left.id == "__name__"
+            and len(t.comparators) == 1
+            and isinstance(t.comparators[0], ast.Constant)
+            and t.comparators[0].value == "__main__"
+        )
+        if is_guard:
+            out.append((node.lineno, max(
+                n.end_lineno or n.lineno
+                for n in ast.walk(node) if hasattr(n, "end_lineno"))))
+    return out
+
+
+def check_file(path: str) -> List[Tuple[int, str]]:
+    """[(lineno, violation), …] for one source file."""
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:  # a syntax error is its own violation
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    guards = _main_guard_ranges(tree)
+
+    def allowlisted(lineno: int) -> bool:
+        return any(lo <= lineno <= hi for lo, hi in guards)
+
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f_ = node.func
+        if isinstance(f_, ast.Name) and f_.id == "print":
+            if not allowlisted(node.lineno):
+                out.append((node.lineno, "print() in library code — use the module logger"))
+        elif (
+            isinstance(f_, ast.Attribute) and f_.attr == "basicConfig"
+            and isinstance(f_.value, ast.Name) and f_.value.id == "logging"
+        ):
+            if not allowlisted(node.lineno):
+                out.append((node.lineno,
+                            "logging.basicConfig() in library code — "
+                            "root-logger setup belongs in entrypoints"))
+    return out
+
+
+def check_package(package_dir: str = PACKAGE) -> List[str]:
+    """All violations in the package as 'path:line: message' strings."""
+    violations = []
+    for dirpath, dirs, files in os.walk(package_dir):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, os.path.dirname(package_dir))
+            for lineno, msg in check_file(path):
+                violations.append(f"{rel}:{lineno}: {msg}")
+    return violations
+
+
+def main() -> int:
+    violations = check_package()
+    if violations:
+        print(f"{len(violations)} violation(s):")
+        for v in violations:
+            print("  " + v)
+        return 1
+    print("ok: no print()/logging.basicConfig() in library code")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

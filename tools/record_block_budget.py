@@ -64,7 +64,8 @@ def run_cold_warm(warm_runs: int = 2) -> dict:
                 os.chdir(d)
                 try:
                     workflow.run(CONFIG, "local")
-                    run_times = dict(workflow.BLOCK_TIMES)
+                    # registry-backed successor of the BLOCK_TIMES dict
+                    run_times = workflow.block_times()
                 finally:
                     os.chdir(cwd)
             if label == "warm" and "warm" in times:
@@ -104,4 +105,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    # entrypoint-only root-logger setup: surface the per-block INFO lines
+    # while the budget recorder runs (library no longer calls basicConfig)
+    import logging
+
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     main()
