@@ -23,6 +23,7 @@ import glob
 import json
 import logging
 import os
+import threading
 from html import escape
 from pathlib import Path
 from typing import Dict, List, Optional
@@ -134,15 +135,20 @@ def _load_fig(path: str) -> Optional[dict]:
         return None
 
 
+# table-id sequence for the client-side pager; report tabs can render from
+# a scheduler worker thread while the basic report runs elsewhere, so the
+# counter bump is lock-guarded (graftcheck GC005)
 _table_seq = [0]
+_table_seq_lock = threading.Lock()
 
 
 def _table_html(df: pd.DataFrame, title: str, page: int = 200) -> str:
     """Client-paged table: the FULL frame ships in the page (no silent
     head() truncation — round-1 Weak #7); rows beyond ``page`` hide behind
     a pager."""
-    _table_seq[0] += 1
-    tid = f"tbl{_table_seq[0]}"
+    with _table_seq_lock:
+        _table_seq[0] += 1
+        tid = f"tbl{_table_seq[0]}"
     n = len(df)
     body = df.to_html(index=False, classes="stats", border=0, na_rep="", table_id=tid)
     pager = ""
@@ -1299,7 +1305,8 @@ def anovos_report(
         dataDict_path = store.pull(dataDict_path, os.path.join(final_report_path, "_data_dictionary.csv"))
     if metricDict_path != "NA":
         metricDict_path = store.pull(metricDict_path, os.path.join(final_report_path, "_metric_dictionary.csv"))
-    _table_seq[0] = 0
+    with _table_seq_lock:
+        _table_seq[0] = 0
     tabs: List[tuple] = []
 
     tabs.append(

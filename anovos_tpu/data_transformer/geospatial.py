@@ -14,6 +14,7 @@ rog_calculation :1223, reverse_geocoding :1335.
 
 from __future__ import annotations
 
+import threading
 import warnings
 from typing import List, Optional, Union
 
@@ -640,7 +641,12 @@ def rog_calculation(idf: Table, lat_col: str, long_col: str, id_col: str) -> pd.
     ).reset_index(drop=True)
 
 
-_GEOCODE_CACHE = {}  # resolved path -> (unit_xyz (C,3) np.f32, frame)
+# resolved path -> (unit_xyz (C,3) np.f32, frame); the geo analyzer runs on
+# scheduler worker threads, so the build-and-store is lock-guarded (two
+# concurrent first calls would otherwise both parse the table and race the
+# store — graftcheck GC005)
+_GEOCODE_CACHE = {}
+_GEOCODE_CACHE_LOCK = threading.Lock()
 
 
 def _geocode_table() -> tuple:
@@ -664,28 +670,29 @@ def _geocode_table() -> tuple:
     if not path:
         npz = os.path.join(d, "cities.npz")
         path = npz if os.path.exists(npz) else os.path.join(d, "world_cities.csv")
-    if path not in _GEOCODE_CACHE:
-        if path.endswith(".npz"):
-            z = np.load(path, allow_pickle=False)
-            cities = pd.DataFrame(
-                {
-                    "name": z["name"].astype(str),
-                    "admin1": z["admin1"].astype(str),
-                    "cc": z["cc"].astype(str),
-                    "lat": z["lat"].astype(np.float64),
-                    "lon": z["lon"].astype(np.float64),
-                }
-            )
-        else:
-            # keep_default_na=False: Namibia's country code IS the string "NA"
-            cities = pd.read_csv(path, keep_default_na=False)
-        la = np.radians(cities["lat"].to_numpy(float))
-        lo = np.radians(cities["lon"].to_numpy(float))
-        xyz = np.stack(
-            [np.cos(la) * np.cos(lo), np.cos(la) * np.sin(lo), np.sin(la)], axis=1
-        ).astype(np.float32)
-        _GEOCODE_CACHE[path] = (xyz, cities)
-    return _GEOCODE_CACHE[path]
+    with _GEOCODE_CACHE_LOCK:
+        if path not in _GEOCODE_CACHE:
+            if path.endswith(".npz"):
+                z = np.load(path, allow_pickle=False)
+                cities = pd.DataFrame(
+                    {
+                        "name": z["name"].astype(str),
+                        "admin1": z["admin1"].astype(str),
+                        "cc": z["cc"].astype(str),
+                        "lat": z["lat"].astype(np.float64),
+                        "lon": z["lon"].astype(np.float64),
+                    }
+                )
+            else:
+                # keep_default_na=False: Namibia's country code IS the string "NA"
+                cities = pd.read_csv(path, keep_default_na=False)
+            la = np.radians(cities["lat"].to_numpy(float))
+            lo = np.radians(cities["lon"].to_numpy(float))
+            xyz = np.stack(
+                [np.cos(la) * np.cos(lo), np.cos(la) * np.sin(lo), np.sin(la)], axis=1
+            ).astype(np.float32)
+            _GEOCODE_CACHE[path] = (xyz, cities)
+        return _GEOCODE_CACHE[path]
 
 
 @jax.jit

@@ -166,9 +166,12 @@ def neighbor_counts(X: np.ndarray, eps: float, tile: int = 4096) -> np.ndarray:
     X = np.asarray(X, np.float32)
     Xd = jnp.asarray(X - X.mean(axis=0, keepdims=True), jnp.float32)  # magnitude → spread
     eps2 = jnp.asarray(eps * eps, jnp.float32)
-    return np.concatenate(
-        [np.asarray(_neighbor_counts_tile(Xd[s : s + tile], Xd, eps2)) for s in range(0, len(X), tile)]
-    )
+    # dispatch every tile before fetching any: the per-tile programs queue
+    # asynchronously on the device stream and the transfers drain afterwards
+    # (a fetch inside the dispatch loop serialized tile k+1 behind tile k's
+    # download — graftcheck GC001)
+    tiles = [_neighbor_counts_tile(Xd[s : s + tile], Xd, eps2) for s in range(0, len(X), tile)]
+    return np.concatenate([np.asarray(t) for t in tiles])
 
 
 @functools.partial(jax.jit, static_argnames=())
@@ -468,10 +471,10 @@ def dbscan_grid(
         import warnings
 
         warnings.warn(f"dbscan_grid: label propagation hit max_iter={max_iter} without converging")
-    labB = np.asarray(labB)[:, :n]
+    labB_h = np.asarray(labB)[:, :n]  # host copy (labB stays the device handle)
     out = np.full((len(min_samples_list), n), -1, np.int64)
     for b in range(len(min_samples_list)):
-        lab = labB[b]
+        lab = labB_h[b]
         hit = lab >= 0
         if hit.any():
             out[b, hit] = np.unique(lab[hit], return_inverse=True)[1]
@@ -519,6 +522,20 @@ def dbscan_fit(
     seed = _cell_clique_seed(np.asarray(X, np.float32)[core_idx], eps)
     lab0 = jnp.concatenate([jnp.asarray(seed), jnp.arange(m, m_pad, dtype=jnp.float32)])
     lab_d, done = _propagate_labels(Xc, vmask, eps2, t, max_iter, lab0)
+    # dispatch the border-point pass BEFORE materializing the propagation
+    # result: the tile programs queue behind it on the device stream, and
+    # the host-side unique/relabel below overlaps their execution
+    # (materializing first stalled the pipeline between the two phases —
+    # graftcheck GC001)
+    Xc = Xd[core_idx]  # unpadded, for the border-point pass
+    border_idx = np.nonzero(~core)[0]
+    border_tiles = []
+    if len(border_idx):
+        Xb = Xd[border_idx]
+        border_tiles = [
+            _nearest_core_tile(Xb[s : s + tile], Xc, eps2)
+            for s in range(0, len(border_idx), tile)
+        ]
     lab = np.asarray(lab_d)[:m]
     if not bool(done):
         import warnings
@@ -526,17 +543,8 @@ def dbscan_fit(
         warnings.warn(f"dbscan_fit: label propagation hit max_iter={max_iter} without converging")
     comp = np.unique(lab, return_inverse=True)[1]
     labels[core_idx] = comp
-    Xc = Xd[core_idx]  # unpadded, for the border-point pass below
-    # border points → nearest within-eps core
-    border_idx = np.nonzero(~core)[0]
-    if len(border_idx):
-        Xb = Xd[border_idx]
-        owners, hits = [], []
-        for s in range(0, len(border_idx), tile):
-            o, h = _nearest_core_tile(Xb[s : s + tile], Xc, eps2)
-            owners.append(np.asarray(o))
-            hits.append(np.asarray(h))
-        owner = np.concatenate(owners)
-        hit = np.concatenate(hits)
+    if border_tiles:
+        owner = np.concatenate([np.asarray(o) for o, _ in border_tiles])
+        hit = np.concatenate([np.asarray(h) for _, h in border_tiles])
         labels[border_idx[hit]] = comp[owner[hit]]
     return labels

@@ -21,6 +21,7 @@ XLA compiles the two kernels once.
 from __future__ import annotations
 
 import functools
+from collections import deque
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
@@ -29,6 +30,12 @@ import numpy as np
 import pandas as pd
 
 from anovos_tpu.obs import timed
+
+
+# streaming backpressure: how many chunks may be dispatched-but-undrained
+# at once — deep enough to overlap upload/compute/download, shallow enough
+# that device residency stays O(window · chunk_rows · k)
+_INFLIGHT_CHUNKS = 4
 
 
 @jax.jit
@@ -180,16 +187,41 @@ def describe_streaming(
     if not cols:
         raise ValueError("describe_streaming: no numeric columns")
 
-    parts = []
+    # dispatch each chunk's moment program as it streams in and drain the
+    # (tiny) per-chunk partials a WINDOW behind: fetching inside the loop
+    # blocked chunk k+1's upload behind chunk k's download (graftcheck
+    # GC001), while dispatching everything unsynchronized would let the
+    # host read-loop run ahead and keep every chunk's input buffers
+    # resident at once — the window keeps the documented O(chunk_rows·k)
+    # device bound AND the upload/compute overlap.  The f64 pairwise merge
+    # stays on host by design (Chan et al.)
+    pending: "deque" = deque()
+    parts: list = []
+
+    def _drain_oldest():
+        p = pending.popleft()
+        parts.append({k: np.asarray(s) for k, s in p.items()})
+
     for v, m in _iter_chunks(files, file_type, cols, chunk_rows, cfg):
-        parts.append({k: np.asarray(s) for k, s in _chunk_stats(jnp.asarray(v), jnp.asarray(m)).items()})
+        pending.append(_chunk_stats(jnp.asarray(v), jnp.asarray(m)))
+        if len(pending) >= _INFLIGHT_CHUNKS:
+            _drain_oldest()
+    while pending:
+        _drain_oldest()
     agg = _pairwise_merge(parts)
 
     lo = jnp.asarray(agg["min"], jnp.float32)
     hi = jnp.asarray(agg["max"], jnp.float32)
-    hist = np.zeros((len(cols), nbins), np.float32)
-    for v, m in _iter_chunks(files, file_type, cols, chunk_rows, cfg):
-        hist += np.asarray(_chunk_hist(jnp.asarray(v), jnp.asarray(m), lo, hi, nbins))
+    # accumulate the histogram ON DEVICE: downloading each chunk's counts
+    # to add them in numpy forced a blocking round-trip per chunk
+    # (graftcheck GC001); one transfer at the quantile step suffices.  A
+    # periodic block_until_ready keeps the host read-loop from racing
+    # ahead of the device with unbounded in-flight chunk uploads
+    hist_d = jnp.zeros((len(cols), nbins), jnp.float32)
+    for i, (v, m) in enumerate(_iter_chunks(files, file_type, cols, chunk_rows, cfg)):
+        hist_d = hist_d + _chunk_hist(jnp.asarray(v), jnp.asarray(m), lo, hi, nbins)
+        if i % _INFLIGHT_CHUNKS == _INFLIGHT_CHUNKS - 1:
+            jax.block_until_ready(hist_d)
 
     # shared finalizer (ops/reductions.finalize_moments) — one statistical
     # policy for GSPMD, shard_map, and streaming paths alike
@@ -219,7 +251,8 @@ def describe_streaming(
     from anovos_tpu.ops.quantiles import quantiles_from_histogram
 
     width = (agg["max"] - agg["min"]) / nbins
-    qvals = quantiles_from_histogram(hist, agg["min"], width, np.asarray(quantiles, np.float32))
+    qvals = quantiles_from_histogram(np.asarray(hist_d), agg["min"], width,
+                                     np.asarray(quantiles, np.float32))
     for i, q in enumerate(quantiles):
         out[f"{int(q * 100)}%"] = np.round(qvals[i], 4)
     return pd.DataFrame(out)

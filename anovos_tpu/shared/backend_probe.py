@@ -36,6 +36,7 @@ import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 # library notices route through the module logger; with no handlers
@@ -43,7 +44,10 @@ import time
 # so the CLI-visible behavior is unchanged
 logger = logging.getLogger(__name__)
 
+# probe result cache; lock-guarded so two threads racing the FIRST call
+# cannot both pay the (up to 90 s) subprocess probe (graftcheck GC005)
 _PROBED: dict = {}
+_PROBED_LOCK = threading.Lock()
 
 # The probe must run a real jitted computation and fetch the result, not
 # just list devices: the wedged tunnel has been observed (round 5) to
@@ -102,56 +106,60 @@ def ensure_responsive_backend(timeout_s: float | None = None, quiet: bool = Fals
     """Pin this process to a backend that is known to answer.
 
     Returns the platform name the process will use.  Idempotent: the first
-    call decides, later calls return the cached answer.
+    call decides, later calls return the cached answer (concurrent first
+    calls serialize on the lock so exactly one pays the probe).
     """
-    if "platform" in _PROBED:
+    if "platform" in _PROBED:  # fast path, no lock: the dict only grows
         return _PROBED["platform"]
+    with _PROBED_LOCK:
+        if "platform" in _PROBED:
+            return _PROBED["platform"]
 
-    import jax  # deferred: importing jax is cheap; initializing a backend is not
+        import jax  # deferred: importing jax is cheap; initializing a backend is not
 
-    explicit = os.environ.get("JAX_PLATFORMS", "")
-    if explicit:
-        # make the env choice stick even where sitecustomize pre-registered
-        # an accelerator plugin (it latches the platform at startup)
-        jax.config.update("jax_platforms", explicit)
-        if explicit.split(",")[0] == "cpu":
-            # CPU cannot wedge: nothing to probe
-            _PROBED["platform"] = "cpu"
-            return "cpu"
-        # an accelerator platform still gets the bounded probe: the ambient
-        # environment ships JAX_PLATFORMS=<plugin> for every process, so an
-        # env value is NOT evidence of a deliberate user pin, and honoring
-        # it blindly re-creates the infinite quickstart hang
+        explicit = os.environ.get("JAX_PLATFORMS", "")
+        if explicit:
+            # make the env choice stick even where sitecustomize pre-registered
+            # an accelerator plugin (it latches the platform at startup)
+            jax.config.update("jax_platforms", explicit)
+            if explicit.split(",")[0] == "cpu":
+                # CPU cannot wedge: nothing to probe
+                _PROBED["platform"] = "cpu"
+                return "cpu"
+            # an accelerator platform still gets the bounded probe: the ambient
+            # environment ships JAX_PLATFORMS=<plugin> for every process, so an
+            # env value is NOT evidence of a deliberate user pin, and honoring
+            # it blindly re-creates the infinite quickstart hang
 
-    if os.environ.get("ANOVOS_BACKEND_PROBE", "1") == "0":
-        _PROBED["platform"] = explicit.split(",")[0] if explicit else "default"
-        return _PROBED["platform"]
+        if os.environ.get("ANOVOS_BACKEND_PROBE", "1") == "0":
+            _PROBED["platform"] = explicit.split(",")[0] if explicit else "default"
+            return _PROBED["platform"]
 
-    # 90 s default: the probe program is one scalar add — a healthy remote
-    # tunnel cold-compiles it in seconds (the 20-40 s figure is for full
-    # pipeline-sized programs), so 90 s covers interpreter + backend init +
-    # a slow compile with wide margin while keeping the wedged-case wait
-    # tolerable
-    budget = float(
-        timeout_s
-        if timeout_s is not None
-        else os.environ.get("ANOVOS_BACKEND_PROBE_TIMEOUT", 90)
-    )
-    platform, diag = probe_default_backend(budget)
-    if platform is None:
-        if not quiet:
-            logger.warning(
-                "anovos_tpu: default backend unresponsive (%s); "
-                "falling back to CPU for this run. Set "
-                "ANOVOS_BACKEND_PROBE=0 to trust the configured backend "
-                "without probing, or ANOVOS_BACKEND_PROBE_TIMEOUT to "
-                "lengthen the probe.", diag,
-            )
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        jax.config.update("jax_platforms", "cpu")
-        platform = "cpu"
-    _PROBED["platform"] = platform
-    return platform
+        # 90 s default: the probe program is one scalar add — a healthy remote
+        # tunnel cold-compiles it in seconds (the 20-40 s figure is for full
+        # pipeline-sized programs), so 90 s covers interpreter + backend init +
+        # a slow compile with wide margin while keeping the wedged-case wait
+        # tolerable
+        budget = float(
+            timeout_s
+            if timeout_s is not None
+            else os.environ.get("ANOVOS_BACKEND_PROBE_TIMEOUT", 90)
+        )
+        platform, diag = probe_default_backend(budget)
+        if platform is None:
+            if not quiet:
+                logger.warning(
+                    "anovos_tpu: default backend unresponsive (%s); "
+                    "falling back to CPU for this run. Set "
+                    "ANOVOS_BACKEND_PROBE=0 to trust the configured backend "
+                    "without probing, or ANOVOS_BACKEND_PROBE_TIMEOUT to "
+                    "lengthen the probe.", diag,
+                )
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            jax.config.update("jax_platforms", "cpu")
+            platform = "cpu"
+        _PROBED["platform"] = platform
+        return platform
 
 
 def supervise_demo(stall_timeout_s: float | None = None) -> None:

@@ -647,12 +647,17 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                                 writes=(f"stats:{subkey}",), timed=f"{key}, {subkey}")
 
             if key == "drift_detector" and args is not None:
+                # one node body PER subkey (not a shared body branching on a
+                # registration-time default arg): the declared writes= of
+                # each registration then match the callee's actual effects
+                # EXACTLY, which is what graftcheck's GC006 contract audit
+                # verifies — a shared body makes every effect a may-effect
                 for subkey, value in args.items():
                     if value is None or subkey not in ("drift_statistics", "stability_index"):
                         continue
 
-                    def _drift(df, subkey=subkey, value=value):
-                        if subkey == "drift_statistics":
+                    if subkey == "drift_statistics":
+                        def _drift_stats(df, value=value):
                             source = None
                             if not value["configs"].get("pre_existing_source", False):
                                 src_spec = value.get("source_dataset")
@@ -668,14 +673,27 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                                     source = base_df
                                 else:
                                     source = ETL(src_spec)
+                            # statistics() also persists the drift frequency
+                            # model (the charts node's drift tab reads it)
                             df_stats = ddetector.statistics(df, source, **value["configs"])
-                        else:
+                            if report_input_path:
+                                save_stats(df_stats, report_input_path, "drift_statistics",
+                                           run_type=run_type, auth_key=auth_key,
+                                           async_writer=writer, async_key="stats:drift_statistics")
+                            else:
+                                save(df_stats, write_stats, "drift_detector/drift_statistics",
+                                     reread=True, writer=writer, key="stats:drift_statistics")
+                        pipe.fanout("drift_detector/drift_statistics", _drift_stats,
+                                    writes=("stats:drift_statistics", "drift:model"),
+                                    timed=f"{key}, drift_statistics")
+                    else:
+                        def _stability(df, value=value):
                             idfs = [ETL(value[k]) for k in value if k != "configs"]
                             df_stats = dstability.stability_index_computation(*idfs, **value["configs"])
-                        if report_input_path:
-                            save_stats(df_stats, report_input_path, subkey, run_type=run_type,
-                                       auth_key=auth_key, async_writer=writer, async_key=f"stats:{subkey}")
-                            if subkey == "stability_index":
+                            if report_input_path:
+                                save_stats(df_stats, report_input_path, "stability_index",
+                                           run_type=run_type, auth_key=auth_key,
+                                           async_writer=writer, async_key="stats:stability_index")
                                 amp = value["configs"].get("appended_metric_path", "")
                                 if amp:
                                     metrics = data_ingest.read_dataset(amp, "csv", {"header": True})
@@ -683,14 +701,12 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                                                "stabilityIndex_metrics", run_type=run_type,
                                                auth_key=auth_key, async_writer=writer,
                                                async_key="stats:stabilityIndex_metrics")
-                        else:
-                            save(df_stats, write_stats, "drift_detector/" + subkey,
-                                 reread=True, writer=writer, key=f"stats:{subkey}")
-                    extra_writes = ("drift:model",) if subkey == "drift_statistics" else (
-                        "stats:stabilityIndex_metrics",)
-                    pipe.fanout(f"drift_detector/{subkey}", _drift,
-                                writes=(f"stats:{subkey}",) + extra_writes,
-                                timed=f"{key}, {subkey}")
+                            else:
+                                save(df_stats, write_stats, "drift_detector/stability_index",
+                                     reread=True, writer=writer, key="stats:stability_index")
+                        pipe.fanout("drift_detector/stability_index", _stability,
+                                    writes=("stats:stability_index", "stats:stabilityIndex_metrics"),
+                                    timed=f"{key}, stability_index")
 
             if key == "transformers" and args is not None:
                 for subkey, value in args.items():

@@ -1,0 +1,45 @@
+"""GC001 negative fixture: sanctioned boundary syncs."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _kernel(x):
+    return x + 1
+
+
+def boundary_materialize(x):
+    y = _kernel(x)
+    return np.asarray(y)  # trailing materialization: fine
+
+
+def boundary_scalar(x):
+    y = _kernel(x)
+    return float(y.sum())  # trailing scalar with nothing left to dispatch
+
+
+def dispatch_then_drain(xs):
+    tiles = [_kernel(jnp.asarray(x)) for x in xs]  # dispatch all tiles...
+    return np.concatenate([np.asarray(t) for t in tiles])  # ...then drain
+
+
+def device_get_is_sanctioned(x):
+    y = _kernel(x)
+    host = jax.device_get(y)
+    z = _kernel(jnp.asarray(host))
+    return jax.device_get(z)
+
+
+def container_truthiness(xs):
+    tiles = [_kernel(jnp.asarray(x)) for x in xs]
+    if tiles:  # python list length check, not a device sync
+        return np.asarray(tiles[0])
+    return None
+
+
+def shape_checks(x):
+    y = _kernel(x)
+    if y.ndim == 2 and y.shape[0] > 0:  # trace-time metadata
+        return np.asarray(y)
+    return None

@@ -1,0 +1,43 @@
+"""GC001 positive fixture: host syncs in pipeline-stalling positions."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _kernel(x):
+    return x * 2
+
+
+def scalar_pull_in_loop(xs):
+    total = _kernel(jnp.asarray(xs))
+    out = []
+    for j in range(3):
+        out.append(float(total[j]))  # float() per iteration
+    return out
+
+
+def item_pull(x):
+    y = _kernel(x)
+    return y.item()  # .item() scalar pull
+
+
+def sync_before_dispatch(x):
+    y = _kernel(x)
+    host = np.asarray(y)  # materializes before the dispatch below
+    z = _kernel(jnp.asarray(host + 1))
+    return np.asarray(z)
+
+
+def truthiness(x):
+    y = _kernel(x)
+    if y:  # host control flow on a device value
+        return 1
+    return 0
+
+
+def sync_in_dispatch_loop(xs):
+    acc = np.zeros(4)
+    for x in xs:
+        acc = acc + np.asarray(_kernel(jnp.asarray(x)))  # per-chunk download
+    return acc

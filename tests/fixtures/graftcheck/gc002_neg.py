@@ -1,0 +1,40 @@
+"""GC002 negative fixture: trace-time-safe control flow in jit."""
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("nbins",))
+def static_branch(x, nbins=4):
+    if nbins > 2:  # static arg: resolved at trace time
+        return jnp.clip(x, 0, nbins)
+    return x
+
+
+@jax.jit
+def none_default(x, w=None):
+    if w is None:  # identity test against None: trace-time
+        w = jnp.ones_like(x)
+    return x * w
+
+
+@jax.jit
+def shape_branch(x):
+    if x.ndim == 1:  # metadata: trace-time
+        x = x[:, None]
+    assert x.shape[1] >= 1
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("cp",))
+def device_branchless(x, cp=False):
+    return jnp.where(x > 0, x, -x)  # branching stays on device
+
+
+@jax.jit
+def container_param(datas: Tuple[jax.Array, ...]):
+    if datas:  # tuple length check: trace-time
+        return jnp.stack(datas).sum()
+    return jnp.zeros(())

@@ -1,0 +1,36 @@
+"""GC002 positive fixture: Python control flow on traced values in jit."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branch_on_tracer(x):
+    if x > 0:  # TracerBoolConversionError at trace time
+        return x
+    return -x
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def while_on_tracer(x, flag=True):
+    while jnp.sum(x) > 0:  # traced predicate
+        x = x - 1
+    return x if flag else -x
+
+
+@jax.jit
+def assert_on_tracer(x):
+    y = x * 2
+    assert y.sum() > 0  # traced assert
+    return y
+
+
+@jax.jit
+def nested_body_branch(x):
+    def body(carry):
+        if carry > 0:  # carry is a tracer inside the lax loop
+            return carry - 1
+        return carry
+
+    return jax.lax.while_loop(lambda c: c > 0, body, x)

@@ -1,0 +1,33 @@
+"""GC003 negative fixture: sanctioned jit construction."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def decorated(x):
+    return x + 1
+
+
+@functools.partial(jax.jit, static_argnames=("nbins",))
+def decorated_partial(x, nbins=4):
+    return jnp.clip(x, 0, nbins)
+
+
+def _plain(x):
+    return x * 2
+
+
+_module_level = jax.jit(_plain)  # built once at import
+
+
+@functools.lru_cache(maxsize=8)
+def memoized_factory(nbins):
+    # per-config jit cached by the factory: one wrapper per distinct nbins
+    return functools.partial(jax.jit, static_argnames=())(lambda x: x * nbins)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def static_num_in_range(x, scale):
+    return x * scale

@@ -1,0 +1,39 @@
+"""GC003 positive fixture: recompile traps."""
+import functools
+
+import jax
+
+
+def jit_per_call(fn, x):
+    jitted = jax.jit(fn)  # fresh compile cache every invocation
+    return jitted(x)
+
+
+def jit_in_loop(fns, x):
+    out = []
+    for f in fns:
+        out.append(functools.partial(jax.jit, static_argnames=())(f)(x))
+    return out
+
+
+def nested_jit_def(x):
+    @jax.jit
+    def step(v):  # re-traced on every nested_jit_def call
+        return v + 1
+
+    return step(x)
+
+
+@functools.partial(jax.jit, static_argnames=("missing",))
+def static_name_typo(x, nbins=4):  # 'missing' is not a parameter
+    return x * nbins
+
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def static_num_out_of_range(x, y):  # only 2 positional params
+    return x + y
+
+
+@functools.partial(jax.jit, static_argnames=("opts",))
+def unhashable_static_default(x, opts=[]):  # list default on a static arg
+    return x

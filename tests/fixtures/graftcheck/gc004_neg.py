@@ -1,0 +1,33 @@
+"""GC004 negative fixture: disciplined key handling."""
+import jax
+
+
+def split_consumers(seed):
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.uniform(k1, (3,))
+    b = jax.random.normal(k2, (3,))
+    return a, b
+
+
+def loop_with_split(seed, n):
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        out.append(jax.random.uniform(sub, (2,)))
+    return out
+
+
+def fold_in_rekey(seed, n):
+    base = jax.random.PRNGKey(seed)
+    out = []
+    for i in range(n):
+        key = jax.random.fold_in(base, i)
+        out.append(jax.random.uniform(key, (2,)))
+    return out
+
+
+def single_use(seed):
+    key = jax.random.PRNGKey(seed)
+    return jax.random.uniform(key, (4,))

@@ -1,0 +1,25 @@
+"""GC004 positive fixture: PRNG key reuse."""
+import jax
+
+
+def double_consume(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.uniform(key, (3,))
+    b = jax.random.normal(key, (3,))  # same key: correlated draws
+    return a, b
+
+
+def use_after_bare_split(seed):
+    key = jax.random.PRNGKey(seed)
+    x = jax.random.normal(key, (3,))
+    subkeys = jax.random.split(key, 4)  # does NOT re-key `key`...
+    y = jax.random.normal(key, (3,))  # ...so this repeats x's stream exactly
+    return x, y, subkeys
+
+
+def loop_reuse(seed, n):
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for _ in range(n):
+        out.append(jax.random.uniform(key, (2,)))  # same stream every iteration
+    return out

@@ -1,0 +1,26 @@
+"""GC005 negative fixture: locked or local mutation."""
+import threading
+
+_CACHE = {}
+_CACHE_LOCK = threading.Lock()
+_CONSTANTS = {"a": 1}  # read-only: never mutated
+
+
+def store(key, value):
+    with _CACHE_LOCK:
+        _CACHE[key] = value
+
+
+def get(key):
+    with _CACHE_LOCK:
+        return _CACHE.get(key)
+
+
+def local_shadow():
+    _CACHE = {}  # a fresh LOCAL dict, not the module global
+    _CACHE["x"] = 1
+    return _CACHE
+
+
+def read_only():
+    return _CONSTANTS["a"]

@@ -1,0 +1,23 @@
+"""GC005 positive fixture: unlocked module-global mutation."""
+
+_CACHE = {}
+_ITEMS = []
+_SEQ = [0]
+
+
+def store(key, value):
+    _CACHE[key] = value  # no lock
+
+
+def push(value):
+    _ITEMS.append(value)  # no lock
+
+
+def bump():
+    _SEQ[0] += 1  # no lock
+    return _SEQ[0]
+
+
+def rebind():
+    global _CACHE
+    _CACHE = {}  # unlocked rebind

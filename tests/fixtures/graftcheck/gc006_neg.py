@@ -1,0 +1,54 @@
+"""GC006 negative fixture: exact contracts, conditional and barrier forms."""
+
+
+def save_stats(df, path, name, **kwargs):
+    pass
+
+
+def save(data, cfg, folder, **kwargs):
+    pass
+
+
+def stats_args(cfg, func):
+    return {}
+
+
+def _stats_deps(cfg, func):
+    return ()
+
+
+def anovos_report(**kwargs):
+    pass
+
+
+def register(sched, writer, cfg, pipe, report_input_path):
+    def _exact(df):
+        extra = stats_args(cfg, "nullColumns_detection")
+        if report_input_path:
+            save_stats(df, "p", "nullColumns_detection",
+                       async_key="stats:nullColumns_detection", **extra)
+        else:
+            save(df, cfg, "qc", key="stats:nullColumns_detection")
+
+    sched.add("quality/null", _exact,
+              reads=_stats_deps(cfg, "nullColumns_detection"),
+              writes=("stats:nullColumns_detection",))
+
+    for m in ("histogram", "unique"):
+        def _stat(df, m=m):
+            save_stats(df, "p", m, async_key=f"stats:{m}")
+
+        sched.add(f"stats/{m}", _stat, writes=(f"stats:{m}",))
+
+    def _ckpt(df):
+        # checkpoint writes without a key are not scheduler resources
+        save(df, cfg, "intermediate", reread=True, writer=writer)
+        writer.submit("charts:objects", lambda: None)
+
+    sched.add("charts", _ckpt, writes=("charts:objects",))
+
+    def _report(df):
+        anovos_report(run_type="local")
+
+    art_reads = tuple(pipe.artifact_keys)
+    sched.add("report", _report, reads=art_reads)

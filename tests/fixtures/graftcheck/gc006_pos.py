@@ -1,0 +1,39 @@
+"""GC006 positive fixture: registration contracts diverging from effects."""
+
+
+def save_stats(df, path, name, **kwargs):
+    pass
+
+
+def stats_args(cfg, func):
+    return {}
+
+
+def _stats_deps(cfg, func):
+    return ()
+
+
+def register(sched, writer, cfg):
+    def _undeclared_writer(df):
+        save_stats(df, "p", "unique", async_key="stats:unique")  # writes stats:unique
+
+    sched.add("stats/unique", _undeclared_writer, reads=(), writes=())
+
+    def _pure(df):
+        return df
+
+    # declares a write it never performs
+    sched.add("stale_writer", _pure, writes=("stats:gone",))
+
+    def _undeclared_reader(df):
+        extra = stats_args(cfg, "nullColumns_detection")  # reads stats deps
+        return extra
+
+    sched.add("reader", _undeclared_reader, reads=(), writes=())
+
+    def _no_reads(df):
+        return df
+
+    # declares a read the body never performs
+    sched.add("stale_reader", _no_reads,
+              reads=_stats_deps(cfg, "nullColumns_detection"), writes=())

@@ -1,0 +1,15 @@
+"""GC007 negative fixture: module logger + __main__-guarded CLI."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+CODE = "print('inside a string literal: not a call')"
+
+
+def announce(msg):
+    logger.info("library notice: %s", msg)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    print("cli output: allowed in the entrypoint block")

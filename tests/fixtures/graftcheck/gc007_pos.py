@@ -1,0 +1,8 @@
+"""GC007 positive fixture: library stdout/root-logger usage."""
+import logging
+
+logging.basicConfig(level=logging.INFO)
+
+
+def announce(msg):
+    print("library chatter:", msg)

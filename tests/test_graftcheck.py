@@ -1,0 +1,170 @@
+"""Tier-1 wiring for graftcheck (tools/graftcheck): the repo-wide scan must
+be clean (every finding fixed, suppressed, or baselined with a
+justification), each rule must fire on its positive fixture and stay quiet
+on its negative one, and the scan must be deterministic.
+
+GC006 gets its own explicit assertions: the acceptance contract is that
+every scheduler registration in ``anovos_tpu/workflow.py`` matches the
+callee's actual effects with ZERO undeclared-write findings — an
+undeclared write is a silent data race in the concurrent executor.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graftcheck import all_rules, scan  # noqa: E402
+from tools.graftcheck import engine  # noqa: E402
+
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftcheck")
+PKG = os.path.join(REPO, "anovos_tpu")
+RULE_IDS = ["GC001", "GC002", "GC003", "GC004", "GC005", "GC006", "GC007"]
+
+
+# -- the gate: repo scan is clean against the committed baseline ----------
+
+def test_repo_scan_clean_and_emits_metrics():
+    code, report, findings = engine.run([PKG], emit_metrics=True)
+    assert code == 0, report
+    # lint debt is booked into the obs registry for the run manifest
+    from anovos_tpu.obs import get_metrics
+
+    snap = get_metrics().snapshot()
+    assert "graftcheck_findings_total" in snap
+    assert snap["graftcheck_findings_total"]["type"] == "gauge"  # a level, not a sum
+    series = snap["graftcheck_findings_total"]["series"]
+    assert sum(v for v in series.values()) == len(findings)
+    assert all(k.startswith('rule="GC') for k in series)
+    # idempotent: a second scan in the same process overwrites, not doubles
+    engine.run([PKG], emit_metrics=True)
+    series2 = get_metrics().snapshot()["graftcheck_findings_total"]["series"]
+    assert series2 == series
+
+
+def test_baseline_matches_fresh_scan_exactly():
+    """No NEW findings beyond the baseline AND no STALE entries — the
+    committed baseline always mirrors reality."""
+    findings = scan([PKG])
+    entries = engine.load_baseline()
+    new, stale = engine.apply_baseline(findings, entries)
+    assert not new, "unbaselined findings:\n" + "\n".join(f.render() for f in new)
+    assert not stale, f"stale baseline entries: {stale}"
+
+
+def test_baseline_entries_are_justified():
+    for e in engine.load_baseline():
+        assert e["justification"].strip(), e  # load_baseline enforces; belt+braces
+
+
+def test_gc006_zero_undeclared_writes_in_workflow():
+    wf = os.path.join(PKG, "workflow.py")
+    findings = [f for f in scan([wf]) if f.rule == "GC006"]
+    undeclared = [f for f in findings if "undeclared write" in f.message
+                  or "does not declare" in f.message]
+    assert not undeclared, "\n".join(f.render() for f in undeclared)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+# -- per-rule fixtures ----------------------------------------------------
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_fires_on_positive_fixture(rule_id):
+    path = os.path.join(FIXTURES, f"{rule_id.lower()}_pos.py")
+    hits = [f for f in scan([path]) if f.rule == rule_id]
+    assert hits, f"{rule_id} found nothing in its positive fixture"
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_quiet_on_negative_fixture(rule_id):
+    path = os.path.join(FIXTURES, f"{rule_id.lower()}_neg.py")
+    hits = [f for f in scan([path]) if f.rule == rule_id]
+    assert not hits, "\n".join(f.render() for f in hits)
+
+
+def test_fixtures_have_no_cross_rule_noise():
+    """A rule's fixtures exercise THAT rule only — other rules stay quiet
+    (keeps fixture failures attributable)."""
+    for rule_id in RULE_IDS:
+        for kind in ("pos", "neg"):
+            path = os.path.join(FIXTURES, f"{rule_id.lower()}_{kind}.py")
+            other = [f for f in scan([path]) if f.rule != rule_id]
+            assert not other, "\n".join(f.render() for f in other)
+
+
+def test_expected_positive_counts():
+    """Pin the per-fixture finding counts so a silently-weakened rule fails
+    loudly (update alongside deliberate fixture changes)."""
+    expected = {"GC001": 5, "GC002": 4, "GC003": 6, "GC004": 3,
+                "GC005": 4, "GC006": 4, "GC007": 2}
+    for rule_id, n in expected.items():
+        path = os.path.join(FIXTURES, f"{rule_id.lower()}_pos.py")
+        hits = [f for f in scan([path]) if f.rule == rule_id]
+        assert len(hits) == n, (rule_id, [f.render() for f in hits])
+
+
+# -- engine mechanics -----------------------------------------------------
+
+def test_scan_is_deterministic():
+    a = json.dumps([f.__dict__ for f in scan([PKG])], sort_keys=True)
+    b = json.dumps([f.__dict__ for f in scan([PKG])], sort_keys=True)
+    assert a == b
+
+
+def test_per_line_suppression(tmp_path):
+    src = (
+        "import jax\n"
+        "def per_call(fn, x):\n"
+        "    j = jax.jit(fn)  # graftcheck: disable=GC003\n"
+        "    return j(x)\n"
+    )
+    p = tmp_path / "suppressed.py"
+    p.write_text(src)
+    assert not [f for f in scan([str(p)]) if f.rule == "GC003"]
+    p.write_text(src.replace("  # graftcheck: disable=GC003", ""))
+    assert [f for f in scan([str(p)]) if f.rule == "GC003"]
+
+
+def test_baseline_refuses_unjustified_entries(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps([{
+        "rule": "GC001", "path": "x.py", "symbol": "f",
+        "message": "m", "count": 1, "justification": "  ",
+    }]))
+    with pytest.raises(ValueError, match="justification"):
+        engine.load_baseline(str(p))
+
+
+def test_baseline_grandfathers_and_reports_stale():
+    from tools.graftcheck.registry import Finding
+
+    f1 = Finding("GC001", "a.py", 3, "f", "msg")
+    entries = [
+        {"rule": "GC001", "path": "a.py", "symbol": "f", "message": "msg",
+         "count": 1, "justification": "j"},
+        {"rule": "GC002", "path": "b.py", "symbol": "g", "message": "gone",
+         "count": 1, "justification": "j"},
+    ]
+    new, stale = engine.apply_baseline([f1, f1], entries)
+    assert len(new) == 1          # second occurrence exceeds count=1
+    assert len(stale) == 1 and stale[0]["rule"] == "GC002"
+
+
+def test_rule_catalogue_complete():
+    assert [r.id for r in all_rules()] == RULE_IDS
+    assert all(r.title for r in all_rules())
+
+
+def test_cli_exits_zero_on_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftcheck", "anovos_tpu"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new findings" in proc.stdout

@@ -1,0 +1,2 @@
+# repo tooling namespace (makes ``python -m tools.graftcheck`` resolvable
+# from the repo root and the graftcheck package importable by the shims)

@@ -1,17 +1,15 @@
-"""Lint gate: no ``print()`` and no ``logging.basicConfig()`` inside the
-``anovos_tpu`` library package.
+"""DEPRECATED shim — the no-print gate now lives in graftcheck as rule
+**GC007** (``tools/graftcheck/rules/gc007_no_print.py``).
 
-Library output goes through module loggers (the importing application owns
-stdout and the root logger); ``logging.basicConfig`` belongs in the
-entrypoints (``main.py`` / ``anovos_tpu/__main__.py``) only.  The check is
-AST-based, so prints inside string literals (e.g. subprocess probe code)
-never false-positive, and calls inside a module's ``if __name__ ==
-"__main__":`` block are allowlisted — that block IS an entrypoint (CLI
-protocols like the backend probe's stdout handshake live there).
+This module keeps the historical API (``check_file`` / ``check_package`` /
+``main``) for anything that imported it (``tests/test_no_print.py``), but
+every check delegates to the graftcheck rule so there is exactly ONE
+implementation of the policy.  New callers should run
+``python -m tools.graftcheck`` instead, which applies GC007 alongside the
+rest of the rule set.
 
-Usage:
+Usage (legacy):
     python tools/check_no_print.py            # exit 1 + listing on violation
-Wired into tier-1 via tests/test_no_print.py.
 """
 
 from __future__ import annotations
@@ -21,61 +19,24 @@ import os
 import sys
 from typing import List, Tuple
 
-PACKAGE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                       "anovos_tpu")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:  # loaded by path (spec_from_file_location) or as a script
+    sys.path.insert(0, _ROOT)
 
+from tools.graftcheck.rules.gc007_no_print import check_tree  # noqa: E402
 
-def _main_guard_ranges(tree: ast.Module) -> List[Tuple[int, int]]:
-    """Line ranges of top-level ``if __name__ == "__main__":`` bodies."""
-    out = []
-    for node in tree.body:
-        if not isinstance(node, ast.If):
-            continue
-        t = node.test
-        is_guard = (
-            isinstance(t, ast.Compare)
-            and isinstance(t.left, ast.Name) and t.left.id == "__name__"
-            and len(t.comparators) == 1
-            and isinstance(t.comparators[0], ast.Constant)
-            and t.comparators[0].value == "__main__"
-        )
-        if is_guard:
-            out.append((node.lineno, max(
-                n.end_lineno or n.lineno
-                for n in ast.walk(node) if hasattr(n, "end_lineno"))))
-    return out
+PACKAGE = os.path.join(_ROOT, "anovos_tpu")
 
 
 def check_file(path: str) -> List[Tuple[int, str]]:
-    """[(lineno, violation), …] for one source file."""
+    """[(lineno, violation), …] for one source file (GC007 semantics)."""
     with open(path) as f:
         src = f.read()
     try:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:  # a syntax error is its own violation
         return [(e.lineno or 0, f"syntax error: {e.msg}")]
-    guards = _main_guard_ranges(tree)
-
-    def allowlisted(lineno: int) -> bool:
-        return any(lo <= lineno <= hi for lo, hi in guards)
-
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f_ = node.func
-        if isinstance(f_, ast.Name) and f_.id == "print":
-            if not allowlisted(node.lineno):
-                out.append((node.lineno, "print() in library code — use the module logger"))
-        elif (
-            isinstance(f_, ast.Attribute) and f_.attr == "basicConfig"
-            and isinstance(f_.value, ast.Name) and f_.value.id == "logging"
-        ):
-            if not allowlisted(node.lineno):
-                out.append((node.lineno,
-                            "logging.basicConfig() in library code — "
-                            "root-logger setup belongs in entrypoints"))
-    return out
+    return check_tree(tree)
 
 
 def check_package(package_dir: str = PACKAGE) -> List[str]:
@@ -100,7 +61,8 @@ def main() -> int:
         for v in violations:
             print("  " + v)
         return 1
-    print("ok: no print()/logging.basicConfig() in library code")
+    print("ok: no print()/logging.basicConfig() in library code "
+          "(via graftcheck GC007 — prefer `python -m tools.graftcheck`)")
     return 0
 
 

@@ -1,0 +1,13 @@
+"""graftcheck — JAX/concurrency-aware static analysis for this repo.
+
+``python -m tools.graftcheck [paths...]`` scans (default:
+``anovos_tpu/``), applies per-line suppressions and the committed
+baseline, and exits non-zero on any NEW finding or STALE baseline entry.
+See ``tools/graftcheck/README.md`` for the rule catalogue.
+"""
+
+from tools.graftcheck import rules as _rules  # noqa: F401  (import = rule registration)
+from tools.graftcheck.engine import run, scan  # noqa: F401
+from tools.graftcheck.registry import Finding, all_rules  # noqa: F401
+
+__all__ = ["run", "scan", "Finding", "all_rules"]

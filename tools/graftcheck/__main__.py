@@ -1,0 +1,77 @@
+"""CLI: ``python -m tools.graftcheck [paths...]`` (or the ``graftcheck``
+console script).
+
+Exit status: 0 when every finding is suppressed or baselined (and no
+baseline entry is stale), 1 otherwise, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _ensure_repo_on_path() -> None:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+
+
+def main(argv=None) -> int:
+    _ensure_repo_on_path()
+    from tools.graftcheck import all_rules
+    from tools.graftcheck import engine
+
+    ap = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="JAX/concurrency-aware static analysis (see tools/graftcheck/README.md)",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to scan (default: anovos_tpu/)")
+    ap.add_argument("--baseline", default=engine.BASELINE_PATH,
+                    help="baseline JSON (default: tools/graftcheck/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings as baseline template entries "
+                         "(justifications left blank — fill them in before committing)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable finding list on stdout")
+    ap.add_argument("--list-rules", action="store_true", help="print the rule catalogue")
+    ap.add_argument("--emit-metrics", action="store_true",
+                    help="book graftcheck_findings_total{rule=...} into the "
+                         "anovos_tpu.obs metrics registry (used by the test gate)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.title}")
+        return 0
+
+    paths = args.paths or [os.path.join(engine.ROOT, "anovos_tpu")]
+    baseline = None if args.no_baseline else args.baseline
+
+    if args.write_baseline:
+        findings = engine.scan(paths)
+        entries = engine.baseline_from_findings(findings)
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump(entries, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {len(entries)} baseline entr"
+              f"{'y' if len(entries) == 1 else 'ies'} to {args.baseline} "
+              "(add a justification to each before committing)")
+        return 0
+
+    code, report, findings = engine.run(paths, baseline_path=baseline,
+                                        emit_metrics=args.emit_metrics)
+    if args.as_json:
+        print(json.dumps([f.__dict__ for f in findings], indent=1, sort_keys=True))
+    else:
+        print(report)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

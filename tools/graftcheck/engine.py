@@ -1,0 +1,218 @@
+"""graftcheck engine: file walking, suppressions, baseline, reporting.
+
+Scan pipeline
+-------------
+1. Collect ``.py`` files under the given paths (skipping ``__pycache__``).
+2. Parse each once; hand the :class:`FileContext` to every rule whose
+   ``applies(relpath)`` accepts the file.
+3. Drop findings suppressed by a ``# graftcheck: disable=GC001[,GC002]``
+   (or ``disable=all``) comment on the flagged line.
+4. Partition the rest against the committed baseline
+   (``tools/graftcheck/baseline.json``): a finding matching a baseline
+   entry on ``(rule, path, symbol, message)`` — up to the entry's
+   ``count`` — is grandfathered; anything beyond is NEW.  Baseline
+   entries with no live finding are STALE.  Both new findings and stale
+   entries fail the run, so the committed baseline is always exact.
+
+Every baseline entry carries a human ``justification`` — loading refuses
+entries without one, so debt can't be silently parked.
+
+Output is deterministic: files sorted by relpath, findings sorted by
+(path, line, rule, message), JSON dumped with sorted keys — two scans of
+the same tree are byte-identical (the determinism tier-1 test).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tools.graftcheck.registry import FileContext, Finding, all_rules
+
+__all__ = [
+    "ROOT", "BASELINE_PATH", "iter_py_files", "scan", "load_baseline",
+    "apply_baseline", "baseline_from_findings", "render_report",
+    "record_obs_metrics", "run",
+]
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+_SUPPRESS_RE = re.compile(r"#\s*graftcheck:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                for fname in sorted(files):
+                    if fname.endswith(".py"):
+                        out.append(os.path.join(dirpath, fname))
+    # sort by repo-relative path so the report order is root-independent
+    return sorted(set(out), key=lambda p: _relpath(p))
+
+
+def _relpath(path: str) -> str:
+    """Repo-relative path for reports and baseline identity.  Anchored to
+    this checkout's ROOT when the file lives under it; otherwise to the
+    CURRENT directory — the installed console script runs from site-packages,
+    where ROOT is meaningless but the operator scans from their repo root,
+    and baseline paths must still come out as ``anovos_tpu/...``."""
+    for anchor in (ROOT, os.getcwd()):
+        rel = os.path.relpath(path, anchor)
+        if not rel.startswith(".."):
+            return rel.replace(os.sep, "/")
+    return path.replace(os.sep, "/")
+
+
+def _suppressed_rules(line_text: str) -> set:
+    m = _SUPPRESS_RE.search(line_text)
+    if not m:
+        return set()
+    return {tok.strip().upper() for tok in m.group(1).split(",") if tok.strip()}
+
+
+def scan(paths: Iterable[str], rules=None) -> List[Finding]:
+    """All unsuppressed findings under ``paths``, deterministically sorted."""
+    rules = list(rules) if rules is not None else all_rules()
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        rel = _relpath(path)
+        applicable = [r for r in rules if r.applies(rel)]
+        if not applicable:
+            continue
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(rule="GC000", path=rel, line=e.lineno or 0,
+                                    symbol="<module>", message=f"syntax error: {e.msg}"))
+            continue
+        ctx = FileContext(path, rel, source, tree)
+        for rule in applicable:
+            for f_ in rule.check(ctx):
+                line_text = ctx.lines[f_.line - 1] if 0 < f_.line <= len(ctx.lines) else ""
+                sup = _suppressed_rules(line_text)
+                if f_.rule in sup or "ALL" in sup:
+                    continue
+                findings.append(f_)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+
+# -- baseline -------------------------------------------------------------
+
+def load_baseline(path: str = BASELINE_PATH) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        entries = json.load(f)
+    for e in entries:
+        for field in ("rule", "path", "symbol", "message"):
+            if not isinstance(e.get(field), str) or not e[field]:
+                raise ValueError(f"baseline entry missing {field!r}: {e}")
+        if not isinstance(e.get("justification"), str) or not e["justification"].strip():
+            raise ValueError(
+                f"baseline entry for {e['rule']} at {e['path']} [{e['symbol']}] "
+                "has no justification — every grandfathered finding must say why"
+            )
+        e.setdefault("count", 1)
+    return entries
+
+
+def apply_baseline(findings: List[Finding], entries: List[dict]) -> Tuple[List[Finding], List[dict]]:
+    """Partition: (new findings not covered by the baseline, stale baseline
+    entries with no matching live finding)."""
+    budget: Dict[Tuple[str, str, str, str], int] = {}
+    for e in entries:
+        k = (e["rule"], e["path"], e["symbol"], e["message"])
+        budget[k] = budget.get(k, 0) + int(e["count"])
+    remaining = dict(budget)
+    new: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+        else:
+            new.append(f)
+    stale = [
+        {"rule": k[0], "path": k[1], "symbol": k[2], "message": k[3], "count": n}
+        for k, n in sorted(remaining.items()) if n > 0
+    ]
+    return new, stale
+
+
+def baseline_from_findings(findings: List[Finding]) -> List[dict]:
+    """Template entries for --write-baseline (justifications left blank —
+    loading will refuse them until a human fills each one in)."""
+    counts: Dict[Tuple[str, str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    return [
+        {"rule": k[0], "path": k[1], "symbol": k[2], "message": k[3],
+         "count": n, "justification": ""}
+        for k, n in sorted(counts.items())
+    ]
+
+
+# -- reporting ------------------------------------------------------------
+
+def render_report(new: List[Finding], stale: List[dict], total: int) -> str:
+    lines: List[str] = []
+    for f in new:
+        lines.append(f.render())
+    for e in stale:
+        lines.append(
+            f"{e['path']}: {e['rule']} [{e['symbol']}] STALE baseline entry "
+            f"(finding no longer present — remove it): {e['message']}"
+        )
+    if new or stale:
+        lines.append(
+            f"graftcheck: {len(new)} new finding(s), {len(stale)} stale baseline "
+            f"entr{'y' if len(stale) == 1 else 'ies'} ({total} finding(s) total pre-baseline)"
+        )
+    else:
+        lines.append(f"graftcheck: ok — 0 new findings ({total} baselined)")
+    return "\n".join(lines)
+
+
+def record_obs_metrics(findings: List[Finding]) -> None:
+    """Book per-rule finding totals (pre-baseline lint debt) into the obs
+    metrics registry as ``graftcheck_findings_total{rule=...}`` so the run
+    manifest / dashboards can track debt over time.  Never raises; a
+    missing anovos_tpu package (standalone tool checkout) is a no-op."""
+    try:
+        from anovos_tpu.obs import get_metrics
+    except Exception:
+        return
+    # a gauge, not a counter: the value is the LEVEL of debt at this scan —
+    # a second scan in the same process must overwrite, not accumulate
+    gauge = get_metrics().gauge(
+        "graftcheck_findings_total",
+        "static-analysis findings per rule (pre-baseline lint debt)",
+    )
+    per_rule: Dict[str, int] = {}
+    for f in findings:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    for rule in all_rules():
+        gauge.set(per_rule.get(rule.id, 0), rule=rule.id)
+
+
+def run(paths: Iterable[str], baseline_path: Optional[str] = BASELINE_PATH,
+        emit_metrics: bool = False) -> Tuple[int, str, List[Finding]]:
+    """Scan + baseline in one call: (exit_code, report_text, all_findings)."""
+    findings = scan(paths)
+    entries = load_baseline(baseline_path) if baseline_path else []
+    new, stale = apply_baseline(findings, entries)
+    if emit_metrics:
+        record_obs_metrics(findings)
+    code = 1 if (new or stale) else 0
+    return code, render_report(new, stale, len(findings)), findings

@@ -1,0 +1,336 @@
+"""Shared JAX-aware AST analysis: jit-decorator detection, device-value
+taint propagation, and f-string normalization.
+
+The taint model is deliberately lightweight — names, not values:
+
+* **Sources** — calls into ``jnp.*`` / ``jax.*`` (minus a host-side
+  allowlist like ``jax.device_get``), calls to file-local functions known
+  to return device values (jit-decorated, or returning a tainted
+  expression), plus any extra seed names a rule supplies (e.g. the traced
+  parameters of a jit function for GC002).
+* **Propagation** — subscripts, attributes, arithmetic, tuple unpacking
+  and comprehension targets of tainted values stay tainted; assignment
+  fixpoint over the function body handles loop-carried names.
+* **Shields** — expressions that are host-safe even on a device value:
+  ``.shape`` / ``.ndim`` / ``.dtype`` / ``.size`` access, ``len()``, and
+  ``is`` / ``is not`` comparisons (all resolved at trace time).
+* **Sinks are the rules' business** — ``np.*`` calls produce HOST values
+  (the conversion itself is the host sync GC001 inspects), so they
+  terminate taint rather than propagate it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = [
+    "attr_chain",
+    "call_chain",
+    "is_jit_decorator",
+    "jit_static_params",
+    "device_returning_functions",
+    "TaintAnalysis",
+    "normalize_template",
+    "enclosing_loops",
+    "JAX_HOST_SAFE",
+]
+
+# jax.* entry points that return HOST values or are pure metadata — calling
+# them is not a device dispatch and their results are not device values
+JAX_HOST_SAFE = {
+    "jax.device_get", "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.default_backend", "jax.config",
+    "jax.debug", "jax.profiler", "jax.tree_util", "jax.tree",
+    "jax.eval_shape", "jax.ShapeDtypeStruct", "jax.jit",
+    "jax.block_until_ready",  # explicit sanctioned sync, not a dispatch
+}
+
+# attribute reads on a device value that resolve at trace time (host-safe)
+SHIELD_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "weak_type", "sharding"}
+
+# jnp.* metadata helpers that neither dispatch nor return device values
+JNP_HOST_SAFE = {
+    "jnp.issubdtype", "jnp.iinfo", "jnp.finfo", "jnp.dtype", "jnp.shape",
+    "jnp.ndim", "jnp.result_type", "jnp.promote_types", "jnp.isdtype",
+}
+
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted name of an attribute chain rooted at a Name — ``jax.random.split``
+    — or None for anything else (calls/subscripts in the chain)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_chain(call: ast.Call) -> Optional[str]:
+    return attr_chain(call.func)
+
+
+def _is_partial_of_jit(call: ast.Call) -> bool:
+    chain = call_chain(call)
+    if chain not in ("functools.partial", "partial", "_functools.partial"):
+        return False
+    return bool(call.args) and attr_chain(call.args[0]) in ("jax.jit", "jit")
+
+
+def is_jit_decorator(dec: ast.AST) -> bool:
+    """``@jax.jit`` / ``@jit`` / ``@functools.partial(jax.jit, ...)``."""
+    if attr_chain(dec) in ("jax.jit", "jit"):
+        return True
+    if isinstance(dec, ast.Call):
+        if attr_chain(dec.func) in ("jax.jit", "jit"):
+            return True
+        return _is_partial_of_jit(dec)
+    return False
+
+
+def _jit_decorator_kwargs(fn: ast.FunctionDef) -> Optional[List[ast.keyword]]:
+    """The keyword list of the jit decorator, or None when ``fn`` isn't
+    jit-decorated."""
+    for dec in fn.decorator_list:
+        if attr_chain(dec) in ("jax.jit", "jit"):
+            return []
+        if isinstance(dec, ast.Call) and (
+            attr_chain(dec.func) in ("jax.jit", "jit") or _is_partial_of_jit(dec)
+        ):
+            return dec.keywords
+    return None
+
+
+def jit_static_params(fn: ast.FunctionDef) -> Optional[Set[str]]:
+    """Static parameter NAMES of a jit-decorated function (resolving
+    static_argnums positions), or None when ``fn`` isn't jit-decorated."""
+    kws = _jit_decorator_kwargs(fn)
+    if kws is None:
+        return None
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static: Set[str] = set()
+    for kw in kws:
+        if kw.arg == "static_argnames":
+            for s in _const_strings(kw.value):
+                static.add(s)
+        elif kw.arg == "static_argnums":
+            for i in _const_ints(kw.value):
+                if 0 <= i < len(params):
+                    static.add(params[i])
+    return static
+
+
+def _const_strings(node: ast.AST) -> Iterable[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for el in node.elts:
+            yield from _const_strings(el)
+
+
+def _const_ints(node: ast.AST) -> Iterable[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for el in node.elts:
+            yield from _const_ints(el)
+
+
+def normalize_template(node: ast.AST) -> Optional[str]:
+    """Stable string form of a key expression: ``"stats:unique"`` stays
+    itself, ``f"stats:{m}"`` becomes ``"stats:{m}"``; anything non-literal
+    inside the braces renders as ``{?}``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            elif isinstance(v, ast.FormattedValue):
+                inner = v.value
+                parts.append("{%s}" % (inner.id if isinstance(inner, ast.Name) else "?"))
+        return "".join(parts)
+    return None
+
+
+def _local_function_defs(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            out.setdefault(node.name, node)
+    return out
+
+
+def device_returning_functions(tree: ast.Module) -> Set[str]:
+    """Names of file-local functions that return device values: jit-
+    decorated, or (one fixpoint round) returning an expression the taint
+    model marks as device-derived."""
+    defs = _local_function_defs(tree)
+    device: Set[str] = {n for n, f in defs.items() if _jit_decorator_kwargs(f) is not None}
+    for _ in range(3):  # wrappers of wrappers converge fast
+        grew = False
+        for name, fn in defs.items():
+            if name in device:
+                continue
+            ta = TaintAnalysis(fn, device_fns=device)
+            for node in walk_function(fn):
+                if isinstance(node, ast.Return) and node.value is not None and ta.tainted(node.value):
+                    device.add(name)
+                    grew = True
+                    break
+        if not grew:
+            break
+    return device
+
+
+def walk_function(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body EXCLUDING nested function/class definitions
+    (they are analyzed on their own)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def enclosing_loops(node: ast.AST, parents) -> List[ast.AST]:
+    """For/While/comprehension ancestors of ``node`` (innermost first),
+    stopping at the enclosing function boundary."""
+    out: List[ast.AST] = []
+    for anc in parents(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            break
+        if isinstance(anc, (ast.For, ast.While, ast.ListComp, ast.SetComp,
+                            ast.DictComp, ast.GeneratorExp)):
+            out.append(anc)
+    return out
+
+
+class TaintAnalysis:
+    """Device-value taint over one function body (see module docstring)."""
+
+    def __init__(self, fn: ast.AST, device_fns: Set[str] = frozenset(),
+                 seed_names: Set[str] = frozenset()):
+        self.fn = fn
+        self.device_fns = set(device_fns)
+        self.names: Set[str] = set(seed_names)
+        self._fixpoint()
+
+    # -- classification ---------------------------------------------------
+    def is_dispatch(self, call: ast.Call) -> bool:
+        """Does this call launch device work / produce a device value?"""
+        chain = call_chain(call)
+        if chain is None:
+            return False
+        root = chain.split(".", 1)[0]
+        if root in ("jnp", "lax"):
+            return chain not in JNP_HOST_SAFE
+        if root == "jax":
+            for safe in JAX_HOST_SAFE:
+                if chain == safe or chain.startswith(safe + "."):
+                    return False
+            return True
+        if chain in self.device_fns:
+            return True
+        return False
+
+    def tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in SHIELD_ATTRS:
+                return False
+            return self.tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.tainted(node.value)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False  # identity tests resolve at trace time
+            return self.tainted(node.left) or any(self.tainted(c) for c in node.comparators)
+        if isinstance(node, ast.Call):
+            chain = call_chain(node)
+            if chain is not None:
+                root = chain.split(".", 1)[0]
+                if root in ("np", "numpy"):
+                    return False  # host result (the conversion is the sync)
+                if chain in ("len", "float", "int", "bool", "str", "repr", "type"):
+                    return False
+            if self.is_dispatch(node):
+                return True
+            # method call ON a device value stays device (x.sum(), x.astype)
+            # — except the host-materializing ones
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in ("item", "tolist"):
+                    return False
+                if self.tainted(node.func.value):
+                    return True
+            # unknown callee: device values generally flow through helpers
+            return any(self.tainted(a) for a in node.args) or any(
+                self.tainted(kw.value) for kw in node.keywords
+            )
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            # dict KEYS are labels, not payloads — only the value element
+            # decides whether the comprehension result is device-derived
+            elts = [node.value] if isinstance(node, ast.DictComp) else [node.elt]
+            extra: Set[str] = set()
+            for gen in node.generators:
+                if self.tainted(gen.iter):
+                    extra |= _target_names(gen.target)
+            if extra:
+                saved = set(self.names)
+                self.names |= extra
+                try:
+                    return any(self.tainted(e) for e in elts)
+                finally:
+                    self.names = saved
+            return any(self.tainted(e) for e in elts)
+        # generic containers / operators: tainted if any child is
+        return any(self.tainted(c) for c in ast.iter_child_nodes(node)
+                   if isinstance(c, ast.expr))
+
+    # -- fixpoint over assignments ----------------------------------------
+    def _fixpoint(self) -> None:
+        for _ in range(10):
+            before = len(self.names)
+            for node in walk_function(self.fn):
+                if isinstance(node, ast.Assign) and self.tainted(node.value):
+                    for t in node.targets:
+                        self.names |= _target_names(t)
+                elif isinstance(node, ast.AnnAssign) and node.value is not None and self.tainted(node.value):
+                    self.names |= _target_names(node.target)
+                elif isinstance(node, ast.AugAssign) and (
+                    self.tainted(node.value) or self.tainted(node.target)
+                ):
+                    self.names |= _target_names(node.target)
+                elif isinstance(node, ast.For) and self.tainted(node.iter):
+                    self.names |= _target_names(node.target)
+                # comprehension targets are handled locally inside tainted()
+                # (their scope never escapes in py3 — adding them here would
+                # leak taint onto same-named variables elsewhere)
+            if len(self.names) == before:
+                return
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    """Names BOUND by an assignment target.  ``x[k] = v`` / ``x.attr = v``
+    store INTO a container without rebinding ``x`` — the container's own
+    truthiness/len stay host-safe, so those roots are not collected."""
+    out: Set[str] = set()
+    stack = [target]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            stack.extend(node.elts)
+        elif isinstance(node, ast.Starred):
+            stack.append(node.value)
+    return out

@@ -1,0 +1,119 @@
+"""Rule registry + the finding/value types every rule shares.
+
+A rule is a class with an ``id`` (``GC0xx``), a one-line ``title``, an
+``applies(relpath)`` scope filter and a ``check(ctx)`` generator yielding
+:class:`Finding`.  Registration is a decorator; the engine iterates
+``all_rules()`` in id order so output is deterministic.
+
+Findings are deliberately LINE-STABLE in identity: the baseline matches on
+``(rule, path, symbol, message)`` — not the line number — so an unrelated
+edit above a grandfathered finding does not invalidate the baseline.  The
+line number is still carried for display and per-line suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple, Type
+
+__all__ = ["Finding", "FileContext", "Rule", "register", "all_rules", "get_rule"]
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One diagnostic.  ``symbol`` is the enclosing function qualname (or
+    ``<module>``) — the stable anchor baseline entries key on."""
+
+    rule: str
+    path: str       # repo-relative, posix separators
+    line: int
+    symbol: str
+    message: str
+
+    def key(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.symbol}] {self.message}"
+
+
+class FileContext:
+    """Parsed view of one source file handed to every applicable rule."""
+
+    def __init__(self, path: str, relpath: str, source: str, tree: ast.Module):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        self._qualnames: Dict[ast.AST, str] = {}
+        self._index()
+
+    def _index(self) -> None:
+        def walk(node: ast.AST, qual: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+                q = qual
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    q = f"{qual}.{child.name}" if qual != "<module>" else child.name
+                    self._qualnames[child] = q
+                walk(child, q)
+
+        walk(self.tree, "<module>")
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def qualname(self, node: ast.AST) -> str:
+        """Qualname of the innermost enclosing def/class of ``node``."""
+        for anc in [node] + list(self.ancestors(node)):
+            q = self._qualnames.get(anc)
+            if q is not None:
+                return q
+        return "<module>"
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule=rule, path=self.relpath,
+                       line=getattr(node, "lineno", 0),
+                       symbol=self.qualname(node), message=message)
+
+
+class Rule:
+    """Base class: subclass, set ``id``/``title``, implement ``check``."""
+
+    id: str = ""
+    title: str = ""
+
+    def applies(self, relpath: str) -> bool:  # default: whole scan set
+        return True
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.id in _RULES:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _RULES[cls.id] = cls()
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+def get_rule(rule_id: str) -> Rule:
+    return _RULES[rule_id]

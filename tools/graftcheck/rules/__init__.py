@@ -1,0 +1,11 @@
+"""Rule modules — importing this package registers every rule."""
+
+from tools.graftcheck.rules import (  # noqa: F401  (import = registration)
+    gc001_host_sync,
+    gc002_tracer_flow,
+    gc003_recompile,
+    gc004_prng_reuse,
+    gc005_global_mutation,
+    gc006_effect_contract,
+    gc007_no_print,
+)

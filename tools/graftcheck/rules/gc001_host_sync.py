@@ -1,0 +1,157 @@
+"""GC001 — host synchronization in a hot path.
+
+Scope: ``anovos_tpu/ops/`` (the jit-adjacent kernel layer).  A host sync
+(``.item()``, ``float()``/``int()``/``bool()``, ``np.asarray``, Python
+truthiness) on a device value blocks the caller until the device pipeline
+drains; in the kernel layer that stalls exactly the async overlap the
+concurrent executor exists to exploit.
+
+What fires, and when:
+
+* ``dev.item()`` — always: a scalar pull is never needed mid-kernel
+  (``np.asarray`` the batch at the boundary instead).
+* ``bool(dev)`` / ``if dev:`` / ``while dev:`` / ``assert dev`` — always:
+  host control flow on device data both syncs and forces eager dispatch.
+* ``float(dev)`` / ``int(dev)`` — when inside a loop (a scalar pull per
+  iteration: bulk-materialize before the loop) or when device work is
+  dispatched later in the same function (the sync splits the pipeline).
+* ``np.asarray(dev)`` / ``np.array(dev)`` — when device work is dispatched
+  later in the same function, or the enclosing loop itself dispatches
+  device work (per-iteration round trips).  A trailing ``np.asarray`` with
+  nothing after it is the sanctioned boundary materialization and is NOT
+  flagged; ``jax.device_get`` is never flagged.
+
+Identity-stable messages (no line numbers) keep baseline entries valid
+across unrelated edits.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.jaxmodel import (
+    TaintAnalysis, call_chain, device_returning_functions, enclosing_loops,
+    walk_function,
+)
+from tools.graftcheck.registry import FileContext, Rule, register
+
+HOT_PATHS = ("anovos_tpu/ops/",)
+
+_NP_MATERIALIZE = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+@register
+class HostSyncRule(Rule):
+    id = "GC001"
+    title = "host sync (.item()/float()/bool()/np.asarray/truthiness) in a hot path"
+
+    def applies(self, relpath: str) -> bool:
+        return any(relpath.startswith(p) for p in HOT_PATHS) or "gc001" in relpath
+
+    def check(self, ctx: FileContext):
+        device_fns = device_returning_functions(ctx.tree)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            yield from self._check_function(ctx, fn, device_fns)
+
+    def _check_function(self, ctx: FileContext, fn: ast.FunctionDef, device_fns):
+        ta = TaintAnalysis(fn, device_fns=device_fns)
+        nodes = list(walk_function(fn))
+        # names bound to Python CONTAINER literals/comprehensions: their own
+        # truthiness is a host-side length check even when the elements are
+        # device values
+        container_names = set()
+        for n in nodes:
+            if isinstance(n, ast.Assign) and isinstance(
+                n.value, (ast.List, ast.ListComp, ast.Tuple, ast.Dict,
+                          ast.DictComp, ast.Set, ast.SetComp),
+            ):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        container_names.add(t.id)
+        dispatch_lines = sorted(
+            n.lineno for n in nodes if isinstance(n, ast.Call) and ta.is_dispatch(n)
+        )
+
+        def dispatch_after(line: int) -> bool:
+            return bool(dispatch_lines) and dispatch_lines[-1] > line
+
+        def loop_info(node: ast.AST):
+            """(in_loop, loop_dispatches) for the innermost enclosing loop."""
+            loops = enclosing_loops(node, ctx.ancestors)
+            if not loops:
+                return False, False
+            for loop in loops:
+                if isinstance(loop, (ast.For, ast.While)):
+                    body = loop.body + getattr(loop, "orelse", [])
+                    sub = [x for stmt in body for x in ast.walk(stmt)]
+                else:  # comprehension: the element part, not the source iterable
+                    elts = [loop.key, loop.value] if isinstance(loop, ast.DictComp) else [loop.elt]
+                    sub = [x for e in elts for x in ast.walk(e)]
+                if any(isinstance(x, ast.Call) and ta.is_dispatch(x) for x in sub):
+                    return True, True
+            return True, False
+
+        for node in nodes:
+            # -- truthiness: if/while/assert on a device expression -------
+            if isinstance(node, (ast.If, ast.While, ast.Assert)):
+                if isinstance(node.test, ast.Name) and node.test.id in container_names:
+                    continue
+                if ta.tainted(node.test):
+                    kind = type(node).__name__.lower()
+                    yield ctx.finding(
+                        self.id, node,
+                        f"host truthiness ({kind}) on a device value forces a "
+                        "blocking sync — compute the predicate with jnp.where or "
+                        "materialize once with np.asarray/jax.device_get first",
+                    )
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            # -- .item() ---------------------------------------------------
+            if (isinstance(node.func, ast.Attribute) and node.func.attr == "item"
+                    and not node.args and ta.tainted(node.func.value)):
+                yield ctx.finding(
+                    self.id, node,
+                    ".item() on a device value is a per-scalar blocking pull — "
+                    "bulk-materialize with np.asarray at the function boundary",
+                )
+                continue
+            chain = call_chain(node)
+            arg0 = node.args[0] if node.args else None
+            if arg0 is None or not ta.tainted(arg0):
+                continue
+            # -- bool()/float()/int() -------------------------------------
+            # a trailing scalar pull with NO device work left to dispatch is
+            # the sanctioned boundary check (e.g. a convergence warning after
+            # the program has drained) — only the pipeline-stalling positions
+            # fire
+            if chain in ("bool", "float", "int"):
+                in_loop, _ = loop_info(node)
+                if in_loop or dispatch_after(node.lineno):
+                    where = ("inside a loop (one device round-trip per iteration)"
+                             if in_loop else "before later device dispatch")
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{chain}() scalar pull on a device value {where} — "
+                        "bulk-materialize with np.asarray first",
+                    )
+                continue
+            # -- np.asarray / np.array ------------------------------------
+            if chain in _NP_MATERIALIZE:
+                in_loop, loop_dispatches = loop_info(node)
+                if in_loop and loop_dispatches:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{chain}() inside a device-dispatching loop syncs every "
+                        "iteration — batch the transfers or keep the "
+                        "accumulation on device",
+                    )
+                elif not in_loop and dispatch_after(node.lineno):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{chain}() host sync before later device dispatch "
+                        "splits the device pipeline — dispatch all device work "
+                        "first, then materialize",
+                    )

@@ -1,0 +1,72 @@
+"""GC002 — tracer-unsafe Python control flow inside a jit function.
+
+Inside ``@jax.jit`` (or ``@functools.partial(jax.jit, ...)``) the
+function runs ONCE on abstract tracers; a Python ``if``/``while``/
+``assert`` on a traced value raises ``TracerBoolConversionError`` at
+trace time (or silently bakes one branch in if it sneaks through via a
+concrete value).  Branching belongs in ``jnp.where`` / ``lax.cond`` /
+``lax.while_loop``.
+
+Traced values: every parameter NOT named in ``static_argnums``/
+``static_argnames``, plus anything derived from them or from ``jnp.*``
+calls.  Trace-time-safe tests are exempt: ``x is None``, ``.shape`` /
+``.ndim`` / ``.dtype`` access, ``len(x)``.  Functions NESTED inside a jit
+function (``lax`` loop bodies, closures) are checked too — their
+parameters are carries, i.e. also tracers.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.jaxmodel import TaintAnalysis, jit_static_params, walk_function
+from tools.graftcheck.registry import FileContext, Rule, register
+
+_CONTAINER_HEADS = {"Tuple", "tuple", "List", "list", "Sequence", "Dict", "dict", "Mapping"}
+
+
+def _is_container_annotation(ann: ast.AST) -> bool:
+    head = ann.value if isinstance(ann, ast.Subscript) else ann
+    name = head.attr if isinstance(head, ast.Attribute) else (
+        head.id if isinstance(head, ast.Name) else None)
+    return name in _CONTAINER_HEADS
+
+
+@register
+class TracerFlowRule(Rule):
+    id = "GC002"
+    title = "Python if/while/assert on a traced value inside @jax.jit"
+
+    def check(self, ctx: FileContext):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            static = jit_static_params(fn)
+            if static is None:
+                continue
+            yield from self._check(ctx, fn, static, fn.name)
+            # nested defs: lax loop bodies / closures — params are carries
+            for nested in ast.walk(fn):
+                if isinstance(nested, ast.FunctionDef) and nested is not fn:
+                    yield from self._check(ctx, nested, set(), fn.name)
+
+    def _check(self, ctx: FileContext, fn: ast.FunctionDef, static, jit_name: str):
+        args = fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+        # container-annotated params (Tuple[...]/List[...]) are pytrees whose
+        # OWN truthiness is a trace-time length check — don't seed them
+        # (their elements are still tracers, a precision tradeoff)
+        containers = {
+            a.arg for a in args
+            if a.annotation is not None and _is_container_annotation(a.annotation)
+        }
+        traced = {a.arg for a in args} - set(static) - containers
+        ta = TaintAnalysis(fn, seed_names=traced)
+        for node in walk_function(fn):
+            if isinstance(node, (ast.If, ast.While, ast.Assert)) and ta.tainted(node.test):
+                kind = type(node).__name__.lower()
+                yield ctx.finding(
+                    self.id, node,
+                    f"Python {kind} on a traced value inside jit function "
+                    f"{jit_name!r} — use jnp.where/lax.cond/lax.while_loop, or "
+                    "mark the argument static if it is genuinely trace-time",
+                )

@@ -1,0 +1,130 @@
+"""GC003 — recompilation traps around ``jax.jit``.
+
+Three concrete hazards, all cheap to miss in review and expensive at
+runtime:
+
+* **jit constructed per call** — ``jax.jit(fn)`` (or
+  ``functools.partial(jax.jit, ...)``) evaluated inside a function body
+  or loop builds a FRESH jit wrapper with an empty compile cache each
+  time, so every invocation re-traces and re-compiles.  Module-level
+  construction, decorators, and memoized factories
+  (``@functools.lru_cache`` / ``@functools.cache``) are exempt.
+* **static_argnames typos** — a name listed in ``static_argnames`` that
+  is not a parameter of the decorated function (jit raises late, at the
+  first call, with a confusing signature error).
+* **unhashable static defaults / out-of-range static_argnums** — a
+  static parameter whose default is a ``list``/``dict``/``set`` literal
+  raises ``TypeError: unhashable`` on the first defaulted call;
+  ``static_argnums`` past the positional parameter list never binds.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.jaxmodel import attr_chain, is_jit_decorator, walk_function
+from tools.graftcheck.registry import FileContext, Rule, register
+
+_MEMO_DECOS = {"functools.lru_cache", "lru_cache", "functools.cache", "cache"}
+
+
+def _is_memoized(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        chain = attr_chain(dec.func if isinstance(dec, ast.Call) else dec)
+        if chain in _MEMO_DECOS:
+            return True
+    return False
+
+
+@register
+class RecompileRule(Rule):
+    id = "GC003"
+    title = "recompile hazards: per-call jax.jit, static-arg typos, unhashable statics"
+
+    def check(self, ctx: FileContext):
+        # -- jit constructed inside a function body ------------------------
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.FunctionDef) or _is_memoized(fn):
+                continue
+            decorator_nodes = {id(d) for dec in fn.decorator_list for d in ast.walk(dec)}
+            for node in walk_function(fn):
+                if id(node) in decorator_nodes:
+                    continue
+                # a jit-DECORATED def nested in a plain function is the same
+                # trap: a fresh wrapper (fresh compile cache) per call
+                if isinstance(node, ast.FunctionDef) and any(
+                    is_jit_decorator(d) for d in node.decorator_list
+                ):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"jit-decorated {node.name!r} defined inside {fn.name!r} "
+                        "builds a fresh compile cache per call (re-traces every "
+                        "invocation) — hoist to module level or memoize the factory",
+                    )
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                if attr_chain(node.func) in ("jax.jit", "jit") or (
+                    attr_chain(node.func) in ("functools.partial", "partial", "_functools.partial")
+                    and node.args and attr_chain(node.args[0]) in ("jax.jit", "jit")
+                ):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"jax.jit constructed inside {fn.name!r} builds a fresh "
+                        "compile cache per call (re-traces every invocation) — "
+                        "hoist to module level, decorate, or memoize the factory "
+                        "with functools.lru_cache",
+                    )
+        # -- decorator static-arg sanity ----------------------------------
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            for dec in fn.decorator_list:
+                if not (isinstance(dec, ast.Call) and is_jit_decorator(dec)):
+                    continue
+                yield from self._check_static_args(ctx, fn, dec)
+
+    def _check_static_args(self, ctx: FileContext, fn: ast.FunctionDef, dec: ast.Call):
+        pos_params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        all_params = set(pos_params) | {a.arg for a in fn.args.kwonlyargs}
+        defaults = dict(zip(reversed([a.arg for a in fn.args.args]),
+                            reversed(fn.args.defaults)))
+        defaults.update({a.arg: d for a, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults)
+                         if d is not None})
+        static_names = []
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for node in ast.walk(kw.value):
+                    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                        static_names.append((node.value, kw.value))
+            elif kw.arg == "static_argnums":
+                for node in ast.walk(kw.value):
+                    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+                        if not 0 <= node.value < len(pos_params):
+                            yield ctx.finding(
+                                self.id, dec,
+                                f"static_argnums={node.value} is out of range for "
+                                f"{fn.name!r} ({len(pos_params)} positional "
+                                "parameter(s)) — it will never bind",
+                            )
+                        else:
+                            static_names.append((pos_params[node.value], kw.value))
+        for name, where in static_names:
+            if name not in all_params:
+                yield ctx.finding(
+                    self.id, dec,
+                    f"static_argnames names {name!r} which is not a parameter of "
+                    f"{fn.name!r} — typo? jit raises a confusing error at first call",
+                )
+                continue
+            default = defaults.get(name)
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(default, ast.Call)
+                and attr_chain(default.func) in ("list", "dict", "set")
+            ):
+                yield ctx.finding(
+                    self.id, dec,
+                    f"static parameter {name!r} of {fn.name!r} defaults to an "
+                    "unhashable value — jit static args must be hashable "
+                    "(TypeError on the first defaulted call)",
+                )

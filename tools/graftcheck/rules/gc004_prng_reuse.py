@@ -1,0 +1,121 @@
+"""GC004 — PRNG key reuse.
+
+JAX PRNG keys are pure values: feeding the SAME key to two
+``jax.random.*`` consumers yields correlated (often identical) streams —
+the classic silent-statistics bug.  The contract is one consumer per key;
+``jax.random.split`` / ``fold_in`` mint fresh keys.
+
+Detection is per-function and line-ordered: a name becomes a KEY when
+assigned from ``jax.random.PRNGKey`` / ``split`` / ``fold_in`` /
+``key``; every ``jax.random.<consumer>(key, ...)`` call uses it up.  A
+second use without an intervening reassignment-from-split fires, as does
+a single use INSIDE a loop when the key was minted outside it and never
+re-split in the loop body (every iteration reuses the key).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from tools.graftcheck.jaxmodel import call_chain, enclosing_loops, walk_function
+from tools.graftcheck.registry import FileContext, Rule, register
+
+# minting / re-keying entry points (NOT consumers)
+_MINTERS = {"PRNGKey", "key", "split", "fold_in", "wrap_key_data", "clone"}
+
+
+def _random_fn(call: ast.Call) -> Optional[str]:
+    chain = call_chain(call)
+    if chain is None:
+        return None
+    if chain.startswith("jax.random.") or chain.startswith("jrandom.") or chain.startswith("random_."):
+        return chain.rsplit(".", 1)[1]
+    return None
+
+
+@register
+class PrngReuseRule(Rule):
+    id = "GC004"
+    title = "same PRNG key fed to two jax.random consumers without a split"
+
+    def check(self, ctx: FileContext):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            yield from self._check(ctx, fn)
+
+    def _check(self, ctx: FileContext, fn: ast.FunctionDef):
+        # statements in source order; per-name state:
+        #   minted_line — where the key was last created/re-keyed
+        #   used_line   — first consumer use since the last mint (None = fresh)
+        state: Dict[str, dict] = {}
+        events = []  # (line, kind, name, node)
+        for node in walk_function(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = node.value
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                if value is None or not isinstance(value, ast.Call):
+                    continue
+                rf = _random_fn(value)
+                if rf in _MINTERS:
+                    for t in targets:
+                        for name_node in ast.walk(t):
+                            if isinstance(name_node, ast.Name):
+                                events.append((node.lineno, "mint", name_node.id, node))
+            if isinstance(node, ast.Call):
+                rf = _random_fn(node)
+                if rf is not None and rf not in _MINTERS and node.args:
+                    arg0 = node.args[0]
+                    if isinstance(arg0, ast.Name):
+                        events.append((node.lineno, "use", arg0.id, node))
+                # NOTE a bare `jax.random.split(key, n)` does NOT re-key
+                # `key`: the parent stays the same value, so consuming it
+                # again after the split is still reuse.  Only an assignment
+                # whose TARGETS include the name (`key, sub = split(key)`,
+                # `key = fold_in(key, i)`) re-keys — handled as "mint" above.
+
+        events.sort(key=lambda e: e[0])
+        resplit_lines: Dict[str, list] = {}
+        for line, kind, name, node in events:
+            if kind == "mint":
+                resplit_lines.setdefault(name, []).append(line)
+
+        for line, kind, name, node in events:
+            if kind == "mint":
+                state[name] = {"minted": line, "used": None}
+                continue
+            st = state.get(name)
+            if st is None:
+                # key came from a parameter/elsewhere — single use is fine,
+                # but loop reuse below still applies
+                st = state[name] = {"minted": 0, "used": None}
+            loops = [
+                l for l in enclosing_loops(node, ctx.ancestors)
+                if isinstance(l, (ast.For, ast.While))
+            ]
+            in_unsplit_loop = False
+            for loop in loops:
+                lo = loop.body[0].lineno if loop.body else loop.lineno
+                hi = max((n.end_lineno or n.lineno)
+                         for n in ast.walk(loop) if getattr(n, "end_lineno", None))
+                if st["minted"] < lo and not any(
+                    lo <= rl <= hi for rl in resplit_lines.get(name, [])
+                ):
+                    in_unsplit_loop = True
+                    break
+            if in_unsplit_loop:
+                yield ctx.finding(
+                    self.id, node,
+                    f"PRNG key {name!r} consumed inside a loop without a per-"
+                    "iteration jax.random.split — every iteration draws the "
+                    "same stream",
+                )
+            elif st["used"] is not None:
+                yield ctx.finding(
+                    self.id, node,
+                    f"PRNG key {name!r} fed to a second jax.random consumer "
+                    "without an intervening jax.random.split — the two draws "
+                    "are correlated",
+                )
+            st["used"] = line

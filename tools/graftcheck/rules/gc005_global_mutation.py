@@ -1,0 +1,153 @@
+"""GC005 — module-level mutable global mutated without a lock.
+
+Everything under ``anovos_tpu/`` is potentially reachable from the DAG
+scheduler's worker threads (analyzer nodes run concurrently), so a bare
+``CACHE[key] = value`` in library code is a data race — at best a double
+compute, at worst a torn read under a future free-threaded runtime, and
+always invisible until it isn't.
+
+Tracked globals: module-level names bound to a mutable container literal
+or constructor (``{}``, ``[]``, ``dict()``, ``list()``, ``set()``,
+``OrderedDict()``, ``defaultdict()``, ``deque()``).  Flagged mutations
+(inside function bodies only — import time is single-threaded):
+
+* ``NAME[...] = v`` / ``NAME[...] += v`` / ``del NAME[...]``
+* mutator method calls: ``.append`` / ``.add`` / ``.update`` /
+  ``.setdefault`` / ``.pop`` / ``.popitem`` / ``.clear`` / ``.extend`` /
+  ``.insert`` / ``.remove`` / ``.discard``
+* rebinding via ``global NAME; NAME = ...``
+
+A mutation is clean when an enclosing ``with`` statement's context
+expression mentions a lock (``...lock...`` in its source, case-
+insensitive) — the idiom every module here uses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from tools.graftcheck.jaxmodel import attr_chain, walk_function
+from tools.graftcheck.registry import FileContext, Rule, register
+
+_MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "collections.OrderedDict",
+                  "defaultdict", "collections.defaultdict", "deque", "collections.deque"}
+_MUTATORS = {"append", "add", "update", "setdefault", "pop", "popitem", "clear",
+             "extend", "insert", "remove", "discard", "appendleft", "popleft"}
+
+
+def _module_mutable_globals(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        if value is None or not targets:
+            continue
+        mutable = isinstance(value, (ast.Dict, ast.List, ast.Set)) or (
+            isinstance(value, ast.Call) and attr_chain(value.func) in _MUTABLE_CTORS
+        )
+        if mutable:
+            out.update(t.id for t in targets)
+    return out
+
+
+def _root_name(node: ast.AST):
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+@register
+class GlobalMutationRule(Rule):
+    id = "GC005"
+    title = "module-level mutable global mutated without a lock"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("anovos_tpu/") or "gc005" in relpath
+
+    def check(self, ctx: FileContext):
+        globals_ = _module_mutable_globals(ctx.tree)
+        if not globals_:
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            declared_global: Set[str] = set()
+            for node in walk_function(fn):
+                if isinstance(node, ast.Global):
+                    declared_global.update(node.names)
+            # names shadowed by a local binding (param or plain local assign
+            # without a ``global`` declaration) are not the module global
+            shadowed = {a.arg for a in fn.args.posonlyargs + fn.args.args
+                        + fn.args.kwonlyargs}
+            for node in walk_function(fn):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            shadowed.add(t.id)
+            shadowed -= declared_global
+            for node in walk_function(fn):
+                name, what = self._mutation(node, globals_, declared_global)
+                if name is None or name in shadowed:
+                    continue
+                if self._under_lock(ctx, node):
+                    continue
+                yield ctx.finding(
+                    self.id, node,
+                    f"module global {name!r} {what} without holding a lock — "
+                    "scheduler worker threads can race; guard with a module "
+                    "threading.Lock (or make the state per-call)",
+                )
+
+    def _mutation(self, node: ast.AST, globals_: Set[str], declared: Set[str]):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    n = _root_name(t)
+                    if n in globals_:
+                        return n, "item-assigned"
+                elif isinstance(t, ast.Name) and t.id in globals_ and t.id in declared:
+                    return t.id, "rebound (global statement)"
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Subscript):
+                n = _root_name(node.target)
+                if n in globals_:
+                    return n, "item-augmented"
+            elif isinstance(node.target, ast.Name) and node.target.id in globals_ and (
+                node.target.id in declared
+            ):
+                return node.target.id, "rebound (global statement)"
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    n = _root_name(t)
+                    if n in globals_:
+                        return n, "item-deleted"
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATORS and isinstance(node.func.value, ast.Name):
+                n = node.func.value.id
+                if n in globals_:
+                    return n, f".{node.func.attr}()-mutated"
+        return None, None
+
+    def _under_lock(self, ctx: FileContext, node: ast.AST) -> bool:
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    try:
+                        src = ast.unparse(item.context_expr)
+                    except Exception:
+                        src = ""
+                    if "lock" in src.lower():
+                        return True
+        return False

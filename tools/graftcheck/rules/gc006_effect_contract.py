@@ -1,0 +1,308 @@
+"""GC006 — scheduler effect-contract auditor.
+
+The DAG scheduler derives ALL of its RAW/WAW/WAR edges from the
+``reads=`` / ``writes=`` resource sets declared at registration
+(``pipe.spine`` / ``pipe.fanout`` / ``sched.add``).  An effect the node
+body performs but does not declare is a silent data race (the scheduler
+may run a reader concurrently with the undeclared writer); a declared
+effect the body no longer performs is a stale edge that serializes the
+DAG for nothing.  This rule cross-checks each registration's declared
+sets against the callee's ACTUAL artifact/resource accesses.
+
+Effect vocabulary (both sides normalize to it):
+
+* ``"stats:histogram"`` / ``f"stats:{m}"`` — literal or template tokens
+  (f-strings normalize to ``stats:{m}``, matching when the declaration
+  uses the same binding).
+* ``<stats_deps:K>`` — the config-derived stats CSVs ``stats_args(cfg,
+  K)`` reads; declared as ``reads=_stats_deps(cfg, K)``.
+* ``<all-artifacts>`` — ``tuple(pipe.artifact_keys)``: the report
+  barrier.  Covers every read.
+
+Actual effects come from a walk of the resolved callee body:
+``save_stats(..., async_key=K)`` and ``save(..., key=K)`` and
+``writer.submit(K, ...)`` write K; ``stats_args(cfg, K)`` reads
+``<stats_deps:K>``; and a small map of known pipeline callees
+(``ts_preprocess`` → writes ``report:ts_autodetect``, ``anovos_report``
+→ reads ``<all-artifacts>``, ``drift_detector.statistics`` → writes
+``drift:model``, …).  Effects under an ``if`` are MAY-effects: a may-
+write must still be declared (the race is real whenever it happens),
+but an undeclared may-read or an unexercised declared-optional token is
+not an error.
+
+``df:N`` spine tokens are scheduler-internal (managed by the
+registration wrappers) and ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftcheck.jaxmodel import attr_chain, call_chain, normalize_template
+from tools.graftcheck.registry import FileContext, Rule, register
+
+ALL = "<all-artifacts>"
+
+# callee name (last dotted component) -> (reads, writes, optional_reads)
+KNOWN_CALLEES: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...], Tuple[str, ...]]] = {
+    "ts_preprocess": ((), ("report:ts_autodetect",), ()),
+    "ts_analyzer": ((), ("report:ts_inspection",), ()),
+    "geospatial_autodetection": ((), ("report:geo",), ()),
+    "anovos_basic_report": ((), ("report:basic",), ()),
+    "anovos_report": ((ALL,), (), ()),
+    "statistics": ((), ("drift:model",), ()),   # drift_detector.statistics persists the model
+    "charts_to_objects": ((), (), ("drift:model",)),  # reuses the drift model when told to
+}
+
+_REGISTRAR_ATTRS = {"spine", "fanout", "add"}
+
+
+class _SymSet:
+    """(required, optional) token sets."""
+
+    def __init__(self, req: Set[str] = None, opt: Set[str] = None):
+        self.req: Set[str] = set(req or ())
+        self.opt: Set[str] = set(opt or ())
+
+    def union(self, other: "_SymSet") -> "_SymSet":
+        return _SymSet(self.req | other.req, self.opt | other.opt)
+
+    def either(self, other: "_SymSet") -> "_SymSet":
+        """Alternative branches: only the intersection is guaranteed."""
+        both = self.req & other.req
+        return _SymSet(both, (self.req | other.req | self.opt | other.opt) - both)
+
+    def all(self) -> Set[str]:
+        return self.req | self.opt
+
+
+def _norm_key_arg(node: ast.AST) -> str:
+    t = normalize_template(node)
+    if t is not None:
+        return t
+    if isinstance(node, ast.Name):
+        return "{%s}" % node.id
+    return "{?}"
+
+
+def _stats_deps_token(call: ast.Call) -> Optional[str]:
+    """``_stats_deps(cfg, K)`` / ``stats_args(cfg, K, ...)`` → token."""
+    chain = call_chain(call)
+    if chain is None:
+        return None
+    last = chain.rsplit(".", 1)[-1]
+    if last not in ("_stats_deps", "stats_args") or len(call.args) < 2:
+        return None
+    return f"<stats_deps:{_norm_key_arg(call.args[1])}>"
+
+
+@register
+class EffectContractRule(Rule):
+    id = "GC006"
+    title = "declared scheduler reads/writes vs the callee's actual effects"
+
+    def check(self, ctx: FileContext):
+        defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                defs.setdefault(node.name, node)
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if not (isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _REGISTRAR_ATTRS):
+                continue
+            if len(call.args) < 2:
+                continue
+            kwargs = {kw.arg for kw in call.keywords}
+            if call.func.attr == "add" and not ({"reads", "writes"} & kwargs):
+                continue  # not a scheduler registration (e.g. set.add)
+            yield from self._audit(ctx, call, defs)
+
+    # -- declared side -----------------------------------------------------
+    def _eval_decl(self, ctx: FileContext, expr: ast.AST, use_line: int) -> _SymSet:
+        if isinstance(expr, (ast.Constant, ast.JoinedStr)):
+            t = normalize_template(expr)
+            return _SymSet({t} if t else set())
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out = _SymSet()
+            for el in expr.elts:
+                out = out.union(self._eval_decl(ctx, el, use_line))
+            return out
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            return self._eval_decl(ctx, expr.left, use_line).union(
+                self._eval_decl(ctx, expr.right, use_line))
+        if isinstance(expr, ast.IfExp):
+            return self._eval_decl(ctx, expr.body, use_line).either(
+                self._eval_decl(ctx, expr.orelse, use_line))
+        if isinstance(expr, ast.Call):
+            tok = _stats_deps_token(expr)
+            if tok is not None:
+                return _SymSet({tok})
+            chain = call_chain(expr)
+            if chain == "tuple" and expr.args and isinstance(expr.args[0], ast.Attribute) \
+                    and expr.args[0].attr == "artifact_keys":
+                return _SymSet({ALL})
+            return _SymSet()
+        if isinstance(expr, ast.Name):
+            return self._resolve_name(ctx, expr.id, use_line)
+        return _SymSet()
+
+    def _resolve_name(self, ctx: FileContext, name: str, use_line: int) -> _SymSet:
+        """Fold the assignments to ``name`` (in source order, before the
+        use, within the registration's enclosing function) into one
+        symbolic value; conditionally-assigned tokens become optional."""
+        scope: ast.AST = ctx.tree
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef) and node.lineno <= use_line <= (
+                getattr(node, "end_lineno", None) or node.lineno
+            ):
+                if scope is ctx.tree or node.lineno > scope.lineno:
+                    scope = node  # innermost enclosing def
+        assigns: List[ast.Assign] = []
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and node.lineno < use_line:
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        assigns.append(node)
+        assigns.sort(key=lambda a: a.lineno)
+        cur = _SymSet()
+        for a in assigns:
+            # self-referencing RHS (x = x + (...)) folds against `cur`
+            val = self._eval_rhs(ctx, a.value, name, cur, a.lineno)
+            conditional = any(isinstance(anc, (ast.If, ast.IfExp))
+                              for anc in ctx.ancestors(a))
+            if conditional:
+                cur = cur.either(val) if cur.all() else _SymSet(set(), val.all())
+            else:
+                cur = val
+        return cur
+
+    def _eval_rhs(self, ctx: FileContext, expr: ast.AST, name: str,
+                  cur: _SymSet, line: int) -> _SymSet:
+        if isinstance(expr, ast.Name) and expr.id == name:
+            return _SymSet(cur.req, cur.opt)
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            return self._eval_rhs(ctx, expr.left, name, cur, line).union(
+                self._eval_rhs(ctx, expr.right, name, cur, line))
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out = _SymSet()
+            for el in expr.elts:
+                out = out.union(self._eval_rhs(ctx, el, name, cur, line))
+            return out
+        return self._eval_decl(ctx, expr, line)
+
+    # -- actual side -------------------------------------------------------
+    def _actual_effects(self, ctx: FileContext, fn: ast.AST) -> Tuple[_SymSet, _SymSet]:
+        reads, writes = _SymSet(), _SymSet()
+
+        def conditional(node: ast.AST) -> bool:
+            for anc in ctx.ancestors(node):
+                if anc is fn:
+                    return False
+                if isinstance(anc, (ast.If, ast.IfExp)):
+                    return True
+            return False
+
+        def book(sym: _SymSet, tok: str, node: ast.AST, forced_opt: bool = False):
+            if tok.startswith("df:"):
+                return
+            if forced_opt or conditional(node):
+                sym.opt.add(tok)
+            else:
+                sym.req.add(tok)
+
+        body = fn.body if isinstance(fn, (ast.FunctionDef, ast.Lambda)) else fn
+        nodes = ast.walk(fn) if not isinstance(body, list) else (
+            n for stmt in body for n in ast.walk(stmt))
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            chain = call_chain(node)
+            last = chain.rsplit(".", 1)[-1] if chain else (
+                node.func.attr if isinstance(node.func, ast.Attribute) else None)
+            if last is None:
+                continue
+            kws = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+            if last == "save_stats":
+                key = kws.get("async_key")
+                if key is not None:
+                    book(writes, _norm_key_arg(key), node)
+                elif len(node.args) >= 3:
+                    book(writes, "stats:" + _norm_key_arg(node.args[2]), node)
+            elif last == "save":
+                if "key" in kws:
+                    book(writes, _norm_key_arg(kws["key"]), node)
+            elif last == "submit" and node.args:
+                book(writes, _norm_key_arg(node.args[0]), node)
+            elif last == "charts_to_objects" and "async_key" in kws:
+                book(writes, _norm_key_arg(kws["async_key"]), node)
+            elif last in ("stats_args", "_stats_deps"):
+                tok = _stats_deps_token(node)
+                if tok:
+                    book(reads, tok, node)
+            if last in KNOWN_CALLEES:
+                r, w, opt_r = KNOWN_CALLEES[last]
+                for tok in r:
+                    book(reads, tok, node)
+                for tok in w:
+                    book(writes, tok, node)
+                for tok in opt_r:
+                    book(reads, tok, node, forced_opt=True)
+        return reads, writes
+
+    # -- diff ---------------------------------------------------------------
+    def _audit(self, ctx: FileContext, call: ast.Call, defs):
+        node_name = _norm_key_arg(call.args[0])
+        fn_ref = call.args[1]
+        if isinstance(fn_ref, ast.Name):
+            fn = defs.get(fn_ref.id)
+        elif isinstance(fn_ref, ast.Lambda):
+            fn = fn_ref
+        else:
+            fn = None
+        if fn is None:
+            return  # unresolvable callee (dynamic dispatch): nothing to audit
+        kws = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+        decl_reads = self._eval_decl(ctx, kws["reads"], call.lineno) if "reads" in kws else _SymSet()
+        decl_writes = self._eval_decl(ctx, kws["writes"], call.lineno) if "writes" in kws else _SymSet()
+        act_reads, act_writes = self._actual_effects(ctx, fn)
+
+        decl_w_all = {t for t in decl_writes.all() if not t.startswith("df:")}
+        decl_r_all = {t for t in decl_reads.all() if not t.startswith("df:")}
+
+        # 1. undeclared writes: races the scheduler cannot see
+        for tok in sorted(act_writes.all() - decl_w_all):
+            yield ctx.finding(
+                self.id, call,
+                f"node {node_name!r}: callee writes {tok!r} but the "
+                "registration does not declare it — undeclared write, "
+                "potential data race (scheduler derives edges from writes=)",
+            )
+        # 2. stale write declarations: edges that serialize for nothing
+        for tok in sorted({t for t in decl_writes.req if not t.startswith("df:")}
+                          - act_writes.all()):
+            yield ctx.finding(
+                self.id, call,
+                f"node {node_name!r}: declared write {tok!r} has no matching "
+                "effect in the callee — stale declaration (dead WAW/WAR edges)",
+            )
+        # 3. undeclared required reads: missing RAW edges
+        for tok in sorted(act_reads.req - decl_r_all):
+            if ALL in decl_r_all:
+                continue
+            yield ctx.finding(
+                self.id, call,
+                f"node {node_name!r}: callee reads {tok!r} but the "
+                "registration does not declare it — the producer may still "
+                "be running when this node consumes it",
+            )
+        # 4. stale read declarations
+        for tok in sorted({t for t in decl_reads.req if not t.startswith("df:")}
+                          - act_reads.all()):
+            yield ctx.finding(
+                self.id, call,
+                f"node {node_name!r}: declared read {tok!r} has no matching "
+                "access in the callee — stale declaration (dead RAW edge)",
+            )
