@@ -53,8 +53,12 @@ def correlation_matrix(
 
         idf = data_sample(idf, fraction=float(sample_size) / idf.nrows, method_type="random")
     X, M = idf.numeric_block(cols)
-    row_ok = M.all(axis=1, keepdims=True)
-    C = np.asarray(masked_corr(X, M & row_ok))
+    # complete-case over the LIVE lanes only: the block is column-bucketed
+    # (dead lanes mask=False), so `M.all(axis=1)` would veto every row.
+    # The live count rides in as a device scalar, keeping the program
+    # keyed on the bucketed shape rather than recompiling per width.
+    row_ok = (M.sum(axis=1) == jnp.asarray(np.int32(len(cols))))[:, None]
+    C = np.asarray(masked_corr(X, M & row_ok))[: len(cols), : len(cols)]
     odf = pd.DataFrame(C, columns=cols, index=cols)
     odf["attribute"] = odf.index
     ordered = sorted(cols)
@@ -73,8 +77,8 @@ def _grouped_label_counts(idf: Table, col: str, y, ym, nbins_cap: int = 0):
     if c.kind == "cat":
         vsize = max(len(c.vocab), 1)
         m_eff = c.mask & ym & (c.data >= 0)
-        tot = np.asarray(code_label_counts(c.data, m_eff, jnp.ones_like(y), vsize))
-        ev = np.asarray(code_label_counts(c.data, m_eff, y, vsize))
+        tot = np.asarray(code_label_counts(c.data, m_eff, jnp.ones_like(y), vsize))[:vsize]
+        ev = np.asarray(code_label_counts(c.data, m_eff, y, vsize))[:vsize]
         null_m = ym & ~(c.mask & (c.data >= 0))
         null_tot = float(jnp.sum(null_m & (jnp.arange(c.padded_len) < idf.nrows)))
         null_ev = float(jnp.sum(jnp.where(null_m, y, 0.0)))
@@ -92,8 +96,8 @@ def _grouped_label_counts(idf: Table, col: str, y, ym, nbins_cap: int = 0):
         pad = idf.padded_rows - idf.nrows
         codes_d = rt.shard_rows(np.concatenate([code_arr, np.full(pad, -1, np.int32)]))
         m_eff = (codes_d >= 0) & ym
-        tot = np.asarray(code_label_counts(codes_d, m_eff, jnp.ones_like(y), vsize))
-        ev = np.asarray(code_label_counts(codes_d, m_eff, y, vsize))
+        tot = np.asarray(code_label_counts(codes_d, m_eff, jnp.ones_like(y), vsize))[:vsize]
+        ev = np.asarray(code_label_counts(codes_d, m_eff, y, vsize))[:vsize]
         null_m = ym & (codes_d < 0) & (jnp.arange(c.padded_len) < idf.nrows)
         null_tot = float(jnp.sum(null_m))
         null_ev = float(jnp.sum(jnp.where(null_m, y, 0.0)))
@@ -241,16 +245,18 @@ def variable_clustering(
 
         idf = data_sample(idf, fraction=float(sample_size) / idf.nrows, method_type="random")
     sub = idf.select(cols)
-    # drop constant / single-valued columns
-    X = jnp.stack([sub.columns[c].data.astype(jnp.float32) for c in cols], 1)
-    M = jnp.stack(
+    # drop constant / single-valued columns (column-bucketed stack; the
+    # nunique readback is sliced to the live k)
+    from anovos_tpu.shared.table import stack_padded
+
+    X, M = stack_padded(
+        [sub.columns[c].data for c in cols],
         [
             sub.columns[c].mask & ((sub.columns[c].data >= 0) if sub.columns[c].kind == "cat" else True)
             for c in cols
         ],
-        1,
     )
-    nu = np.asarray(masked_nunique(X, M))
+    nu = np.asarray(masked_nunique(X, M))[: len(cols)]
     cols = [c for c, u in zip(cols, nu) if u >= 2]
     sub = sub.select(cols)
     cat_cols = [c for c in cols if sub.columns[c].kind == "cat"]
@@ -258,8 +264,10 @@ def variable_clustering(
         sub = cat_to_num_unsupervised(sub, cat_cols, method_type="label_encoding")
     sub = imputation_MMM(sub, list_of_cols="missing", method_type="mean")
     Xn, Mn = sub.numeric_block(cols)
-    row_ok = Mn.all(axis=1, keepdims=True)
-    C = np.asarray(masked_corr(Xn, Mn & row_ok), dtype=np.float64)
+    # complete-case over live lanes (see correlation_matrix): dead bucketed
+    # lanes are mask=False and must not veto rows
+    row_ok = (Mn.sum(axis=1) == jnp.asarray(np.int32(len(cols))))[:, None]
+    C = np.asarray(masked_corr(Xn, Mn & row_ok), dtype=np.float64)[: len(cols), : len(cols)]
     # harden for eigendecomposition: f32 device numerics can leave NaNs for
     # near-constant columns (zero-variance denominators) and tiny asymmetry;
     # either makes eigh fail to converge.  masked_corr pins the diagonal to

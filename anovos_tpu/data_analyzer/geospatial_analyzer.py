@@ -157,7 +157,7 @@ def _geohash_profile(idf: Table, gh_col: str, max_val: int):
     col = idf.columns[gh_col]
     from anovos_tpu.ops.segment import code_counts
 
-    cnts = np.asarray(code_counts(col.data, col.mask, max(len(col.vocab), 1)))
+    cnts = np.asarray(code_counts(col.data, col.mask, max(len(col.vocab), 1)))[: max(len(col.vocab), 1)]
     order = np.argsort(-cnts)[:max_val] if len(col.vocab) else np.zeros(0, dtype=int)
     decoded = [geohash_decode(str(col.vocab[j])) for j in order]
     top_gh = pd.DataFrame(

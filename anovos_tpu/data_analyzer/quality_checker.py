@@ -54,6 +54,21 @@ def _check_bool(treatment):
     raise TypeError("Non-Boolean input for treatment")
 
 
+@jax.jit
+def _outlier_flags(X, M, lo, hi):
+    """Fused outlier flagging over a (rows, k_pad) block: per-cell flag
+    (−1 below / +1 above / 0 in-range-or-null), per-column outlier counts,
+    and the clean-row mask for row_removal.  Dead bucketed lanes are
+    mask=False → flag 0 everywhere, so both reductions stay exact."""
+    flag = jnp.where(M & (X > hi[None, :]), 1, 0) + jnp.where(M & (X < lo[None, :]), -1, 0)
+    return (
+        flag,
+        (flag == -1).sum(axis=0),
+        (flag == 1).sum(axis=0),
+        (flag == 0).all(axis=1),
+    )
+
+
 def duplicate_detection(
     idf: Table, list_of_cols="all", drop_cols=[], treatment=False, print_impact=False
 ) -> Tuple[Table, pd.DataFrame]:
@@ -77,8 +92,11 @@ def duplicate_detection(
         arrs = _hashable(c)
         hash_arrays.extend(arrs)
         hash_masks.extend([sub.columns[c].mask] * len(arrs))
-    X = jnp.stack(hash_arrays, 1)
-    M = jnp.stack(hash_masks, 1)
+    # column-bucketed stack: dead lanes hash a constant sentinel into every
+    # row, so the collision structure (what dedup compares) is unchanged
+    from anovos_tpu.shared.table import stack_padded
+
+    X, M = stack_padded(hash_arrays, hash_masks, dtype=jnp.int32)
     sig = np.asarray(row_signature(X, M))[: idf.nrows]
     df_sig = pd.DataFrame({"h1": sig[:, 0], "h2": sig[:, 1]})
     # only rows in colliding hash buckets need exact host verification —
@@ -120,8 +138,15 @@ def nullRows_detection(
     treatment_threshold = float(treatment_threshold)
     if not (0 <= treatment_threshold <= 1):
         raise TypeError("Invalid input for Treatment Threshold Value")
-    M = jnp.stack([idf.columns[c].mask for c in cols], 1)
-    null_cnt = np.asarray((~M).sum(axis=1))[: idf.nrows]
+    # column-bucketed mask stack: nulls-per-row counts against the LIVE k
+    # (dead lanes are mask=False and must not count as nulls); the live
+    # count rides in as a device scalar so the program stays width-keyed
+    from anovos_tpu.shared.table import stack_masks_padded
+
+    M = stack_masks_padded([idf.columns[c].mask for c in cols])
+    null_cnt = np.asarray(
+        jnp.asarray(np.int32(len(cols))) - M.sum(axis=1, dtype=jnp.int32)
+    )[: idf.nrows]
     if treatment_threshold == 1:
         flagged = null_cnt == len(cols)
     else:
@@ -198,8 +223,13 @@ def nullColumns_detection(
             if threshold is not None:
                 subset = [c for c in subset if pct.get(c, 0.0) > float(threshold)]
             if subset:
-                M = jnp.stack([idf.columns[c].mask for c in subset], 1)
-                keep = np.asarray(M.all(axis=1))[: idf.nrows]
+                from anovos_tpu.shared.table import stack_masks_padded
+
+                # complete-case over the live lanes of the bucketed stack
+                M = stack_masks_padded([idf.columns[c].mask for c in subset])
+                keep = np.asarray(
+                    M.sum(axis=1, dtype=jnp.int32) == jnp.asarray(np.int32(len(subset)))
+                )[: idf.nrows]
                 odf = idf.filter_rows(keep)
         elif treatment_method == "column_removal":
             if threshold is None:
@@ -318,10 +348,11 @@ def outlier_detection(
         qs = jnp.array(
             [cfg.get("pctile_lower", 0.05), cfg.get("pctile_upper", 0.95), 0.25, 0.75], jnp.float32
         )
-        Q = np.asarray(masked_quantiles(X, M, qs, interpolation="lower"))
+        # slice the column-bucketed kernel outputs back to the live k
+        Q = np.asarray(masked_quantiles(X, M, qs, interpolation="lower"))[:, : len(cols)]
         mom = masked_moments(X, M)
-        mean = np.asarray(mom["mean"], np.float64)
-        std = np.asarray(mom["stddev"], np.float64)
+        mean = np.asarray(mom["mean"], np.float64)[: len(cols)]
+        std = np.asarray(mom["stddev"], np.float64)[: len(cols)]
         p_lo, p_hi, q1, q3 = Q[0], Q[1], Q[2], Q[3]
         skew_mask = p_lo == p_hi
         if skew_mask.any():
@@ -378,11 +409,18 @@ def outlier_detection(
     if not cols:
         return idf, pd.DataFrame(columns=["attribute", "lower_outliers", "upper_outliers"])
     X, M = idf.numeric_block(cols)
-    lo_d = jnp.asarray(lower, jnp.float32)[None, :]
-    hi_d = jnp.asarray(upper, jnp.float32)[None, :]
-    flag = jnp.where(M & (X > hi_d), 1, 0) + jnp.where(M & (X < lo_d), -1, 0)
-    n_lo = np.asarray((flag == -1).sum(axis=0))
-    n_hi = np.asarray((flag == 1).sum(axis=0))
+    # bounds padded to the bucketed lane count (dead lanes are mask=False,
+    # so any pad value yields flag 0 there — including the row_removal
+    # `clean_row` reduction, which stays correct across padding).  One
+    # fused program replaces the eager compare/where/reduce chain that
+    # compiled per width (cold-compile census).
+    from anovos_tpu.shared.table import pad_lane_params
+
+    lo_d = jnp.asarray(pad_lane_params(lower, X.shape[1]), jnp.float32)
+    hi_d = jnp.asarray(pad_lane_params(upper, X.shape[1]), jnp.float32)
+    flag, n_lo_d, n_hi_d, clean_row = _outlier_flags(X, M, lo_d, hi_d)
+    n_lo = np.asarray(n_lo_d)[: len(cols)]
+    n_hi = np.asarray(n_hi_d)[: len(cols)]
     stats = pd.DataFrame(
         {"attribute": cols, "lower_outliers": n_lo, "upper_outliers": n_hi}
     )
@@ -391,7 +429,7 @@ def outlier_detection(
         if treatment_method == "row_removal":
             # null entries have flag 0 by construction, matching the
             # reference's "flag==0 or flag is null" keep condition (:1029-1034)
-            keep = np.asarray((flag == 0).all(axis=1))[: idf.nrows]
+            keep = np.asarray(clean_row)[: idf.nrows]
             odf = idf.filter_rows(keep)
         else:
             from collections import OrderedDict
@@ -403,8 +441,8 @@ def outlier_detection(
                 if treatment_method == "value_replacement":
                     clipped = jnp.clip(
                         x,
-                        lo_d[0, i] if np.isfinite(lower[i]) else -jnp.inf,
-                        hi_d[0, i] if np.isfinite(upper[i]) else jnp.inf,
+                        lo_d[i] if np.isfinite(lower[i]) else -jnp.inf,
+                        hi_d[i] if np.isfinite(upper[i]) else jnp.inf,
                     )
                     new_cols[c] = Column("num", jnp.where(col.mask, clipped, 0.0), col.mask, dtype_name="double")
                 else:  # null_replacement

@@ -64,14 +64,17 @@ def _fill_count(idf: Table, col: str, num_out, cat_out, ni, ci) -> int:
 
 
 def _stacked_valid_mask(idf: Table, cols: List[str]) -> "jnp.ndarray":
-    """(rows, k) validity with categorical null-code semantics — THE null
-    rule, shared by every consumer so it lives in exactly one place."""
-    return jnp.stack(
+    """(rows, k_pad) validity with categorical null-code semantics — THE
+    null rule, shared by every consumer so it lives in exactly one place.
+    Column-bucketed (dead lanes False): per-column reductions slice back to
+    the live ``len(cols)``."""
+    from anovos_tpu.shared.table import stack_masks_padded
+
+    return stack_masks_padded(
         [
             idf.columns[c].mask & ((idf.columns[c].data >= 0) if idf.columns[c].kind == "cat" else True)
             for c in cols
-        ],
-        axis=1,
+        ]
     )
 
 
@@ -89,7 +92,7 @@ def _fill_counts_light(idf: Table, cols: List[str]) -> np.ndarray:
             if all(c in ni or c in ci for c in cols):
                 return np.array([_fill_count(idf, c, num_out, cat_out, ni, ci) for c in cols])
     M = _stacked_valid_mask(idf, cols)
-    return np.asarray(M.sum(axis=0, dtype=jnp.int32)).astype(np.int64)
+    return np.asarray(M.sum(axis=0, dtype=jnp.int32))[: len(cols)].astype(np.int64)
 
 
 def global_summary(idf: Table, list_of_cols="all", drop_cols=[], print_impact=False) -> pd.DataFrame:
@@ -314,9 +317,12 @@ def uniqueCount_computation(
                 return (col.data + 0.0).view(jnp.int32)
             return col.data.astype(jnp.int32)
 
-        X = jnp.stack([_exact_bits(c) for c in cols], 1)
+        from anovos_tpu.shared.table import stack_padded
+
+        X, _ = stack_padded([_exact_bits(c) for c in cols],
+                            [idf.columns[c].mask for c in cols], dtype=jnp.int32)
         M = _stacked_valid_mask(idf, cols)
-        nu = np.round(approx_nunique(X, M, rsd)).astype(np.int64)
+        nu = np.round(np.asarray(approx_nunique(X, M, rsd))[: len(cols)]).astype(np.int64)
     else:
         num_out, cat_out, ni, ci = _desc(idf)
         nu = np.array(

@@ -89,15 +89,21 @@ _DAYPART_NAMES = ["late_hours", "early_hours", "work_hours", "evening_hours", "n
 _DOW_NAMES = ["Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun"]
 
 
-def _grain_buckets(tcol, grain: str):
-    """Device bucket ids + host labels for hourly (daypart) / weekly (dow)."""
+@functools.partial(jax.jit, static_argnames=("grain",))
+def _grain_ids(tdata, grain: str):
+    """One fused program per grain: the eager clip/gather/shift chain here
+    compiled ~7 programs per run (cold-compile census)."""
     from anovos_tpu.ops import datetime_kernels as dk
 
     if grain == "hourly":
-        hour = dk.extract_unit(tcol.data, "hour")
-        return jnp.asarray(_DAYPART_LUT)[jnp.clip(hour, 0, 23)], _DAYPART_NAMES
-    dow = dk.extract_unit(tcol.data, "dayofweek") - 1  # Mon=0
-    return jnp.clip(dow, 0, 6), _DOW_NAMES
+        hour = dk.extract_unit(tdata, "hour")
+        return jnp.asarray(_DAYPART_LUT)[jnp.clip(hour, 0, 23)]
+    return jnp.clip(dk.extract_unit(tdata, "dayofweek") - 1, 0, 6)  # Mon=0
+
+
+def _grain_buckets(tcol, grain: str):
+    """Device bucket ids + host labels for hourly (daypart) / weekly (dow)."""
+    return _grain_ids(tcol.data, grain), (_DAYPART_NAMES if grain == "hourly" else _DOW_NAMES)
 
 
 def _num_viz_small_grain(idf: Table, ts_col: str, num_cols: List[str], grain: str) -> pd.DataFrame:

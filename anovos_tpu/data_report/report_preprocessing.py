@@ -25,7 +25,7 @@ import pandas as pd
 from anovos_tpu.ops.drift_kernels import binned_histograms, fit_cutoffs
 from anovos_tpu.ops.quantiles import masked_quantiles
 from anovos_tpu.ops.segment import code_counts
-from anovos_tpu.shared.table import Table
+from anovos_tpu.shared.table import Table, pad_lane_params
 from anovos_tpu.shared.utils import ends_with, parse_cols
 
 global_theme = "#8000ff"
@@ -115,6 +115,27 @@ def _write_json(fig: dict, path: str) -> None:
         json.dump(fig, f)
 
 
+import functools as _functools
+
+import jax as _jax
+
+
+@_functools.partial(_jax.jit, static_argnames=("nbins",))
+def _binned_label_counts(X, M, cutoffs, ym, y, nbins):
+    """Per-column (tot, event) bin counts for the event-rate charts, fused:
+    digitize against the (k_pad, nb-1) cutoffs + label-masked bincounts in
+    ONE program (dead bucketed lanes are mask=False → zero rows)."""
+    from anovos_tpu.ops.drift_kernels import compare_digitize
+    from anovos_tpu.ops.histogram import masked_bincount
+
+    bins = compare_digitize(X, cutoffs)
+    Mv = M & ym[:, None]
+    return (
+        masked_bincount(bins, Mv, nbins),
+        masked_bincount(bins, Mv & (y[:, None] > 0), nbins),
+    )
+
+
 _BIN_RANGE = re.compile(r"^(-?\d+(?:\.\d+)?)-(-?\d+(?:\.\d+)?)$")
 
 
@@ -166,7 +187,7 @@ def plot_frequency(idf: Table, col: str, cutoffs_path: Optional[str] = None, bin
     c = idf.columns[col]
     if c.kind == "cat":
         vsize = max(len(c.vocab), 1)
-        cnts = np.asarray(code_counts(c.data, c.mask, vsize))
+        cnts = np.asarray(code_counts(c.data, c.mask, vsize))[:vsize]
         order = np.argsort(-cnts)
         return _bar_fig(
             [str(c.vocab[j]) for j in order if cnts[j] > 0],
@@ -226,8 +247,8 @@ def plot_eventRate(
 
         vsize = max(len(c.vocab), 1)
         m_eff = c.mask & ym
-        tot = np.asarray(code_label_counts(c.data, m_eff, jnp.ones_like(y), vsize))
-        evs = np.asarray(code_label_counts(c.data, m_eff, y, vsize))
+        tot = np.asarray(code_label_counts(c.data, m_eff, jnp.ones_like(y), vsize))[:vsize]
+        evs = np.asarray(code_label_counts(c.data, m_eff, y, vsize))[:vsize]
         with np.errstate(invalid="ignore", divide="ignore"):
             rate = np.where(tot > 0, evs / np.maximum(tot, 1), 0.0)
         order = np.argsort(-tot)
@@ -349,31 +370,28 @@ def charts_to_objects(
         cut_map = _load_cut_map(drift_model_dir)
         fit_cols = [c for c in num_cols if c not in cut_map]
         if fit_cols:
+            # column-bucketed fit (dead lanes all-NaN); zip() truncates the
+            # readback to the live fit_cols
+            from anovos_tpu.drift_stability.drift_detector import _padded_col_tuples
+
             cuts = np.asarray(
-                fit_cutoffs(
-                    tuple(idf.columns[c].data for c in fit_cols),
-                    tuple(idf.columns[c].mask for c in fit_cols),
-                    bin_size,
-                    bin_method,
-                )
+                fit_cutoffs(*_padded_col_tuples(idf, fit_cols), bin_size, bin_method)
             )
             for c, row in zip(fit_cols, cuts):
                 cut_map[c] = row
-        cutoffs = np.stack([cut_map[c] for c in num_cols])
         X, M = idf.numeric_block(num_cols)
+        # cutoff rows padded to the block's bucketed lane count (dead-lane
+        # histogram rows are all-masked zeros, never indexed below)
+        cutoffs = pad_lane_params(np.stack([cut_map[c] for c in num_cols]), X.shape[1])
         counts = np.asarray(binned_histograms(X, M, jnp.asarray(cutoffs, jnp.float32), bin_size))
         ev_counts = None
         if y is not None:
-            from anovos_tpu.ops.histogram import masked_bincount
-            from anovos_tpu.ops.drift_kernels import compare_digitize
-
-            bins = compare_digitize(X, jnp.asarray(cutoffs, jnp.float32))
-            Mv = M & ym[:, None]
-            tot = np.asarray(masked_bincount(bins, Mv, bin_size))
-            evs = np.asarray(
-                masked_bincount(bins, Mv & (y[:, None] > 0), bin_size)
+            # one fused program: the eager digitize → mask-combine →
+            # two-bincount chain compiled ~5 programs per width here
+            tot_d, evs_d = _binned_label_counts(
+                X, M, jnp.asarray(cutoffs, jnp.float32), ym, y, bin_size
             )
-            ev_counts = (tot, evs)
+            ev_counts = (np.asarray(tot_d), np.asarray(evs_d))
         for i, c in enumerate(num_cols):
             labels = [f"{j + 1}" for j in range(bin_size)]
             _emit(_bar_fig(labels, counts[i].tolist(), c), ends_with(master_path) + "freqDist_" + c)
@@ -404,7 +422,7 @@ def charts_to_objects(
     for c in cat_cols:
         col = idf.columns[c]
         vsize = max(len(col.vocab), 1)
-        cnts = np.asarray(code_counts(col.data, col.mask, vsize))
+        cnts = np.asarray(code_counts(col.data, col.mask, vsize))[:vsize]
         order = np.argsort(-cnts)
         cats = [str(col.vocab[j]) for j in order if cnts[j] > 0]
         vals = [float(cnts[j]) for j in order if cnts[j] > 0]
@@ -413,8 +431,8 @@ def charts_to_objects(
             from anovos_tpu.ops.segment import code_label_counts
 
             m_eff = col.mask & ym
-            tot = np.asarray(code_label_counts(col.data, m_eff, jnp.ones_like(y), vsize))
-            evs = np.asarray(code_label_counts(col.data, m_eff, y, vsize))
+            tot = np.asarray(code_label_counts(col.data, m_eff, jnp.ones_like(y), vsize))[:vsize]
+            evs = np.asarray(code_label_counts(col.data, m_eff, y, vsize))[:vsize]
             with np.errstate(invalid="ignore", divide="ignore"):
                 rate = np.where(tot > 0, evs / np.maximum(tot, 1), 0.0)
             _emit(

@@ -623,9 +623,26 @@ def _segment_aggregate(ids0: jax.Array, valid: jax.Array, V: jax.Array, Mv: jax.
     cumulative-count indexed gathers — one program, no host loop.  On a
     multi-device mesh the block is re-laid column-parallel (each device
     lexsorts whole columns locally; ids/validity replicate) — see
-    runtime.column_parallel."""
+    runtime.column_parallel.
+
+    The static segment count is bucketed into 2^k classes (min 8 —
+    ops/segment.py ``bucket_segments_pow2``): a daypart sweep
+    (nseg 5), a weekday sweep (7) and a small date span then share one
+    compiled program per (rows, k) shape.  The returned arrays keep the
+    padded ``(k, nseg_pad)`` width — dead buckets count zero rows, and
+    every consumer either loops over its own label list or filters
+    ``cnt > 0``, so the extra buckets are never read."""
+    import os as _os
+
     from anovos_tpu.shared.runtime import wants_column_parallel
 
+    if _os.environ.get("ANOVOS_SHAPE_BUCKETS", "1") != "0":
+        # 2^k classes (shared bucket_segments_pow2 — NOT the coarse vocab
+        # classes): the output is six (k, nseg) arrays, so over-padding a
+        # wide date span costs real memory, while 2× stays trivial
+        from anovos_tpu.ops.segment import bucket_segments_pow2
+
+        nseg = bucket_segments_pow2(nseg)
     return _segment_aggregate_jit(
         ids0, valid, V, Mv, nseg,
         cp=wants_column_parallel(ids0, valid, V, Mv, replicate=(ids0, valid)),

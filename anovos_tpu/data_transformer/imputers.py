@@ -51,8 +51,11 @@ def _missing_num_cols(idf: Table, list_of_cols, drop_cols, stats_missing: dict) 
             miss = read_dataset(**stats_missing).to_pandas()
             cand = list(miss.loc[miss["missing_count"].astype(float) > 0, "attribute"])
         else:
-            M = jnp.stack([idf.columns[c].mask for c in num_all], 1) if num_all else None
-            fill = np.asarray(M.sum(axis=0)) if num_all else np.array([])
+            from anovos_tpu.ops.reductions import masked_count
+            from anovos_tpu.shared.table import stack_masks_padded
+
+            M = stack_masks_padded([idf.columns[c].mask for c in num_all]) if num_all else None
+            fill = np.asarray(masked_count(M)) if num_all else np.array([])
             cand = [c for c, f in zip(num_all, fill) if f < idf.nrows]
         cols = [c for c in cand if c in num_all]
     elif list_of_cols == "all":
@@ -118,7 +121,11 @@ def imputation_sklearn(
     num_all, _, _ = idf.attribute_type_segregation()
     feat_cols = list(dict.fromkeys(num_all))
     tgt_idx = np.array([feat_cols.index(c) for c in cols])
-    X, M = idf.numeric_block(feat_cols)
+    # pad_cols=False: the feature count is MODEL SEMANTICS here — the KNN
+    # nan-euclidean scale is k/|overlap|, the ridge sweep solves a (k, k)
+    # system whose dead lanes would carry NaN means, and the persisted model
+    # npz must hold exactly the live features
+    X, M = idf.numeric_block(feat_cols, pad_cols=False)
 
     # model artifacts route through the run_type artifact store (reference
     # transformers.py:1886-1950 shuttles its pickles with aws/azcopy)
@@ -136,7 +143,7 @@ def imputation_sklearn(
         feat_cols = [str(c) for c in blob["feat_cols"]]
         cols = [c for c in cols if c in feat_cols]
         tgt_idx = np.array([feat_cols.index(c) for c in cols])
-        X, M = idf.numeric_block(feat_cols)
+        X, M = idf.numeric_block(feat_cols, pad_cols=False)
         if method_type == "KNN":
             Xs = jnp.asarray(blob["Xs"])
             Ms = jnp.asarray(blob["Ms"])
@@ -256,7 +263,9 @@ def imputation_matrixFactorization(
     num_all, _, _ = idf.attribute_type_segregation()
     feat_cols = [c for c in num_all if c != id_col]
     tgt_idx = jnp.asarray(np.array([feat_cols.index(c) for c in cols]))
-    X, M = idf.numeric_block(feat_cols)
+    # pad_cols=False: the block IS the ratings matrix — ALS rank derives
+    # from the feature count and dead lanes would skew the factorization
+    X, M = idf.numeric_block(feat_cols, pad_cols=False)
     # standardize per column so ALS regularization is scale-free, then undo
     mom = masked_moments(X, M)
     mean = mom["mean"]

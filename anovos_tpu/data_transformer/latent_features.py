@@ -10,6 +10,7 @@ the same explained-variance-cutoff k selection (ref :3121-3137).
 
 from __future__ import annotations
 
+import functools
 import logging
 
 import os
@@ -34,18 +35,29 @@ logger = logging.getLogger(__name__)
 
 def _prep_block(idf: Table, cols: List[str], standardization: bool, imputation: bool):
     """Common preamble (reference :2560-2780): impute missing with median,
-    z-standardize.  Returns (X, stats) with X fully dense."""
-    X, M = idf.numeric_block(cols)
+    z-standardize.  Returns (X, stats) with X fully dense.
+
+    pad_cols=False: the block width IS the autoencoder's input dimension —
+    bucketed dead lanes would change the model architecture (and the
+    persisted weights), not just the batch shape."""
+    X, M = idf.numeric_block(cols, pad_cols=False)
     mom = masked_moments(X, M)
-    mean = mom["mean"]
-    std = jnp.where(mom["stddev"] > 0, mom["stddev"], 1.0)
     if imputation:
         from anovos_tpu.ops.quantiles import masked_median
 
         fill = masked_median(X, M)
-        Xd = jnp.where(M, X, fill[None, :])
     else:
-        Xd = jnp.where(M, X, mean[None, :])
+        fill = mom["mean"]
+    Xd, mean, std = _prep_dense(X, M, mom["mean"], mom["stddev"], fill, standardization)
+    return Xd, mean, std
+
+
+@functools.partial(jax.jit, static_argnames=("standardization",))
+def _prep_dense(X, M, mean, stddev, fill, standardization):
+    """Fused dense-fill + standardize (the eager where/affine chain here
+    compiled one program per step per AE width — cold-compile census)."""
+    std = jnp.where(stddev > 0, stddev, 1.0)
+    Xd = jnp.where(M, X, fill[None, :])
     if standardization:
         Xd = (Xd - mean[None, :]) / std[None, :]
     return Xd, mean, std

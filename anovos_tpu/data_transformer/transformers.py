@@ -30,7 +30,7 @@ from anovos_tpu.ops.quantiles import masked_quantiles
 from anovos_tpu.ops.reductions import masked_moments
 from anovos_tpu.ops.segment import code_counts, code_label_counts, masked_nunique
 from anovos_tpu.shared.runtime import get_runtime
-from anovos_tpu.shared.table import Column, Table
+from anovos_tpu.shared.table import Column, Table, pad_lane_params
 from anovos_tpu.shared.utils import parse_cols
 
 logger = logging.getLogger(__name__)
@@ -135,13 +135,16 @@ def attribute_binning(
             if X.size > int(os.environ.get("ANOVOS_EXACT_QUANTILE_CELLS", 64_000_000)):
                 from anovos_tpu.ops.quantiles import histogram_quantiles
 
-                cutoffs = np.asarray(histogram_quantiles(X, M, qs)).T.astype(np.float64)
+                cutoffs = np.asarray(histogram_quantiles(X, M, qs))[:, : len(cols)].T.astype(np.float64)
             else:
-                cutoffs = np.asarray(masked_quantiles(X, M, qs, interpolation="lower")).T  # (k, B-1)
+                # (k, B-1) — sliced to the live k of the column-bucketed block
+                cutoffs = np.asarray(
+                    masked_quantiles(X, M, qs, interpolation="lower")
+                )[:, : len(cols)].T
         else:
             mom = masked_moments(X, M)
-            lo = np.asarray(mom["min"], dtype=np.float64)
-            hi = np.asarray(mom["max"], dtype=np.float64)
+            lo = np.asarray(mom["min"], dtype=np.float64)[: len(cols)]
+            hi = np.asarray(mom["max"], dtype=np.float64)[: len(cols)]
             keep = ~np.isnan(lo)
             if not keep.all():
                 dropped = [c for c, k in zip(cols, keep) if not k]
@@ -161,11 +164,13 @@ def attribute_binning(
 
     X, M = idf.numeric_block(cols)
     nb = cutoffs.shape[1] + 1
-    # digitize expects (k, nb+1) edges with sentinels; interior cutoffs only matter
+    # digitize expects (k, nb+1) edges with sentinels; interior cutoffs only
+    # matter.  Edges are padded to the bucketed lane count (dead-lane bins
+    # are never read — every consumer below indexes bins0[:, i] for live i).
     edges = np.concatenate(
         [np.full((len(cols), 1), -np.inf), cutoffs, np.full((len(cols), 1), np.inf)], axis=1
     )
-    bins0 = digitize(X, jnp.asarray(edges, jnp.float32))  # 0-indexed
+    bins0 = digitize(X, jnp.asarray(pad_lane_params(edges, X.shape[1]), jnp.float32))  # 0-indexed
     new_cols: "OrderedDict[str, Column]" = OrderedDict()
     if bin_dtype == "numerical":
         data = (bins0 + 1).astype(jnp.int32)
@@ -326,7 +331,7 @@ def cat_to_num_unsupervised(
         for c in cols:
             col = idf.columns[c]
             vsize = max(len(col.vocab), 1)
-            cnts = np.asarray(code_counts(col.data, col.mask, vsize))
+            cnts = np.asarray(code_counts(col.data, col.mask, vsize))[:vsize]
             if index_order == "frequencyDesc":
                 order = np.lexsort((np.arange(vsize), -cnts))
             elif index_order == "frequencyAsc":
@@ -409,8 +414,8 @@ def cat_to_num_supervised(
             rates = np.array([rate_map.get(str(v), np.nan) for v in col.vocab], dtype=np.float32)
         else:
             m_eff = col.mask & ym
-            tot = np.asarray(code_counts(col.data, m_eff, vsize))
-            ev = np.asarray(code_label_counts(col.data, m_eff, y, vsize))
+            tot = np.asarray(code_counts(col.data, m_eff, vsize))[:vsize]
+            ev = np.asarray(code_label_counts(col.data, m_eff, y, vsize))[:vsize]
             with np.errstate(divide="ignore", invalid="ignore"):
                 rates = np.round(ev / np.maximum(tot, 1e-30), 4).astype(np.float32)
             rates[tot == 0] = np.nan
@@ -459,8 +464,8 @@ def z_standardization(
     else:
         X, M = idf.numeric_block(cols)
         mom = masked_moments(X, M)
-        mean = np.asarray(mom["mean"], np.float32)
-        std = np.asarray(mom["stddev"], np.float32)
+        mean = np.asarray(mom["mean"], np.float32)[: len(cols)]
+        std = np.asarray(mom["stddev"], np.float32)[: len(cols)]
         if model_path != "NA":
             save_model_df(
                 pd.DataFrame({"attribute": cols, "mean": mean.astype(float), "stddev": std.astype(float)}),
@@ -476,7 +481,10 @@ def z_standardization(
     if not cols:
         return idf
     X, M = idf.numeric_block(cols)
-    Z = (X - jnp.asarray(mean)[None, :]) / jnp.asarray(std)[None, :]
+    # params padded to the bucketed lane count (σ=1 keeps dead lanes finite)
+    mean_p = pad_lane_params(mean, X.shape[1])
+    std_p = pad_lane_params(std, X.shape[1], fill=1.0)
+    Z = (X - jnp.asarray(mean_p)[None, :]) / jnp.asarray(std_p)[None, :]
     new_cols = OrderedDict(
         (c, Column("num", Z[:, i].astype(jnp.float32), idf.columns[c].mask, dtype_name="double"))
         for i, c in enumerate(cols)
@@ -511,7 +519,7 @@ def IQR_standardization(
         X, M = idf.numeric_block(cols)
         q = np.asarray(
             masked_quantiles(X, M, jnp.array([0.25, 0.5, 0.75], jnp.float32), interpolation="lower")
-        )
+        )[:, : len(cols)]
         med = q[1].astype(np.float32)
         iqr = (q[2] - q[0]).astype(np.float32)
         if model_path != "NA":
@@ -529,7 +537,9 @@ def IQR_standardization(
     if not cols:
         return idf
     X, M = idf.numeric_block(cols)
-    Z = (X - jnp.asarray(med)[None, :]) / jnp.asarray(iqr)[None, :]
+    med_p = pad_lane_params(med, X.shape[1])
+    iqr_p = pad_lane_params(iqr, X.shape[1], fill=1.0)
+    Z = (X - jnp.asarray(med_p)[None, :]) / jnp.asarray(iqr_p)[None, :]
     new_cols = OrderedDict(
         (c, Column("num", Z[:, i].astype(jnp.float32), idf.columns[c].mask, dtype_name="double"))
         for i, c in enumerate(cols)
@@ -564,8 +574,8 @@ def normalization(
     else:
         X, M = idf.numeric_block(cols)
         mom = masked_moments(X, M)
-        lo = np.asarray(mom["min"], np.float32)
-        hi = np.asarray(mom["max"], np.float32)
+        lo = np.asarray(mom["min"], np.float32)[: len(cols)]
+        hi = np.asarray(mom["max"], np.float32)[: len(cols)]
         if model_path != "NA":
             save_model_df(
                 pd.DataFrame({"attribute": cols, "min": lo.astype(float), "max": hi.astype(float)}),
@@ -581,7 +591,9 @@ def normalization(
     if not cols:
         return idf
     X, M = idf.numeric_block(cols)
-    Z = (X - jnp.asarray(lo)[None, :]) / jnp.asarray(hi - lo)[None, :]
+    lo_p = pad_lane_params(lo, X.shape[1])
+    rng_p = pad_lane_params(hi - lo, X.shape[1], fill=1.0)
+    Z = (X - jnp.asarray(lo_p)[None, :]) / jnp.asarray(rng_p)[None, :]
     new_cols = OrderedDict(
         (c, Column("num", Z[:, i].astype(jnp.float32), idf.columns[c].mask, dtype_name="double"))
         for i, c in enumerate(cols)
@@ -620,8 +632,11 @@ def imputation_MMM(
             miss = read_dataset(**stats_missing).to_pandas()
             cols = list(miss.loc[miss["missing_count"] > 0, "attribute"])
         else:
-            M = jnp.stack([idf.columns[c].mask for c in idf.col_names], 1)
-            fill = np.asarray(M.sum(axis=0))
+            from anovos_tpu.ops.reductions import masked_count
+            from anovos_tpu.shared.table import stack_masks_padded
+
+            M = stack_masks_padded([idf.columns[c].mask for c in idf.col_names])
+            fill = np.asarray(masked_count(M))  # zip() truncates the dead lanes
             cols = [c for c, f in zip(idf.col_names, fill) if f < idf.nrows]
     else:
         cols = parse_cols(list_of_cols, idf.col_names, [])
@@ -650,7 +665,7 @@ def imputation_MMM(
                 fills[c] = ("num", float(v))
         for c in cat_cols:
             col = idf.columns[c]
-            cnts = np.asarray(code_counts(col.data, col.mask, max(len(col.vocab), 1)))
+            cnts = np.asarray(code_counts(col.data, col.mask, max(len(col.vocab), 1)))[: max(len(col.vocab), 1)]
             fills[c] = ("cat", str(col.vocab[int(np.argmax(cnts))]) if len(col.vocab) and cnts.max() > 0 else None)
         if model_path != "NA":
             save_model_df(
@@ -834,11 +849,12 @@ def boxcox_transformation(
             # selected λ is the one actually emitted
             Y = jnp.log(X) if lmb == 0.0 else jnp.sign(X) * jnp.abs(X) ** lmb
             ok = M & jnp.isfinite(Y)
-            ks = np.asarray(_ks_vs_normal(jnp.where(ok, Y, 0.0), ok))
+            ks = np.asarray(_ks_vs_normal(jnp.where(ok, Y, 0.0), ok))[: len(cols)]
             better = ks < best_ks
             lam = np.where(better, lmb, lam)
             best_ks = np.where(better, ks, best_ks)
-    lam_d = jnp.asarray(lam, jnp.float32)[None, :]
+    # λ=1 (identity) on the dead bucketed lanes keeps them finite
+    lam_d = jnp.asarray(pad_lane_params(lam, X.shape[1], fill=1.0), jnp.float32)[None, :]
     Y = jnp.where(lam_d == 0.0, jnp.log(X), jnp.sign(X) * jnp.abs(X) ** lam_d)
     ok = M & jnp.isfinite(Y)
     new_cols = OrderedDict(
@@ -882,7 +898,7 @@ def outlier_categories(
         for c in cols:
             col = idf.columns[c]
             vsize = max(len(col.vocab), 1)
-            cnts = np.asarray(code_counts(col.data, col.mask, vsize))
+            cnts = np.asarray(code_counts(col.data, col.mask, vsize))[:vsize]
             order = np.lexsort((np.arange(vsize), -cnts))
             sorted_cnts = cnts[order]
             pct = sorted_cnts / max(sorted_cnts.sum(), 1)
@@ -905,9 +921,13 @@ def outlier_categories(
         code_map = np.array(
             [lk.get(str(v), out_code) for v in col.vocab] or [out_code], dtype=np.int32
         )
-        data = jnp.where(
-            col.data >= 0, jnp.asarray(code_map)[jnp.clip(col.data, 0, len(code_map) - 1)], -1
-        )
+        # vocab_lookup pads the LUT to a 2^k class: every column's remap
+        # replays ONE compiled gather per row shape instead of one per
+        # vocab size (the eager per-column indexing compiled a gather
+        # program per column here — cold-compile census)
+        from anovos_tpu.ops.segment import vocab_lookup
+
+        data = jnp.where(col.data >= 0, vocab_lookup(code_map, col.data), -1)
         new_cols[c] = Column("cat", data.astype(jnp.int32), col.mask, vocab=new_vocab, dtype_name="string")
     odf = _emit(idf, new_cols, output_mode, "_outliered")
     if print_impact:

@@ -144,7 +144,12 @@ def statistics(
         else:
             cuts_d = _fit_cutoffs_dev(idf_source, num_cols, bin_size, bin_method)
             if not pipeline_ok:
-                cutoffs, num_cols_eff, _ = _drop_allnan_cutoffs(np.asarray(cuts_d), num_cols)
+                # slice the column-bucketed fit back to the live columns
+                # BEFORE the all-NaN drop — the dead lanes are all-NaN by
+                # construction and must not masquerade as dropped columns
+                cutoffs, num_cols_eff, _ = _drop_allnan_cutoffs(
+                    np.asarray(cuts_d)[: len(num_cols)], num_cols
+                )
 
     # ---- union vocabularies for categorical columns -----------------------
     union_vocabs: Dict[str, np.ndarray] = {}
@@ -195,9 +200,12 @@ def statistics(
         cutoffs, (tgt_num, tgt_cat), (src_num, src_cat) = jax.device_get(
             (cuts_dev, tgt_pair, src_pair)
         )
-        cutoffs, num_cols_eff, keep = _drop_allnan_cutoffs(cutoffs, num_cols_eff)
-        tgt_num = tgt_num[keep]
-        src_num = src_num[keep]
+        # live-column slice first (column-bucketed dead lanes are all-NaN
+        # cutoffs + all-zero histogram rows), then the real all-null drop
+        k_live = len(num_cols_eff)
+        cutoffs, num_cols_eff, keep = _drop_allnan_cutoffs(cutoffs[:k_live], num_cols_eff)
+        tgt_num = tgt_num[:k_live][keep]
+        src_num = src_num[:k_live][keep]
     else:
         tgt_num, tgt_cat = side(idf_target)
         if not pre_existing_source:
@@ -258,16 +266,36 @@ def statistics(
     return odf
 
 
+def _padded_col_tuples(idf: Table, cols: List[str]):
+    """(datas, masks) tuples extended to the column-bucketed lane count.
+
+    The drift programs stack raw column tuples INSIDE the jit, so the tuple
+    arity is the program key — extending it to ``Runtime.pad_cols`` makes
+    nearby column counts share one compiled side program, the same contract
+    as ``Table.numeric_block``.  Dead lanes reuse the first column's data
+    array (free — no new device buffer) under an all-False mask, so every
+    histogram count in those lanes is zero; host consumers slice back to
+    the live k.
+    """
+    from anovos_tpu.shared.runtime import get_runtime
+
+    datas = [idf.columns[c].data for c in cols]
+    masks = [idf.columns[c].mask for c in cols]
+    k_pad = get_runtime().pad_cols(len(cols))
+    if datas and k_pad > len(datas):
+        dead = jnp.zeros_like(masks[0])
+        datas.extend([datas[0]] * (k_pad - len(cols)))
+        masks.extend([dead] * (k_pad - len(cols)))
+    return tuple(datas), tuple(masks)
+
+
 def _fit_cutoffs_dev(idf_source: Table, num_cols: List[str], bin_size: int, bin_method: str):
-    """Device cutoff fit over the source side's column arrays (one kernel)."""
+    """Device cutoff fit over the source side's column arrays (one kernel).
+    Column-bucketed: dead lanes fit all-null cutoffs (NaN rows, sliced off
+    by the caller before ``_drop_allnan_cutoffs``)."""
     from anovos_tpu.ops.drift_kernels import fit_cutoffs
 
-    return fit_cutoffs(
-        tuple(idf_source.columns[c].data for c in num_cols),
-        tuple(idf_source.columns[c].mask for c in num_cols),
-        bin_size,
-        bin_method,
-    )
+    return fit_cutoffs(*_padded_col_tuples(idf_source, num_cols), bin_size, bin_method)
 
 
 def _union_vocabs_for(idf_source: Table, idf_target: Table, cat_cols: List[str]):
@@ -285,10 +313,19 @@ def _union_vocabs_for(idf_source: Table, idf_target: Table, cat_cols: List[str])
 
 
 def _lut_for(idf: Table, cat_cols: List[str], union_vocabs: Dict[str, np.ndarray]):
-    """(k, maxv) LUT mapping each column's LOCAL codes to union indices."""
+    """(k, maxv) LUT mapping each column's LOCAL codes to union indices.
+
+    ``maxv`` is bucketed to a 2^k size class (``bucket_segments_pow2`` —
+    NOT the coarse {16, 256, …} vocab classes, because the LUT is a real
+    (k, maxv) matrix whose dead lanes cost bytes): the two dataset sides
+    usually differ only in their max local vocab size, and an unbucketed
+    maxv made each side compile its own ``drift_side_full`` program."""
+    from anovos_tpu.ops.segment import bucket_segments_pow2
+
     if not cat_cols:
         return jnp.zeros((0, 1), jnp.int32)
     maxv = max(max(len(idf.columns[c].vocab), 1) for c in cat_cols)
+    maxv = bucket_segments_pow2(maxv)
     luts = np.zeros((len(cat_cols), maxv), np.int32)
     for j, c in enumerate(cat_cols):
         pos = {v: i for i, v in enumerate(union_vocabs[c])}
@@ -308,13 +345,30 @@ def _side_args(
 ):
     """The exact ``drift_side_full`` argument tuple ``statistics`` dispatches
     for one dataset side — shared with ``drift_device_args`` so the
-    steady-state benchmark times the production program, not a copy."""
+    steady-state benchmark times the production program, not a copy.
+
+    Column-bucketed (``_padded_col_tuples``): both tuple families are
+    extended to their lane classes, the cutoff matrix rows pad with NaN and
+    the LUT rows with zeros — dead lanes produce all-zero histogram rows
+    which the metric assembly never reads (it indexes the live columns)."""
+    num_datas, num_masks = _padded_col_tuples(idf, num_cols)
+    cat_datas, cat_masks = _padded_col_tuples(idf, cat_cols)
+    k_num_pad = len(num_datas)
+    if num_cols and k_num_pad > int(cuts_dev.shape[0]):
+        cuts_dev = jnp.pad(
+            cuts_dev.astype(jnp.float32),
+            ((0, k_num_pad - int(cuts_dev.shape[0])), (0, 0)),
+            constant_values=jnp.nan,
+        )
+    k_cat_pad = len(cat_datas)
+    if cat_cols and k_cat_pad > int(lut.shape[0]):
+        lut = jnp.pad(lut, ((0, k_cat_pad - int(lut.shape[0])), (0, 0)))
     return (
-        tuple(idf.columns[c].data for c in num_cols),
-        tuple(idf.columns[c].mask for c in num_cols),
+        num_datas,
+        num_masks,
         cuts_dev,
-        tuple(idf.columns[c].data for c in cat_cols),
-        tuple(idf.columns[c].mask for c in cat_cols),
+        cat_datas,
+        cat_masks,
         lut,
         bin_size,
         max(n_union, 1),

@@ -18,11 +18,16 @@ Three cooperating, stdlib-only pieces:
   executor mode, critical path, per-node spans, metrics snapshot);
   ``bench.py`` / ``perf_report.py`` and the HTML report read it instead of
   re-deriving timings.
+* **Compile census** (``obs.compile_census``): a ``jax.monitoring``
+  listener counting every real XLA backend compile with per-program
+  attribution; the per-run delta lands in the manifest and
+  ``tools/compile_census.py`` renders / CI-gates it.
 
 Recording is always on at negligible cost; trace-file export is gated by
 ``ANOVOS_TPU_TRACE=<path|1>``.
 """
 
+from anovos_tpu.obs import compile_census
 from anovos_tpu.obs.manifest import (
     MANIFEST_VERSION,
     build_manifest,
@@ -50,6 +55,7 @@ from anovos_tpu.obs.tracing import (
 )
 
 __all__ = [
+    "compile_census",
     "MANIFEST_VERSION",
     "build_manifest",
     "config_hash",

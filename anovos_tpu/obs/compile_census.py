@@ -1,0 +1,136 @@
+"""Process-wide XLA compile census.
+
+The cold-run wall of the pipeline is compile-bound, not compute-bound
+(PERF.md: 20.8 s of a 32.4 s cold configs_full spent in XLA compiles), and
+the ``timed()`` first-call probes only see the ops they decorate.  This
+module listens to JAX's own monitoring stream — every
+``/jax/core/compile/backend_compile_duration`` event is one real backend
+compile — and attributes each event to its program:
+
+* **name**: the pjit program name (``jit(_masked_quantiles)``), sniffed
+  from the ``_cached_compilation`` frame on the listener's stack.  Two
+  compiles of the same kernel at different shapes share a name — the
+  column-count shape variants the census exists to expose.
+* **fingerprint**: sha1 of the lowered MLIR module text — the true program
+  signature.  ``distinct_programs`` counts unique fingerprints, so a
+  recompile of an identical program (cache eviction, donation variants)
+  does not inflate it.
+
+Never raises: if the JAX internals move, attribution degrades to
+``<unknown>`` names and per-event fingerprints (every compile counts as
+distinct — the safe error direction for a regression gate).
+
+Wire-up: :func:`install` is idempotent and called from
+``runtime.init_runtime`` (so any entry point that touches the device mesh
+is covered) and again from ``workflow.main``.  ``workflow.main`` stamps
+:func:`mark` at run start and embeds :func:`census` (the delta) in the run
+manifest; ``tools/compile_census.py`` renders it and gates CI.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import sys
+import threading
+from typing import List, Optional, Tuple
+
+from anovos_tpu.obs.metrics import get_metrics
+
+__all__ = ["install", "mark", "census", "COMPILE_EVENT"]
+
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_LOCK = threading.Lock()
+_EVENTS: List[Tuple[str, str, float]] = []  # (name, fingerprint, seconds)
+_INSTALLED = False
+
+
+def _sniff_program() -> Tuple[str, Optional[str]]:
+    """(program name, module-text fingerprint) from the compile call stack."""
+    name, fp = "<unknown>", None
+    try:
+        f = sys._getframe(2)
+        while f is not None:
+            if f.f_code.co_name == "_cached_compilation":
+                n = f.f_locals.get("name")
+                if n is not None:
+                    name = str(n)
+                comp = f.f_locals.get("computation")
+                if comp is not None:
+                    fp = hashlib.sha1(str(comp).encode()).hexdigest()[:16]
+                break
+            f = f.f_back
+    except Exception:
+        pass
+    return name, fp
+
+
+def _listener(event: str, duration_secs: float, **_kw) -> None:
+    if event != COMPILE_EVENT:
+        return
+    try:
+        name, fp = _sniff_program()
+        with _LOCK:
+            if fp is None:
+                fp = f"<event-{len(_EVENTS)}>"  # degrade: every compile distinct
+            _EVENTS.append((name, fp, float(duration_secs)))
+        reg = get_metrics()
+        reg.counter("xla_compiles_total",
+                    "XLA backend compiles observed this process").inc()
+        reg.counter("xla_compile_seconds_total",
+                    "wall seconds spent in XLA backend compiles").inc(float(duration_secs))
+    except Exception:
+        pass  # a census must never break a compile
+
+
+def install() -> None:
+    """Register the jax.monitoring listener (idempotent, never raises)."""
+    global _INSTALLED
+    with _LOCK:
+        if _INSTALLED:
+            return
+        _INSTALLED = True
+    try:
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_listener)
+    except Exception:
+        pass
+
+
+def mark() -> int:
+    """Current event position — pass to :func:`census` for a per-run delta."""
+    with _LOCK:
+        return len(_EVENTS)
+
+
+def census(since: int = 0, top: int = 20) -> dict:
+    """Aggregate view of the compiles recorded after ``since``.
+
+    ``compiles_total`` counts events, ``distinct_programs`` unique program
+    fingerprints, ``distinct_kernels`` unique program names; ``programs``
+    is the per-name table (count = shape variants, seconds = compile wall)
+    sorted by compile wall, truncated to ``top`` (0 = all).
+    """
+    with _LOCK:
+        events = list(_EVENTS[since:])
+    by_name: dict = {}
+    fps = set()
+    for name, fp, secs in events:
+        fps.add(fp)
+        row = by_name.setdefault(name, {"program": name, "count": 0, "seconds": 0.0})
+        row["count"] += 1
+        row["seconds"] += secs
+    programs = sorted(by_name.values(), key=lambda r: (-r["seconds"], r["program"]))
+    if top:
+        programs = programs[:top]
+    return {
+        "compiles_total": len(events),
+        "distinct_programs": len(fps),
+        "distinct_kernels": len(by_name),
+        "compile_seconds_total": round(sum(s for _, _, s in events), 3),
+        "programs": [
+            {"program": r["program"], "count": r["count"], "seconds": round(r["seconds"], 3)}
+            for r in programs
+        ],
+    }

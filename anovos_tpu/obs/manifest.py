@@ -48,6 +48,7 @@ def build_manifest(
     block_times: Optional[dict] = None,
     trace_path: Optional[str] = None,
     generated_unix: Optional[float] = None,
+    compile_census: Optional[dict] = None,
 ) -> dict:
     """Assemble the manifest dict from the scheduler summary + metrics.
 
@@ -78,6 +79,12 @@ def build_manifest(
         "scheduler": summary,
         "block_seconds": {k: round(v, 4) for k, v in sorted((block_times or {}).items())},
         "metrics": metrics_snapshot,
+        # per-run XLA compile census (obs.compile_census delta): compile
+        # count, distinct program signatures, distinct kernels, and the
+        # top programs by compile wall — the record bench.py's
+        # e2e_cold_compiles / e2e_distinct_programs fields and the
+        # tools/compile_census.py gate read
+        "compile_census": compile_census,
         "trace_path": trace_path,
         "backend": backend,
         "generated_unix": round(
@@ -109,6 +116,9 @@ _VOLATILE_TOP_FIELDS = (
     # the critical path is the longest chain BY MEASURED DURATION — two
     # runs can legitimately pick different chains when durations jitter
     "critical_path",
+    # compile counts depend on PROCESS history (a warm in-process rerun
+    # compiles nothing) — like the op_ metric families, not run identity
+    "compile_census",
 )
 
 
@@ -133,7 +143,7 @@ def stable_view(manifest: dict) -> dict:
     out["scheduler"] = sched
     metrics = {}
     for name, m in (out.get("metrics") or {}).items():
-        if name.startswith("op_") or name.startswith("device_"):
+        if name.startswith("op_") or name.startswith("device_") or name.startswith("xla_"):
             # compile-cache state (op_compile vs op_execute/op_cache_hit)
             # depends on PROCESS history — a warm in-process rerun shifts
             # families even though the run is identical; device-memory

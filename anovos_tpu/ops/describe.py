@@ -292,19 +292,29 @@ def table_describe(idf: Table, num_cols: List[str], cat_cols: List[str]) -> Tupl
     num_out: dict = {}
     if num_cols:
         X, M = idf.numeric_block(num_cols)
-        num_out = {k: np.asarray(v) for k, v in describe_numeric(X, M).items()}
+        # numeric_block column-buckets to k_pad dead lanes (mask=False);
+        # slice every per-column output back to the live k before the host
+        # arrays escape to consumers that zip/stack them against num_cols
+        kk_live = len(num_cols)
+        num_out = {k: np.asarray(v)[..., :kk_live]
+                   for k, v in describe_numeric(X, M).items()}
         if compensated:
             comp = compensated_moments(X, M)
             for kk in ("mean", "variance", "stddev", "skewness", "kurtosis"):
-                num_out[kk] = comp[kk]
+                num_out[kk] = comp[kk][..., :kk_live]
         wide = [c for c in num_cols if idf.columns[c].is_wide]
         if wide:
             # overwrite the f32-approximate order stats with exact values
             # from the (hi, lo) int32-pair kernel (moments stay f32-approx);
-            # the lexicographic sort is order-correct for BOTH wide kinds
-            Hi = jnp.stack([idf.columns[c].wide_hi for c in wide], axis=1)
-            Lo = jnp.stack([idf.columns[c].wide_lo for c in wide], axis=1)
-            Mw = jnp.stack([idf.columns[c].mask for c in wide], axis=1)
+            # the lexicographic sort is order-correct for BOTH wide kinds.
+            # Stacks are column-bucketed like numeric_block; the j-indexed
+            # reads below never touch the dead lanes.
+            from anovos_tpu.shared.table import stack_padded
+
+            Hi, Mw = stack_padded([idf.columns[c].wide_hi for c in wide],
+                                  [idf.columns[c].mask for c in wide], dtype=jnp.int32)
+            Lo, _ = stack_padded([idf.columns[c].wide_lo for c in wide],
+                                 [idf.columns[c].mask for c in wide], dtype=jnp.int32)
             w = {kk: np.asarray(v) for kk, v in describe_wide_int(Hi, Lo, Mw).items()}
             kinds = [idf.columns[c].wide_kind for c in wide]
             pctl = _wide_pair_to_f64(w["pctl_hi"], w["pctl_lo"], kinds)  # (nq, kw)
@@ -345,10 +355,14 @@ def table_describe(idf: Table, num_cols: List[str], cat_cols: List[str]) -> Tupl
         # dispatch every bucket's program before fetching any result: the
         # per-bucket kernels overlap on the device stream instead of each
         # waiting for the previous bucket's download (graftcheck GC001)
+        from anovos_tpu.shared.table import stack_padded
+
         bucket_res = []
         for b, cols_b in sorted(buckets.items()):
-            C = jnp.stack([idf.columns[c].data for c in cols_b], axis=1)
-            Mc = jnp.stack([idf.columns[c].mask for c in cols_b], axis=1)
+            # column-bucketed stack (dead lanes code 0 / mask False → zero
+            # counts); reads below are j-indexed over the live cols_b
+            C, Mc = stack_padded([idf.columns[c].data for c in cols_b],
+                                 [idf.columns[c].mask for c in cols_b], dtype=jnp.int32)
             bucket_res.append((cols_b, describe_cat(C, Mc, b)))
         for cols_b, res in bucket_res:
             sw = {kk: np.asarray(v) for kk, v in res.items()}
@@ -361,9 +375,10 @@ def table_describe(idf: Table, num_cols: List[str], cat_cols: List[str]) -> Tupl
         if large:
             # codes are just ints: the sort-based numeric kernel yields
             # count/nunique/mode directly, no per-vocab lanes
-            C = jnp.stack([idf.columns[c].data for c in large], axis=1)
-            Mc = jnp.stack(
-                [idf.columns[c].mask & (idf.columns[c].data >= 0) for c in large], axis=1
+            C, Mc = stack_padded(
+                [idf.columns[c].data for c in large],
+                [idf.columns[c].mask & (idf.columns[c].data >= 0) for c in large],
+                dtype=jnp.int32,
             )
             lg_dev = describe_numeric(C, Mc)
             # bulk-materialize the four stats once: per-element int()/float()

@@ -34,6 +34,11 @@ def masked_quantiles(
     default) or 'lower' (Spark approxQuantile returns actual elements).
     On a multi-device mesh the sort runs column-parallel
     (runtime.column_parallel).
+
+    The quantile-grid axis is deliberately NOT shape-bucketed: padding q
+    would change the public (q, k) return shape, and the census shows only
+    ~2 compiles of saving — the column axis is where the shape variants
+    live.
     """
     return _masked_quantiles(
         X, M, qs, interpolation=interpolation, cp=wants_column_parallel(X, M)

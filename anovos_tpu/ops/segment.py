@@ -45,10 +45,27 @@ def _masked_nunique(X: jax.Array, M: jax.Array, cp: bool = False) -> jax.Array:
 
 
 def _bucket_segments(n: int) -> int:
-    """Static segment counts round up to 2^k size classes (min 8): every
+    """Static segment counts round up to 4^k size classes (min 16): every
     vocab size in a table then reuses ONE compiled program per row shape —
     unbucketed, a 19-column describe compiled code_counts 16 times on
-    identical array shapes, seconds of remote XLA each on the tunnel."""
+    identical array shapes, seconds of remote XLA each on the tunnel.
+    Power-of-SIXTEEN (coarser than describe_cat's dense-sweep pow-4
+    buckets, which pay O(rows·k·vocab) per lane and must stay fine):
+    segment_sum cost is rows-driven and the outputs are (vocab,)-scale
+    vectors, so the coarse classes {16, 256, 4096, 65536} trade idle
+    output lanes for a near-minimal distinct-program count across a run's
+    vocab-size spread (cold-compile census)."""
+    b = 16
+    while b < max(n, 1):
+        b *= 16
+    return b
+
+
+def bucket_segments_pow2(n: int) -> int:
+    """2^k size classes (min 8) — for consumers whose PADDED dimension is
+    memory-proportional (a (k, maxv) LUT matrix, a (k, nseg) aggregate
+    table): waste stays ≤2× where the coarse 4^k/16^k classes could cost
+    16× real bytes."""
     return max(8, 1 << (max(n, 1) - 1).bit_length())
 
 
@@ -65,9 +82,13 @@ def code_counts(codes: jax.Array, M: jax.Array, vocab_size: int) -> jax.Array:
     """Frequency of each dictionary code for ONE categorical column.
 
     codes: (rows,) int32 with -1 for null; M: (rows,) bool.
-    Returns (vocab_size,) counts.  segment_sum keyed by code — the histogram
-    kernel of the framework (null contributes nothing)."""
-    return _code_counts_p(codes, M, _bucket_segments(vocab_size))[:vocab_size]
+    Returns counts PADDED to the ``_bucket_segments`` size class
+    ({16, 256, 4096, …} ≥ vocab_size) — trailing lanes are zero.  Callers
+    slice ``[:vocab_size]`` after host materialization: an on-device slice
+    here compiled one dynamic_slice program per vocab size, re-creating
+    exactly the per-shape compile tail the segment-class bucketing removes
+    (PERF.md cold-compile census)."""
+    return _code_counts_p(codes, M, _bucket_segments(vocab_size))
 
 
 @functools.partial(jax.jit, static_argnames=("vocab_size",))
@@ -85,8 +106,10 @@ def code_label_counts(
     codes: jax.Array, M: jax.Array, y: jax.Array, vocab_size: int
 ) -> jax.Array:
     """Per-code sum of a row weight/label (event counts for IV, target
-    encoding).  Returns (vocab_size,)."""
-    return _code_label_counts_p(codes, M, y, _bucket_segments(vocab_size))[:vocab_size]
+    encoding).  Returns counts PADDED to the ``_bucket_segments`` class
+    (trailing lanes zero) — same host-slice contract as
+    :func:`code_counts`."""
+    return _code_label_counts_p(codes, M, y, _bucket_segments(vocab_size))
 
 
 @jax.jit

@@ -116,19 +116,27 @@ def _chunk_hist(X: jax.Array, M: jax.Array, lo: jax.Array, hi: jax.Array, nbins:
 def _iter_chunks(
     files: List[str], file_type: str, cols: List[str], chunk_rows: int, cfg: dict
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-    """(chunk_rows, k) float32 blocks + masks, padded to constant shape."""
-    from anovos_tpu.data_ingest.data_ingest import read_host_frame
+    """(chunk_rows, k_pad) float32 blocks + masks, padded to constant shape.
 
+    Both axes are shape-bucketed: rows to ``chunk_rows`` (the warm-up pass
+    contract above) and columns to ``Runtime.pad_cols`` — so two streamed
+    datasets with nearby column counts share the chunk kernels' compiled
+    programs.  Dead lanes are zero/False; ``describe_streaming`` slices its
+    outputs back to the live k."""
+    from anovos_tpu.data_ingest.data_ingest import read_host_frame
+    from anovos_tpu.shared.runtime import get_runtime
+
+    k_pad = get_runtime().pad_cols(len(cols))
     buf: List[pd.DataFrame] = []
     nbuf = 0
 
     def _emit(df: pd.DataFrame):
         vals = df[cols].to_numpy(np.float32, na_value=np.nan)
         mask = ~np.isnan(vals)
-        out_v = np.zeros((chunk_rows, len(cols)), np.float32)
-        out_m = np.zeros((chunk_rows, len(cols)), bool)
-        out_v[: len(vals)] = np.where(mask, vals, 0)
-        out_m[: len(vals)] = mask
+        out_v = np.zeros((chunk_rows, k_pad), np.float32)
+        out_m = np.zeros((chunk_rows, k_pad), bool)
+        out_v[: len(vals), : len(cols)] = np.where(mask, vals, 0)
+        out_m[: len(vals), : len(cols)] = mask
         return out_v, out_m
 
     for f in files:
@@ -217,7 +225,7 @@ def describe_streaming(
     # (graftcheck GC001); one transfer at the quantile step suffices.  A
     # periodic block_until_ready keeps the host read-loop from racing
     # ahead of the device with unbounded in-flight chunk uploads
-    hist_d = jnp.zeros((len(cols), nbins), jnp.float32)
+    hist_d = jnp.zeros((int(lo.shape[0]), nbins), jnp.float32)  # k_pad lanes
     for i, (v, m) in enumerate(_iter_chunks(files, file_type, cols, chunk_rows, cfg)):
         hist_d = hist_d + _chunk_hist(jnp.asarray(v), jnp.asarray(m), lo, hi, nbins)
         if i % _INFLIGHT_CHUNKS == _INFLIGHT_CHUNKS - 1:
@@ -227,11 +235,14 @@ def describe_streaming(
     # policy for GSPMD, shard_map, and streaming paths alike
     from anovos_tpu.ops.reductions import finalize_moments
 
-    n = agg["n"]
+    # slice every per-column array back to the live k (the chunk kernels ran
+    # on the column-bucketed k_pad; dead lanes are zero-count noise)
+    kk = len(cols)
+    n = agg["n"][:kk]
     fin = {
-        k: np.asarray(v)
+        k: np.asarray(v)[:kk]
         for k, v in finalize_moments(
-            jnp.asarray(n), jnp.asarray(agg["mean"] * n), jnp.asarray(agg["M2"]),
+            jnp.asarray(agg["n"]), jnp.asarray(agg["mean"] * agg["n"]), jnp.asarray(agg["M2"]),
             jnp.asarray(agg["M3"]), jnp.asarray(agg["M4"]),
             jnp.asarray(agg["min"]), jnp.asarray(agg["max"]), jnp.asarray(agg["nonzero"]),
         ).items()
@@ -246,7 +257,7 @@ def describe_streaming(
         "kurtosis": np.round(fin["kurtosis"], 4),
         "min": fin["min"],
         "max": fin["max"],
-        "nonzero": agg["nonzero"].astype(np.int64),
+        "nonzero": agg["nonzero"][:kk].astype(np.int64),
     }
     from anovos_tpu.ops.quantiles import quantiles_from_histogram
 
@@ -254,5 +265,5 @@ def describe_streaming(
     qvals = quantiles_from_histogram(np.asarray(hist_d), agg["min"], width,
                                      np.asarray(quantiles, np.float32))
     for i, q in enumerate(quantiles):
-        out[f"{int(q * 100)}%"] = np.round(qvals[i], 4)
+        out[f"{int(q * 100)}%"] = np.round(qvals[i][:kk], 4)
     return pd.DataFrame(out)

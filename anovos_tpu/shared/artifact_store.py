@@ -217,13 +217,17 @@ class AsyncArtifactWriter:
         self._workers = max(1, workers)
 
     def _ensure_pool(self):
-        if self._pool is None:
-            from concurrent.futures import ThreadPoolExecutor
+        # lock the check-then-create: two concurrent first submits (fanout
+        # nodes under the concurrent executor) would otherwise each build a
+        # pool and orphan one of them past close()'s shutdown
+        with self._lock:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
 
-            self._pool = ThreadPoolExecutor(
-                max_workers=self._workers, thread_name_prefix="artifact-writer"
-            )
-        return self._pool
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self._workers, thread_name_prefix="artifact-writer"
+                )
+            return self._pool
 
     @staticmethod
     def _instrumented(key: str, fn: Callable, args, kwargs):

@@ -93,6 +93,36 @@ class Runtime:
             n = b
         return ((n + m - 1) // m) * m
 
+    # column-axis floor: blocks this narrow are left exact — the compile
+    # saving cannot repay padding a 1-2 column kernel to 4+ lanes, and the
+    # per-column transformer paths routinely stack single columns
+    PAD_COLS_FLOOR = 4
+
+    def pad_cols(self, k: int) -> int:
+        """Column-axis size class for a stacked (rows, k) block.
+
+        Same static-shape discipline as :meth:`pad_rows`, applied to the
+        column axis of ``Table.numeric_block``: per-block column subsets of
+        nearby widths are padded up to geometric 2^j / 1.5·2^j classes
+        (≤33% padding waste) so they reuse compiled program shapes instead
+        of each paying a fresh XLA compile — the round-5 census measured
+        the ×3-×11 repeat compiles on the cold path to be exactly these
+        column-count shape variants (PERF.md).  Padding lanes carry
+        mask=False, so masked kernels never see them; consumers slice
+        per-column outputs back to the live ``k``.
+
+        ``ANOVOS_SHAPE_BUCKETS=0`` disables bucketing on BOTH axes; widths
+        at or below the floor (4) stay exact either way."""
+        if k <= self.PAD_COLS_FLOOR or os.environ.get("ANOVOS_SHAPE_BUCKETS", "1") == "0":
+            return k
+        b = self.PAD_COLS_FLOOR
+        while b < k:
+            if (c := b + b // 2) >= k:  # 1.5·2^j class between doublings
+                b = c
+                break
+            b *= 2
+        return b
+
 
 def init_runtime(
     devices: Optional[Sequence[jax.Device]] = None,
@@ -106,6 +136,15 @@ def init_runtime(
     (multi-host over DCN; env-driven coordinator discovery).
     """
     global _RUNTIME
+    # compile census from the first device touch: every XLA backend compile
+    # in this process is counted with per-program attribution (obs
+    # subsystem; the run manifest embeds the per-run delta)
+    try:
+        from anovos_tpu.obs.compile_census import install as _install_census
+
+        _install_census()
+    except Exception:
+        pass
     # TPU MXU's default f32 matmul precision is bf16 inputs — catastrophic
     # for the quadratic-expansion distance/covariance kernels (squared lat/lon
     # magnitudes produced within-eps errors ~800x eps^2).  A stats framework

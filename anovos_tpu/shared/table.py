@@ -288,26 +288,49 @@ class Table:
     # device block extraction for batched kernels
     # ------------------------------------------------------------------
     def numeric_block(
-        self, names: Sequence[str], dtype=jnp.float32, shard_cols: bool = False
+        self, names: Sequence[str], dtype=jnp.float32, shard_cols: bool = False,
+        pad_cols: bool = True,
     ) -> Tuple[jax.Array, jax.Array]:
-        """Stack numeric columns into (padded_rows, k) X and bool mask M,
+        """Stack numeric columns into (padded_rows, k_pad) X and bool mask M,
         row-sharded.  This is the input shape for every batched stats kernel.
         Cast+stack runs as ONE jitted program — per-column eager casts would
         cost one device dispatch each (expensive on remote backends).
+
+        The column axis is padded up to ``Runtime.pad_cols``'s geometric
+        size class (the row-axis shape-bucketing contract extended to
+        columns): padding lanes carry mask=False (their values alias the
+        first column's buffer and are DEAD — readable only under the
+        mask), so masked kernels never count them, and per-block column
+        subsets of nearby widths reuse one compiled program shape instead
+        of each paying a fresh XLA compile (PERF.md cold-compile census).
+        CONSUMER CONTRACT: every
+        per-column output must be sliced back to the live ``k=len(names)``
+        before host materialization, and any row-wise (axis=1) statistic
+        must ignore the dead lanes (e.g. complete-case = ``M.sum(axis=1)
+        == k``, never ``M.all(axis=1)``).  ``pad_cols=False`` opts out for
+        consumers whose semantics depend on the exact feature count (model
+        fits: AE latent dim, KNN distance scaling, ridge/ALS solves).
 
         ``shard_cols=True`` additionally shards the column axis over the
         mesh's model axis — the wide-table analogue of tensor parallelism
         (SURVEY §2.10): per-column stats kernels reduce over rows only, so a
         frame whose (rows × cols) block exceeds one chip's HBM splits across
-        the whole mesh with no kernel changes (GSPMD inserts the layout)."""
+        the whole mesh with no kernel changes (GSPMD inserts the layout).
+        The layout is computed from the PADDED width ``k_pad`` (rounded up
+        to a model-axis multiple so per-device lane counts stay static)."""
+        rt = get_runtime()
         datas = tuple(self.columns[n].data for n in names)
         masks = tuple(self.columns[n].mask for n in names)
-        X, M = _stack_cast(datas, masks, dtype)
+        k_pad = rt.pad_cols(len(names)) if pad_cols else len(names)
         if shard_cols:
             from anovos_tpu.shared.runtime import DATA_AXIS, MODEL_AXIS
 
-            rt = get_runtime()
-            if rt.mesh is not None and len(names) >= rt.mesh.shape.get(MODEL_AXIS, 1) > 1:
+            n_model = rt.mesh.shape.get(MODEL_AXIS, 1)
+            if k_pad >= n_model > 1:
+                k_pad = -(-k_pad // n_model) * n_model
+        X, M = _stack_canonical(list(datas), list(masks), dtype, k_pad)
+        if shard_cols:
+            if rt.mesh is not None and k_pad >= rt.mesh.shape.get(MODEL_AXIS, 1) > 1:
                 sh = NamedSharding(rt.mesh, P(DATA_AXIS, MODEL_AXIS))
                 X = jax.device_put(X, sh)
                 M = jax.device_put(M, sh)
@@ -444,6 +467,79 @@ def _stack_cast(datas, masks, dtype):
     X = jnp.stack([d.astype(dtype) for d in datas], axis=1)
     M = jnp.stack(masks, axis=1)
     return X, M
+
+
+def _extend_dead_lanes(datas, masks, k_pad):
+    """Extend column tuples to ``k_pad`` with zero-data / False-mask lanes.
+
+    The extension happens BEFORE the stack program, so the stack is keyed
+    on the bucketed arity — two blocks of nearby widths (and the same
+    dtype pattern) replay ONE compiled stack instead of one per width.
+    ``jnp.zeros_like`` costs a tiny shared fill program per (shape, dtype),
+    amortized process-wide."""
+    k = len(datas)
+    if k_pad <= k:
+        return tuple(datas), tuple(masks)
+    # dead DATA lanes alias the first column's buffer — zero device work,
+    # no fill program (the drift _padded_col_tuples pattern); only the
+    # all-False mask needs a real (tiny, shared) fill.  Consumers may read
+    # dead-lane VALUES only under the mask, which is False there.
+    dead_d = datas[0]
+    dead_m = jnp.zeros_like(masks[0])
+    return (tuple(datas) + (dead_d,) * (k_pad - k),
+            tuple(masks) + (dead_m,) * (k_pad - k))
+
+
+def _stack_canonical(datas, masks, dtype, k_pad):
+    """Bucketed stack: dead-lane tuple extension before the stack program,
+    so the stack is keyed on the bucketed arity.  (A dtype-canonical lane
+    sort + inverse-perm gather was measured here and reverted: real blocks
+    differ in their dtype COUNTS, not their order, so the permutation only
+    added gather programs without collapsing stack variants.)"""
+    datas, masks = _extend_dead_lanes(list(datas), list(masks), k_pad)
+    return _stack_cast(tuple(datas), tuple(masks), dtype)
+
+
+def stack_padded(datas, masks, dtype=jnp.float32, pad_cols: bool = True):
+    """Column-bucketed stack for ad-hoc (rows, k) blocks built from raw
+    column arrays (cat codes, wide-int hi/lo pairs, mixed-kind stacks) —
+    the same contract as :meth:`Table.numeric_block` for callers that are
+    not stacking ``Column.data`` of a single table: padding lanes carry
+    mask=False (dead values) and per-column outputs must be sliced back to
+    the live ``len(datas)``."""
+    k_pad = get_runtime().pad_cols(len(datas)) if pad_cols else len(datas)
+    return _stack_canonical(list(datas), list(masks), dtype, k_pad)
+
+
+@jax.jit
+def _stack_bool(masks):
+    return jnp.stack(masks, axis=1)
+
+
+def stack_masks_padded(masks, pad_cols: bool = True) -> jax.Array:
+    """Column-bucketed (rows, k_pad) bool stack of validity masks (dead
+    lanes False).  Row-wise consumers must count against the LIVE k — e.g.
+    nulls-per-row is ``k − M.sum(axis=1)`` and complete-case is
+    ``M.sum(axis=1) == k`` — never ``(~M).sum(axis=1)`` / ``M.all(axis=1)``,
+    which would count the dead lanes."""
+    masks = list(masks)
+    k_pad = get_runtime().pad_cols(len(masks)) if pad_cols else len(masks)
+    if k_pad > len(masks):
+        dead = jnp.zeros_like(masks[0])
+        masks = masks + [dead] * (k_pad - len(masks))
+    return _stack_bool(tuple(masks))
+
+
+def pad_lane_params(arr: np.ndarray, k_pad: int, fill=0.0) -> np.ndarray:
+    """Pad a host per-column parameter array (k, ...) to (k_pad, ...) along
+    axis 0 so elementwise kernels broadcast against a column-bucketed block
+    without a per-width recompile.  ``fill`` picks a value that keeps the
+    dead lanes numerically inert (1.0 for divisors, 0.0 otherwise)."""
+    arr = np.asarray(arr)
+    if arr.shape[0] >= k_pad:
+        return arr
+    widths = ((0, k_pad - arr.shape[0]),) + ((0, 0),) * (arr.ndim - 1)
+    return np.pad(arr, widths, constant_values=fill)
 
 
 @jax.jit

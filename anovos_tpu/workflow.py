@@ -59,6 +59,7 @@ from anovos_tpu.drift_stability import drift_detector as ddetector
 from anovos_tpu.drift_stability import stability as dstability
 from anovos_tpu.obs import (
     build_manifest,
+    compile_census,
     get_metrics,
     get_tracer,
     record_device_memory,
@@ -401,6 +402,11 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
     # cache hits instead of compiles — exactly the steady-state picture
     get_metrics().reset()
     get_tracer().clear()
+    # compile census delta for THIS run: the listener is process-wide
+    # (installed at init_runtime), the manifest embeds only what compiled
+    # after this mark — a warm in-process rerun shows ~zero compiles
+    compile_census.install()
+    census_mark = compile_census.mark()
     LAST_RUN_SUMMARY = {}
     LAST_MANIFEST_PATH = ""
     auth_key = _auth_key(auth_key_val)
@@ -783,6 +789,7 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                 all_configs, summary, get_metrics().snapshot(),
                 run_type=run_type, block_times=block_times(),
                 trace_path=trace_dest and os.path.abspath(trace_dest),
+                compile_census=compile_census.census(since=census_mark),
             )
             # the manifest rides the same async write queue as every other
             # artifact; close() below drains it

@@ -39,9 +39,16 @@ import pandas as pd
 TARGET_ROWS = int(os.environ.get("BENCH_ROWS", 4_000_000))
 BIN_SIZE = 10
 # total probe budget (was a single-shot 150s in round 2 — the round's number
-# landed on CPU because the flaky tunnel missed its one chance)
-PROBE_TOTAL = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", 600))
+# landed on CPU because the flaky tunnel missed its one chance).
+# ANOVOS_PROBE_BUDGET is the operator-facing override; the legacy
+# BENCH_TPU_PROBE_TIMEOUT name still works.
+PROBE_TOTAL = int(os.environ.get("ANOVOS_PROBE_BUDGET",
+                                 os.environ.get("BENCH_TPU_PROBE_TIMEOUT", 600)))
 PROBE_ATTEMPT = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPT_TIMEOUT", 150))
+# fast-fail: N consecutive IDENTICAL timeout diagnostics means the tunnel is
+# wedged, not flaky — burning the remaining budget on more 150 s probes only
+# delays the CPU fallback (BENCH_r05 tail: 4×150 s before surrender)
+PROBE_FAST_FAIL = int(os.environ.get("ANOVOS_PROBE_FAST_FAIL", 2))
 RUN_TIMEOUT = int(os.environ.get("BENCH_RUN_TIMEOUT", 1200))
 E2E_TIMEOUT = int(os.environ.get("BENCH_E2E_TIMEOUT", 2400))
 
@@ -85,6 +92,7 @@ def probe_backend(total_budget_s: int, attempt_timeout_s: int):
     """
     deadline = time.monotonic() + total_budget_s
     attempt, diag, backoff = 0, None, 5
+    same_timeout_streak, prev_diag = 0, None
     while time.monotonic() < deadline:
         attempt += 1
         remaining = deadline - time.monotonic()
@@ -93,6 +101,23 @@ def probe_backend(total_budget_s: int, attempt_timeout_s: int):
             return platform, None, attempt
         print(f"bench: probe attempt {attempt} failed ({diag}); "
               f"{remaining:.0f}s budget left", file=sys.stderr)
+        # a WEDGED tunnel fails the same way every time (probe timeout at
+        # the full attempt budget); a FLAKY one usually fails differently
+        # between attempts (connection reset, UNAVAILABLE, partial init).
+        # Two identical timeout diagnostics in a row → stop paying 150 s
+        # per probe and let the CPU fallback record a real number.
+        # DELIBERATE tradeoff: a tunnel that flakes as two consecutive
+        # clean timeouts loses its later attempts too — rounds 3-5 never
+        # observed that pattern recover within the budget (every wedge was
+        # N identical timeouts), and ANOVOS_PROBE_FAST_FAIL=0 restores the
+        # full-budget retry loop when a deployment's tunnel behaves
+        # differently.
+        is_timeout = "timed out" in str(diag) or "timeout" in str(diag).lower()
+        same_timeout_streak = same_timeout_streak + 1 if (is_timeout and diag == prev_diag) else 1
+        prev_diag = diag
+        if PROBE_FAST_FAIL and is_timeout and same_timeout_streak >= PROBE_FAST_FAIL:
+            return None, (f"{diag} ({attempt} attempts; fast-fail after "
+                          f"{same_timeout_streak} identical timeouts)"), attempt
         if time.monotonic() + backoff >= deadline:
             break
         time.sleep(backoff)
@@ -285,6 +310,7 @@ def e2e_cold_warm() -> dict:
     out = {}
     blocks = {}
     summary = {}
+    census = {}
     cwd = os.getcwd()
     for label in ("cold", "warm"):
         with tempfile.TemporaryDirectory() as d:
@@ -301,6 +327,9 @@ def e2e_cold_warm() -> dict:
                 man = load_manifest(workflow.LAST_MANIFEST_PATH)
                 blocks = dict(man.get("block_seconds", {}))
                 summary = dict(man.get("scheduler", {}))
+                # per-run XLA compile census (cold = the shape-bucketing
+                # regression signal; warm should be ~zero)
+                census[label] = dict(man.get("compile_census") or {})
             finally:
                 os.chdir(cwd)
     try:
@@ -318,6 +347,17 @@ def e2e_cold_warm() -> dict:
         # tests/golden/e2e_block_budget.csv)
         "e2e_warm_blocks": {k: round(v, 2) for k, v in top_blocks.items()},
     }
+    if census.get("cold"):
+        # cold-run compile census (obs.compile_census via the manifest):
+        # total XLA backend compiles, distinct program signatures, and the
+        # compile wall they cost — the numbers column/row shape bucketing
+        # keeps down; tools/compile_census.py renders the per-program table
+        result.update({
+            "e2e_cold_compiles": census["cold"].get("compiles_total"),
+            "e2e_distinct_programs": census["cold"].get("distinct_programs"),
+            "e2e_cold_compile_wall_s": census["cold"].get("compile_seconds_total"),
+            "e2e_warm_compiles": (census.get("warm") or {}).get("compiles_total"),
+        })
     if summary:
         # DAG-executor observability (warm run): serial work vs wall,
         # measured critical path, and the chain itself — how much of the

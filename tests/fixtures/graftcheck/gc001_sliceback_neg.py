@@ -1,0 +1,26 @@
+"""GC001 negative fixture: padded-lane slice-back done RIGHT.
+
+The live-k slice happens on the HOST after one bulk materialization (the
+pattern table_describe / the transformers / drift statistics use), so the
+column bucketing adds zero extra device round-trips.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _moments(X, M):
+    n = M.sum(axis=0)
+    return jnp.where(M, X, 0).sum(axis=0) / jnp.maximum(n, 1)
+
+
+def bulk_then_host_slice(X, M, live_k):
+    mean = _moments(X, M)
+    return np.asarray(mean)[:live_k]  # one trailing pull, host-side slice
+
+
+def dispatch_both_then_drain(X, M, live_k):
+    mean = _moments(X, M)
+    mean2 = _moments(X * 2, M)  # second program dispatched before any pull
+    return np.asarray(mean)[:live_k], np.asarray(mean2)[:live_k]

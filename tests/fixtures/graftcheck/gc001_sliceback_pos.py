@@ -1,0 +1,32 @@
+"""GC001 positive fixture: padded-lane slice-back done WRONG.
+
+Column-bucketed blocks (Table.numeric_block pads k to a size class) must be
+sliced back to the live k AFTER one bulk host materialization.  Pulling the
+per-column values element-by-element off the device — the tempting way to
+"skip the dead lanes" — is exactly the hot-path host-sync shape GC001
+exists to flag: one blocking round-trip per column per statistic.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _moments(X, M):
+    n = M.sum(axis=0)
+    return jnp.where(M, X, 0).sum(axis=0) / jnp.maximum(n, 1)
+
+
+def per_column_pull_skips_dead_lanes(X, M, live_k):
+    mean = _moments(X, M)
+    out = []
+    for i in range(live_k):
+        out.append(float(mean[i]))  # one device round-trip per live column
+    return out
+
+
+def scalar_pull_then_dispatch(X, M):
+    mean = _moments(X, M)
+    first = mean[0].item()  # scalar pull with more work still to dispatch
+    rest = _moments(X * 2, M)
+    return first, np.asarray(rest)

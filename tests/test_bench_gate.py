@@ -127,6 +127,53 @@ def test_embedded_probe_clock_cross_check(tmp_path, monkeypatch):
     assert bench._attested_capture() is None
 
 
+def test_probe_fast_fail_on_identical_timeouts(monkeypatch):
+    """A wedged tunnel fails identically every probe; two identical timeout
+    diagnostics must end the retry loop (≤ ~2 attempt budgets) instead of
+    burning the full 600 s budget on more 150 s probes (BENCH_r05 tail).
+    A flaky tunnel (changing diagnostics) keeps retrying."""
+    calls = []
+
+    def fake_probe(timeout_s):
+        calls.append(timeout_s)
+        return None, f"backend probe timed out after {150}s"
+
+    monkeypatch.setattr(bench, "probe_backend_once", fake_probe)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    platform, diag, attempts = bench.probe_backend(600, 150)
+    assert platform is None
+    assert attempts == 2 and len(calls) == 2
+    assert "fast-fail" in diag
+
+    # distinct diagnostics (flaky, not wedged): no fast-fail, budget governs
+    calls.clear()
+    seq = iter(range(100))
+
+    def flaky_probe(timeout_s):
+        calls.append(timeout_s)
+        return None, f"backend probe failed: UNAVAILABLE #{next(seq)}"
+
+    monkeypatch.setattr(bench, "probe_backend_once", flaky_probe)
+    t = {"now": 0.0}
+    monkeypatch.setattr(bench.time, "monotonic", lambda: t.__setitem__("now", t["now"] + 50) or t["now"])
+    platform, diag, attempts = bench.probe_backend(600, 150)
+    assert platform is None
+    assert attempts > 2
+    assert "fast-fail" not in diag
+
+
+def test_probe_budget_env_override(monkeypatch):
+    import importlib.util as _ilu
+
+    monkeypatch.setenv("ANOVOS_PROBE_BUDGET", "123")
+    spec = _ilu.spec_from_file_location(
+        "bench_env_probe", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    mod = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.PROBE_TOTAL == 123
+
+
 def test_e2e_rows_derived_from_config():
     # configs_full reads the income parquet: the derived count must match
     # the dataset, not a hardwired constant

@@ -88,6 +88,20 @@ def test_rule_quiet_on_negative_fixture(rule_id):
     assert not hits, "\n".join(f.render() for f in hits)
 
 
+def test_gc001_sliceback_regression_fixture():
+    """Column-bucketed blocks slice back to the live k on the HOST after one
+    bulk pull (the numeric_block consumer contract) — per-column device
+    pulls of the padded stats are the GC001 host-sync shape.  Pins both the
+    firing and the quiet pattern so a future consumer rewrite that
+    re-introduces per-lane pulls fails here."""
+    pos = os.path.join(FIXTURES, "gc001_sliceback_pos.py")
+    hits = [f for f in scan([pos]) if f.rule == "GC001"]
+    assert len(hits) >= 2, [f.render() for f in hits]
+    neg = os.path.join(FIXTURES, "gc001_sliceback_neg.py")
+    quiet = [f for f in scan([neg]) if f.rule == "GC001"]
+    assert not quiet, "\n".join(f.render() for f in quiet)
+
+
 def test_fixtures_have_no_cross_rule_noise():
     """A rule's fixtures exercise THAT rule only — other rules stay quiet
     (keeps fixture failures attributable)."""

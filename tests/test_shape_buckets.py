@@ -1,0 +1,212 @@
+"""Shape-bucketing contract: ``Runtime.pad_rows`` / ``Runtime.pad_cols``
+size classes and the byte-identical parity of column-bucketed kernels.
+
+The bucketing exists purely as a compile-amortization discipline (PERF.md
+cold-compile census): padded lanes/rows carry mask=False and every consumer
+slices per-column outputs back to the live k, so results must be
+BYTE-identical with bucketing on vs off.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from anovos_tpu.shared.runtime import get_runtime
+
+
+# ---------------------------------------------------------------------------
+# pad_rows / pad_cols unit contract
+# ---------------------------------------------------------------------------
+def test_pad_cols_floor_exact():
+    rt = get_runtime()
+    for k in range(1, rt.PAD_COLS_FLOOR + 1):
+        assert rt.pad_cols(k) == k
+
+
+def test_pad_cols_geometric_classes():
+    rt = get_runtime()
+    # classes above the floor: 6, 8, 12, 16, 24, 32, 48, 64 …
+    assert rt.pad_cols(5) == 6
+    assert rt.pad_cols(6) == 6
+    assert rt.pad_cols(7) == 8
+    assert rt.pad_cols(8) == 8
+    assert rt.pad_cols(9) == 12
+    assert rt.pad_cols(12) == 12
+    assert rt.pad_cols(13) == 16
+    assert rt.pad_cols(17) == 24
+    assert rt.pad_cols(25) == 32
+    assert rt.pad_cols(33) == 48
+    assert rt.pad_cols(49) == 64
+
+
+def test_pad_cols_bucket_edges_are_fixed_points():
+    """2^j and 1.5·2^j widths are their own bucket (no over-padding)."""
+    rt = get_runtime()
+    b = rt.PAD_COLS_FLOOR
+    classes = []
+    while b < 4096:
+        classes.append(b)
+        classes.append(b + b // 2)
+        b *= 2
+    for c in classes:
+        assert rt.pad_cols(c) == c, c
+
+
+def test_pad_cols_properties():
+    """Monotone, idempotent, ≥ input, ≤ 1.5× waste above the floor."""
+    rt = get_runtime()
+    prev = 0
+    for k in range(1, 2000):
+        p = rt.pad_cols(k)
+        assert p >= k
+        assert p >= prev  # monotone in k
+        assert rt.pad_cols(p) == p  # idempotent: classes are fixed points
+        if k > rt.PAD_COLS_FLOOR:
+            assert p <= k + k // 2  # geometric-class waste bound
+        prev = p
+
+
+def test_pad_rows_bucket_edges():
+    rt = get_runtime()
+    m = rt.n_data
+    # at/below the 256 floor: exact up to the data-axis multiple
+    assert rt.pad_rows(100) == -(-100 // m) * m
+    assert rt.pad_rows(256) == -(-256 // m) * m
+    # above: 2^k / 1.5·2^k classes, then the data-axis multiple
+    assert rt.pad_rows(257) == -(-384 // m) * m
+    assert rt.pad_rows(385) == -(-512 // m) * m
+    assert rt.pad_rows(513) == -(-768 // m) * m
+    assert rt.pad_rows(768) == -(-768 // m) * m
+    assert rt.pad_rows(1025) == -(-1536 // m) * m
+
+
+def test_pad_rows_monotone_and_multiple():
+    rt = get_runtime()
+    m = rt.n_data
+    prev = 0
+    for n in range(1, 3000, 7):
+        p = rt.pad_rows(n)
+        assert p >= n and p % m == 0
+        assert p >= prev
+        prev = p
+
+
+def test_shape_buckets_env_disables_both_axes(monkeypatch):
+    rt = get_runtime()
+    monkeypatch.setenv("ANOVOS_SHAPE_BUCKETS", "0")
+    m = rt.n_data
+    for n in (257, 300, 1000):
+        assert rt.pad_rows(n) == -(-n // m) * m  # only the shard multiple
+    for k in (5, 9, 13, 100):
+        assert rt.pad_cols(k) == k
+
+
+# ---------------------------------------------------------------------------
+# numeric_block padding contract
+# ---------------------------------------------------------------------------
+def test_numeric_block_pads_dead_lanes():
+    from anovos_tpu.shared.table import Table
+
+    g = np.random.default_rng(0)
+    df = pd.DataFrame({f"c{i}": g.normal(size=64) for i in range(9)})
+    t = Table.from_pandas(df)
+    X, M = t.numeric_block(t.col_names)
+    rt = get_runtime()
+    assert X.shape[1] == rt.pad_cols(9) == 12
+    Mh = np.asarray(M)
+    assert not Mh[:, 9:].any(), "dead lanes must be mask=False"
+    # dead-lane VALUES are unspecified (they alias a live column's buffer);
+    # only the mask contract matters — every consumer reads through M
+    # opt-out for model-semantics consumers
+    X0, _ = t.numeric_block(t.col_names, pad_cols=False)
+    assert X0.shape[1] == 9
+
+
+def test_numeric_block_widths_share_padded_shape():
+    from anovos_tpu.shared.table import Table
+
+    g = np.random.default_rng(1)
+    shapes = set()
+    for k in (9, 10, 11, 12):
+        df = pd.DataFrame({f"c{i}": g.normal(size=32) for i in range(k)})
+        t = Table.from_pandas(df)
+        X, _ = t.numeric_block(t.col_names)
+        shapes.add(tuple(X.shape))
+    assert len(shapes) == 1, shapes  # all widths land in the 12-lane class
+
+
+# ---------------------------------------------------------------------------
+# byte-identical parity: bucketing on vs off
+# ---------------------------------------------------------------------------
+_PARITY_CHILD = r"""
+import os, sys, json, hashlib, tempfile
+import numpy as np, pandas as pd
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["ANOVOS_TPU_EXECUTOR"] = "sequential"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from anovos_tpu.shared.runtime import init_runtime
+init_runtime()
+import jax.numpy as jnp
+from anovos_tpu.shared.table import Table
+from anovos_tpu.ops.describe import table_describe
+from anovos_tpu.ops.quantiles import masked_quantiles
+from anovos_tpu.ops.drift_kernels import binned_histograms, fit_cutoffs
+from anovos_tpu.drift_stability import statistics
+from anovos_tpu.shared.table import pad_lane_params
+
+def h(a):
+    return hashlib.sha1(np.ascontiguousarray(np.asarray(a)).tobytes()).hexdigest()
+
+out = {}
+g = np.random.default_rng(11)
+# widths straddling the 8→12 and 12→16 bucket edges (and one below floor)
+for k in (4, 8, 9, 12, 13):
+    df = pd.DataFrame({f"n{i}": g.normal(i, 1 + i / 9, 120) for i in range(k)})
+    df.iloc[::7, k // 2] = np.nan
+    df["cat"] = g.choice(list("abc"), 120)
+    t = Table.from_pandas(df)
+    num = [c for c in df.columns if c.startswith("n")]
+    num_out, cat_out = table_describe(t, num, ["cat"])
+    for kk in sorted(num_out):
+        out[f"desc{k}_{kk}"] = h(num_out[kk])
+        assert np.asarray(num_out[kk]).shape[-1] == k
+    for kk in sorted(cat_out):
+        out[f"cat{k}_{kk}"] = h(cat_out[kk])
+    X, M = t.numeric_block(num)
+    q = np.asarray(masked_quantiles(X, M, jnp.array([0.05, 0.5, 0.95], jnp.float32)))[:, :k]
+    out[f"quant{k}"] = h(q)
+    # histogram counts against fitted cutoffs
+    from anovos_tpu.drift_stability.drift_detector import _padded_col_tuples
+    cuts = np.asarray(fit_cutoffs(*_padded_col_tuples(t, num), 10, "equal_range"))[:k]
+    counts = np.asarray(
+        binned_histograms(X, M, jnp.asarray(pad_lane_params(cuts, X.shape[1]), jnp.float32), 10)
+    )[:k]
+    out[f"hist{k}"] = h(counts)
+    with tempfile.TemporaryDirectory() as d:
+        odf = statistics(t, t, use_sampling=False, source_path=d,
+                         method_type=["PSI", "HD", "JSD", "KS"])
+    out[f"psi{k}"] = hashlib.sha1(odf.to_csv(index=False).encode()).hexdigest()
+print(json.dumps(out))
+"""
+
+
+def test_bucketed_vs_exact_byte_parity():
+    """table_describe / masked_quantiles / histogram counts / drift metrics
+    byte-identical with ANOVOS_SHAPE_BUCKETS on vs off, for widths
+    straddling bucket edges (CPU, sequential executor, fresh process per
+    mode so jit caches cannot leak between them)."""
+    results = {}
+    for mode in ("1", "0"):
+        env = {**os.environ, "ANOVOS_SHAPE_BUCKETS": mode, "JAX_PLATFORMS": "cpu"}
+        env.pop("XLA_FLAGS", None)  # single-device child: parity must not
+        # depend on the 8-virtual-device test mesh
+        r = subprocess.run([sys.executable, "-c", _PARITY_CHILD],
+                           capture_output=True, text=True, env=env, timeout=600)
+        assert r.returncode == 0, r.stderr[-3000:]
+        results[mode] = r.stdout.strip().splitlines()[-1]
+    assert results["1"] == results["0"], "bucketing changed artifact bytes"

@@ -1,0 +1,83 @@
+"""Render / gate the XLA compile census of a run manifest.
+
+Reads the ``compile_census`` section ``workflow.main`` embeds in
+``obs/run_manifest.json`` (obs.compile_census: every real backend compile,
+attributed per program) and prints the top-N programs by compile wall —
+the cold-run tail the column/row shape bucketing exists to keep short.
+
+CI gate: ``--assert-max-programs N`` (and ``--assert-max-compiles N``)
+exits non-zero when the run compiled more distinct program signatures
+(resp. total compiles) than the budget — a per-call ``jax.jit``, a
+missing shape bucket, or a new per-column eager loop re-inflates the cold
+wall loudly instead of silently (the regression class PERF.md's round-4
+census caught by hand: a per-call closure jit recompiling 10 programs per
+ts_analyzer call).
+
+Usage::
+
+    python -m tools.compile_census <run_manifest.json> [--top N]
+        [--assert-max-programs N] [--assert-max-compiles N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_census(manifest_path: str) -> dict:
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    census = manifest.get("compile_census")
+    if not census:
+        raise SystemExit(
+            f"{manifest_path}: no compile_census section — manifest predates "
+            "the census (re-run the workflow) or the run recorded no compiles"
+        )
+    return census
+
+
+def format_census(census: dict, top: int = 15) -> str:
+    lines = [
+        "compiles_total={compiles_total}  distinct_programs={distinct_programs}  "
+        "distinct_kernels={distinct_kernels}  compile_wall_s={compile_seconds_total}".format(**census),
+        f"{'seconds':>9}  {'count':>5}  program",
+    ]
+    for row in census.get("programs", [])[: top or None]:
+        lines.append(f"{row['seconds']:9.3f}  {row['count']:5d}  {row['program']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("manifest", help="path to obs/run_manifest.json")
+    p.add_argument("--top", type=int, default=15, help="programs to print (0 = all)")
+    p.add_argument("--assert-max-programs", type=int, default=None,
+                   help="fail if distinct_programs exceeds this budget")
+    p.add_argument("--assert-max-compiles", type=int, default=None,
+                   help="fail if compiles_total exceeds this budget")
+    args = p.parse_args(argv)
+    census = load_census(args.manifest)
+    print(format_census(census, args.top))
+    rc = 0
+    if args.assert_max_programs is not None and census["distinct_programs"] > args.assert_max_programs:
+        print(
+            f"FAIL: distinct_programs {census['distinct_programs']} > budget "
+            f"{args.assert_max_programs} — a shape-variant or per-call-jit "
+            "regression re-inflated the cold compile tail",
+            file=sys.stderr,
+        )
+        rc = 2
+    if args.assert_max_compiles is not None and census["compiles_total"] > args.assert_max_compiles:
+        print(
+            f"FAIL: compiles_total {census['compiles_total']} > budget "
+            f"{args.assert_max_compiles}",
+            file=sys.stderr,
+        )
+        rc = 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
