@@ -1,0 +1,165 @@
+"""Node fingerprints: the cache key of one scheduler node's outputs.
+
+A node's artifacts are a pure function of (input dataset, its config
+slice, the code version, the runtime knobs that change numerics, and the
+outputs of the nodes it reads through RAW edges) — PR 3's GC006 audit
+verifies the read/write contracts are exact, which is what makes this
+key SOUND.  The fingerprint is the sha256 over exactly those parts:
+
+``H(base ∥ node name ∥ canonical(config slice) ∥ writes-set ∥ RAW-dep
+fingerprints)`` where ``base = H(anovos version ∥ backend ∥ env knobs ∥
+dataset fingerprint ∥ global path config)``.
+
+Canonicalization drops ``None``-valued keys recursively — the workflow
+ignores them when dispatching (``_clean_spec`` semantics), so two
+configs differing only in explicit nulls must hash equal.
+
+``KNOWN_ENV_KNOBS`` is the audited list of environment variables that
+can change a node's ARTIFACTS (not just its speed).  graftcheck's GC008
+rule enforces completeness: any ``os.environ`` read reachable from a
+scheduler node body must name a knob on this list (or be explicitly
+baselined), so a new knob cannot silently poison the cache key.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+from typing import Iterable, Optional
+
+__all__ = [
+    "KNOWN_ENV_KNOBS",
+    "canonical",
+    "digest",
+    "dataset_fingerprint",
+    "env_fingerprint",
+    "base_material",
+    "node_fingerprint",
+]
+
+# Environment variables whose value changes node ARTIFACTS.  Pure
+# performance knobs (worker counts, timeouts, trace paths, probe budgets)
+# deliberately stay off the list — they must NOT invalidate the cache.
+# ANOVOS_SHAPE_BUCKETS is on it defensively: bucketed-vs-exact parity is
+# tested byte-identical, but the knob exists precisely to flip compiled
+# program shapes, and a false invalidation is cheap while a false hit is
+# not.  graftcheck GC008 audits node bodies against this list.
+KNOWN_ENV_KNOBS = (
+    "ANOVOS_MATMUL_PRECISION",
+    "ANOVOS_REPLICATE_MAX_BYTES",
+    "ANOVOS_REREAD_FROM_DISK",
+    "ANOVOS_SHAPE_BUCKETS",
+)
+
+
+def canonical(obj) -> str:
+    """Deterministic JSON of a config slice; ``None``-valued dict entries
+    are dropped recursively (the workflow ignores them — ``_clean_spec``)."""
+
+    def strip(o):
+        if isinstance(o, dict):
+            return {str(k): strip(v) for k, v in o.items() if v is not None}
+        if isinstance(o, (list, tuple)):
+            return [strip(v) for v in o]
+        return o
+
+    return json.dumps(strip(obj), sort_keys=True, default=str, separators=(",", ":"))
+
+
+def digest(*parts: str) -> str:
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p.encode() if isinstance(p, str) else p)
+        h.update(b"\x00")  # unambiguous part boundary
+    return h.hexdigest()
+
+
+def _stat_sig(path: str) -> str:
+    st = os.stat(path)
+    return f"{path}:{st.st_size}:{st.st_mtime_ns}"
+
+
+def dataset_fingerprint(spec: Optional[dict]) -> str:
+    """Fingerprint of an input-dataset spec: the canonical spec plus a
+    (path, size, mtime_ns) signature of every file under its read path.
+
+    Stat-based, not content-hashed: the income parquet is ~MBs but real
+    deployments point at GBs — a content hash would cost a full extra
+    read per run for a file that editing tools always re-stamp anyway.
+    A touch without a content change costs one spurious recompute, never
+    a wrong hit."""
+    spec = spec or {}
+    sigs = []
+    path = ((spec.get("read_dataset") or {}).get("file_path")
+            if isinstance(spec.get("read_dataset"), dict) else None)
+    if path and os.path.isdir(path):
+        for dirpath, dirs, files in os.walk(path):
+            dirs.sort()
+            for f in sorted(files):
+                try:
+                    sigs.append(_stat_sig(os.path.join(dirpath, f)))
+                except OSError:
+                    pass
+    elif path and os.path.isfile(path):
+        try:
+            sigs.append(_stat_sig(path))
+        except OSError:
+            pass
+    return digest(canonical(spec), *sigs)
+
+
+def env_fingerprint() -> str:
+    """The audited runtime knobs (KNOWN_ENV_KNOBS) plus the backend name —
+    cpu and tpu runs of the same config legitimately differ in float
+    artifacts, so they must never share cache entries."""
+    backend = ""
+    jax = sys.modules.get("jax")  # never import jax for a hash
+    if jax is not None:
+        try:
+            backend = jax.default_backend()
+        except Exception:
+            backend = ""
+    knobs = {k: os.environ.get(k, "") for k in KNOWN_ENV_KNOBS}
+    return digest(canonical(knobs), backend)
+
+
+def base_material(all_configs: dict, run_type: str = "local") -> str:
+    """The run-wide part of every node fingerprint: code version, audited
+    env knobs + backend, the input dataset, and the global output-path
+    config (a changed write destination must recompute — restored
+    artifacts embed their paths in nothing, but the capture recorded the
+    OLD destinations)."""
+    from anovos_tpu.version import __version__
+
+    global_slice = {
+        "run_type": run_type,
+        "write_main": all_configs.get("write_main"),
+        "write_intermediate": all_configs.get("write_intermediate"),
+        "write_stats": all_configs.get("write_stats"),
+        "report_preprocessing": {
+            "master_path": (all_configs.get("report_preprocessing") or {}).get("master_path")
+        },
+    }
+    return digest(
+        __version__,
+        env_fingerprint(),
+        dataset_fingerprint(all_configs.get("input_dataset")),
+        canonical(global_slice),
+    )
+
+
+def node_fingerprint(
+    base: str,
+    name: str,
+    config_slice,
+    writes: Iterable[str] = (),
+    dep_fingerprints: Iterable[str] = (),
+) -> str:
+    """Fold one node's identity: run base, node name, its canonicalized
+    config slice, its declared writes-set, and the fingerprints of the
+    nodes it reads through RAW edges (registration order is topological,
+    so dep fingerprints always exist by the time this is called)."""
+    return digest(base, name, canonical(config_slice),
+                  canonical(sorted(writes)), *sorted(dep_fingerprints))

@@ -1,0 +1,89 @@
+"""Write-ahead run journal: ``obs/run_journal.jsonl``.
+
+One JSON line per lifecycle event — ``run_begin``, ``node_begin``
+(cache miss, about to execute), ``node_commit`` (artifacts committed to
+the store), ``node_restored`` (cache hit), ``node_failed``, ``run_end``.
+The journal is append-only ACROSS runs in the same output directory, so
+a killed run's committed frontier is still on disk when ``--resume``
+re-runs the config: resumed nodes hit the cache store (the store commit,
+tmp+rename, is the durability point — the journal is the human/tooling
+record of WHAT was committed and when, and what a resume started from).
+
+Lines are written through the run's :class:`AsyncArtifactWriter` (same
+queue as every other artifact, drained at the run barrier) when one is
+supplied; appends themselves serialize on an internal lock so concurrent
+scheduler workers never interleave partial lines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["RunJournal", "read_journal", "committed_fingerprints"]
+
+JOURNAL_KEY = "obs:run_journal"
+
+
+class RunJournal:
+    def __init__(self, path: str, writer=None):
+        self.path = os.path.abspath(path)
+        self._writer = writer
+        self._lock = threading.Lock()
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    def _append_line(self, line: str) -> None:
+        with self._lock, open(self.path, "a") as f:
+            f.write(line + "\n")
+            f.flush()
+
+    def append(self, event: str, **fields) -> None:
+        rec = {"event": event, "t": round(time.time(), 3), **fields}
+        line = json.dumps(rec, sort_keys=True, separators=(",", ":"))
+        if self._writer is not None:
+            self._writer.submit(JOURNAL_KEY, self._append_line, line)
+        else:
+            self._append_line(line)
+
+
+def read_journal(path: str) -> List[dict]:
+    """All parseable records (a torn final line from a kill is skipped)."""
+    out: List[dict] = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+def committed_fingerprints(records: List[dict],
+                           since_run: Optional[str] = None) -> List[str]:
+    """Fingerprints with a commit/restore record (the resumable frontier).
+    ``since_run`` restricts to records at or after that run id's last
+    ``run_begin``."""
+    if since_run is not None:
+        start = 0
+        for i, r in enumerate(records):
+            if r.get("event") == "run_begin" and r.get("run_id") == since_run:
+                start = i
+        records = records[start:]
+    out, seen = [], set()
+    for r in records:
+        if r.get("event") in ("node_commit", "node_restored"):
+            fp = r.get("fp", "")
+            if fp and fp not in seen:
+                seen.add(fp)
+                out.append(fp)
+    return out

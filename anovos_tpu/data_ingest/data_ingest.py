@@ -296,6 +296,13 @@ def write_dataset(
         else:
             raise ValueError(f"unsupported file_type: {file_type}")
     open(os.path.join(file_path, "_SUCCESS"), "w").close()
+    # incremental-recompute capture: the pyarrow writers bypass the
+    # builtins.open hook, so this choke point books every part explicitly
+    # (a no-op unless a cache recorder is active on this thread)
+    from anovos_tpu.cache import capture as _capture
+
+    for f in written + [os.path.join(file_path, "_SUCCESS")]:
+        _capture.record_artifact(f)
     from anovos_tpu.obs import get_metrics
 
     try:

@@ -42,6 +42,7 @@ from anovos_tpu.obs.metrics import (
     Histogram,
     MetricsRegistry,
     get_metrics,
+    record_cache_stats,
     record_device_memory,
 )
 from anovos_tpu.obs.timed import timed
@@ -67,6 +68,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "get_metrics",
+    "record_cache_stats",
     "record_device_memory",
     "timed",
     "Span",

@@ -49,6 +49,7 @@ def build_manifest(
     trace_path: Optional[str] = None,
     generated_unix: Optional[float] = None,
     compile_census: Optional[dict] = None,
+    cache: Optional[dict] = None,
 ) -> dict:
     """Assemble the manifest dict from the scheduler summary + metrics.
 
@@ -85,6 +86,10 @@ def build_manifest(
         # e2e_cold_compiles / e2e_distinct_programs fields and the
         # tools/compile_census.py gate read
         "compile_census": compile_census,
+        # incremental-recompute record (anovos_tpu.cache): store root,
+        # per-run hits/misses/restore wall, resumed frontier — present only
+        # when ANOVOS_TPU_CACHE was set for the run
+        "cache": cache,
         "trace_path": trace_path,
         "backend": backend,
         "generated_unix": round(
@@ -109,8 +114,10 @@ def load_manifest(path: str) -> dict:
 
 
 # fields whose values are wall-clock/duration-derived and therefore differ
-# between two otherwise-identical runs
-_VOLATILE_NODE_FIELDS = ("start_s", "end_s", "dur_s", "queue_wait_s", "thread")
+# between two otherwise-identical runs ("cached" depends on STORE history:
+# the same run misses cold and hits warm)
+_VOLATILE_NODE_FIELDS = ("start_s", "end_s", "dur_s", "queue_wait_s", "thread",
+                         "cached")
 _VOLATILE_TOP_FIELDS = (
     "generated_unix", "block_seconds", "trace_path", "backend",
     # the critical path is the longest chain BY MEASURED DURATION — two
@@ -119,6 +126,8 @@ _VOLATILE_TOP_FIELDS = (
     # compile counts depend on PROCESS history (a warm in-process rerun
     # compiles nothing) — like the op_ metric families, not run identity
     "compile_census",
+    # hit/miss split depends on cache-store history, not run identity
+    "cache",
 )
 
 
@@ -134,7 +143,7 @@ def stable_view(manifest: dict) -> dict:
     out = {k: v for k, v in manifest.items() if k not in _VOLATILE_TOP_FIELDS}
     sched = dict(out.get("scheduler") or {})
     for k in ("wall_s", "serial_s", "critical_path_s", "parallel_speedup",
-              "critical_path"):
+              "critical_path", "cache"):
         sched.pop(k, None)
     sched["nodes"] = {
         name: {k: v for k, v in node.items() if k not in _VOLATILE_NODE_FIELDS}
@@ -143,15 +152,21 @@ def stable_view(manifest: dict) -> dict:
     out["scheduler"] = sched
     metrics = {}
     for name, m in (out.get("metrics") or {}).items():
-        if name.startswith("op_") or name.startswith("device_") or name.startswith("xla_"):
+        if (name.startswith("op_") or name.startswith("device_")
+                or name.startswith("xla_") or name.startswith("cache_")):
             # compile-cache state (op_compile vs op_execute/op_cache_hit)
             # depends on PROCESS history — a warm in-process rerun shifts
             # families even though the run is identical; device-memory
-            # gauges depend on the backend.  Neither is run identity.
+            # gauges depend on the backend; cache_ families depend on
+            # STORE history.  None of them is run identity.
             continue
-        keep_values = name in (
-            "rows_ingested_total", "bytes_written_total", "artifact_writes_total"
-        )
+        # rows_ingested is the one data-volume counter that is pure run
+        # identity: ingest always executes.  bytes_written/artifact_writes
+        # stopped qualifying when incremental recompute landed — a node
+        # restored from the cache writes through neither counter, so their
+        # VALUES differ between a populate run and a warm re-run of the
+        # identical config; only the series names remain identity.
+        keep_values = name == "rows_ingested_total"
         metrics[name] = {
             "type": m.get("type"),
             "series": (m.get("series") if keep_values

@@ -21,6 +21,7 @@ each distinct label combination is an independent series.
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
@@ -31,6 +32,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "get_metrics",
+    "record_cache_stats",
     "record_device_memory",
 ]
 
@@ -252,3 +254,22 @@ def record_device_memory(registry: Optional[MetricsRegistry] = None) -> None:
     if peak is not None:
         reg.gauge("device_peak_bytes",
                   "allocator-reported peak device memory").set_max(float(peak))
+
+
+def record_cache_stats(store, registry: Optional[MetricsRegistry] = None) -> None:
+    """Record the incremental-recompute store's footprint (total bytes on
+    disk, committed node entries) as gauges — the companion of
+    :func:`record_device_memory` for the ``anovos_tpu.cache`` subsystem.
+    ``store`` is a ``CacheStore`` or ``None`` (no-op); never raises."""
+    if store is None:
+        return
+    reg = registry or _REGISTRY
+    try:
+        n_nodes = sum(1 for f in os.listdir(store.nodes_dir) if f.endswith(".json"))
+        reg.gauge("cache_store_bytes",
+                  "on-disk size of the node-result cache store"
+                  ).set(float(store.total_bytes()))
+        reg.gauge("cache_store_nodes",
+                  "committed node entries in the cache store").set(float(n_nodes))
+    except Exception:
+        return

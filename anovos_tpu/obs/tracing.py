@@ -171,6 +171,11 @@ class Tracer:
                 "pid": pid,
                 "tid": sp.tid,
             }
+            if sp.cat == "cache":
+                # cache-restore spans on worker lanes render in a fixed
+                # distinct color, so a warm run's restored-vs-executed mix
+                # is visible at a glance in Perfetto
+                ev["cname"] = "thread_state_runnable"
             if sp.dur_ns:
                 ev["dur"] = sp.dur_ns / 1e3
             else:

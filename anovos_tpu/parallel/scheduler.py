@@ -37,6 +37,20 @@ Design properties:
   on) for the Chrome-trace export, and books wall/queue-wait time into the
   process metrics registry (``node_wall_seconds``,
   ``node_queue_wait_seconds``) that feeds the run manifest.
+* **Incremental recompute.**  A node registered with a
+  :class:`~anovos_tpu.cache.NodeCachePolicy` gets a fingerprint — its
+  policy's key material folded with the fingerprints of the nodes it reads
+  through RAW edges (registration order is topological, so dep
+  fingerprints always exist when ``add()`` runs).  With a
+  :class:`~anovos_tpu.cache.CacheStore` attached, ``_execute`` consults
+  the store first: on a hit the node's committed artifacts are restored
+  (copy from the content-addressed store, a ``cache:restore`` span on the
+  worker lane) and the node is marked done WITHOUT executing; on a miss
+  the body runs inside an artifact-capture recorder and its created files
+  are committed atomically afterwards.  Cache failures never fail the
+  run — a broken restore falls back to executing, a broken commit logs
+  and continues.  A node whose RAW dep has no fingerprint is uncacheable
+  (its inputs are unidentifiable), as is any node without a policy.
 
 Caveat: concurrent mode must only run device work against a SINGLE-device
 runtime.  On a multi-device mesh, two concurrently dispatched programs that
@@ -91,6 +105,7 @@ class Node:
     __slots__ = (
         "name", "fn", "reads", "writes", "on_error", "deps", "dependents",
         "pending", "state", "start", "end", "ready", "thread", "error",
+        "cache", "fingerprint", "cached",
     )
 
     def __init__(self, name: str, fn: Callable[[], None], reads, writes, on_error: str):
@@ -107,6 +122,9 @@ class Node:
         self.ready = 0.0            # when the last dep finished (queue-wait origin)
         self.thread = ""
         self.error: Optional[BaseException] = None
+        self.cache = None           # NodeCachePolicy (or None: always execute)
+        self.fingerprint: Optional[str] = None
+        self.cached = False         # True when this run restored instead of ran
 
     @property
     def queue_wait(self) -> float:
@@ -119,12 +137,16 @@ class Node:
 class DagScheduler:
     """Register nodes with resource reads/writes, then ``run()`` them."""
 
-    def __init__(self, name: str = "dag"):
+    def __init__(self, name: str = "dag", cache_store=None, journal=None):
         self.name = name
         self._nodes: List[Node] = []
         self._by_name: Dict[str, Node] = {}
         self._last_writer: Dict[str, Node] = {}
         self._readers_since_write: Dict[str, List[Node]] = {}
+        self.cache_store = cache_store   # anovos_tpu.cache.CacheStore | None
+        self.journal = journal           # anovos_tpu.cache.RunJournal | None
+        self._cache_lock = threading.Lock()
+        self._cache_stats = {"hits": 0, "misses": 0, "restore_s": 0.0}
 
     # -- registration ----------------------------------------------------
     def add(
@@ -134,6 +156,7 @@ class DagScheduler:
         reads: Iterable[str] = (),
         writes: Iterable[str] = (),
         on_error: str = "raise",
+        cache=None,
     ) -> Node:
         """Register ``fn`` as node ``name``.
 
@@ -141,17 +164,24 @@ class DagScheduler:
         external input (immediately available) — mirroring the sequential
         runner, where a consumer registered before its producer would also
         find only whatever pre-exists on disk.
+
+        ``cache`` (a :class:`~anovos_tpu.cache.NodeCachePolicy`) makes the
+        node cacheable: its fingerprint is the policy's key material folded
+        with the fingerprints of its RAW-edge producers.
         """
         if on_error not in ("raise", "continue"):
             raise ValueError(f"on_error must be 'raise' or 'continue', got {on_error!r}")
         if name in self._by_name:
             raise ValueError(f"duplicate node name {name!r}")
         node = Node(name, fn, reads, writes, on_error)
+        node.cache = cache
         deps: "dict[int, Node]" = {}  # id -> Node, insertion-ordered, deduped
+        raw_deps: "dict[int, Node]" = {}  # the content-carrying subset
         for r in node.reads:
             w = self._last_writer.get(r)
             if w is not None:
                 deps[id(w)] = w  # read-after-write
+                raw_deps[id(w)] = w
         for w in node.writes:
             prev = self._last_writer.get(w)
             if prev is not None:
@@ -168,6 +198,15 @@ class DagScheduler:
         for w in node.writes:
             self._last_writer[w] = node
             self._readers_since_write[w] = []
+        raw_deps.pop(id(node), None)
+        if cache is not None:
+            # fingerprint = key material ⊕ RAW-producer fingerprints; a
+            # producer without one makes this node's inputs unidentifiable
+            dep_fps = [d.fingerprint for d in raw_deps.values()]
+            if all(fp is not None for fp in dep_fps):
+                from anovos_tpu.cache import digest
+
+                node.fingerprint = digest(cache.key_material, *sorted(dep_fps))
         self._nodes.append(node)
         self._by_name[name] = node
         return node
@@ -215,7 +254,8 @@ class DagScheduler:
                 queue_wait_s=round(node.queue_wait, 4),
                 scheduler=self.name,
             ):
-                node.fn()
+                if not self._try_restore(node):
+                    self._run_body(node)
             node.state = "done"
         except BaseException as e:
             node.error = e
@@ -234,6 +274,87 @@ class DagScheduler:
             reg.histogram("node_queue_wait_seconds",
                           "ready-to-start wait behind the worker pool"
                           ).observe(node.queue_wait, node=node.name)
+
+    # -- cache ------------------------------------------------------------
+    def _try_restore(self, node: Node) -> bool:
+        """Cache hit: restore the node's committed artifacts and report
+        True (the body is skipped).  Any restore failure logs and reports
+        False — executing is always a safe fallback."""
+        if self.cache_store is None or node.fingerprint is None:
+            return False
+        manifest = self.cache_store.lookup(node.fingerprint)
+        if manifest is None:
+            return False
+        from anovos_tpu.obs import get_metrics, get_tracer
+
+        t0 = time.monotonic()
+        try:
+            with get_tracer().span(f"cache:restore:{node.name}", cat="cache",
+                                   fingerprint=node.fingerprint[:12],
+                                   files=len(manifest.get("files", ()))):
+                n_files = self.cache_store.restore(manifest)
+                if node.cache.on_hit is not None:
+                    pdir = (self.cache_store.payload_dir(node.fingerprint)
+                            if manifest.get("payload") else None)
+                    node.cache.on_hit(pdir)
+        except Exception:
+            logger.exception("cache restore for node %r failed; executing", node.name)
+            return False
+        restore_s = time.monotonic() - t0
+        node.cached = True
+        reg = get_metrics()
+        reg.counter("cache_hits_total", "scheduler nodes restored from cache"
+                    ).inc(node=node.name)
+        reg.histogram("cache_restore_seconds", "one node's artifact restore wall"
+                      ).observe(restore_s, node=node.name)
+        with self._cache_lock:
+            self._cache_stats["hits"] += 1
+            self._cache_stats["restore_s"] += restore_s
+        if self.journal is not None:
+            self.journal.append("node_restored", node=node.name,
+                                fp=node.fingerprint, files=n_files)
+        return True
+
+    def _run_body(self, node: Node) -> None:
+        """Execute the body; on a cacheable miss, capture created artifacts
+        and commit them (commit failure logs — the run's own outputs are
+        already on disk and must not be sacrificed to a cache error)."""
+        if self.cache_store is None or node.fingerprint is None:
+            node.fn()
+            return
+        from anovos_tpu.cache import capture
+        from anovos_tpu.obs import get_metrics
+
+        get_metrics().counter("cache_misses_total",
+                              "scheduler nodes executed (no cache entry)"
+                              ).inc(node=node.name)
+        with self._cache_lock:
+            self._cache_stats["misses"] += 1
+        if self.journal is not None:
+            self.journal.append("node_begin", node=node.name, fp=node.fingerprint)
+        rec = capture.Recorder()
+        try:
+            with capture.recording(rec):
+                node.fn()
+        except BaseException:
+            if self.journal is not None:
+                self.journal.append("node_failed", node=node.name, fp=node.fingerprint)
+            raise
+        try:
+            if node.cache.flush is not None and rec.keys:
+                # the node's queued async writes must land before commit
+                node.cache.flush(sorted(rec.keys))
+            manifest = self.cache_store.commit(
+                node.fingerprint, node.name, rec.paths,
+                payload_write=node.cache.payload_write,
+            )
+            if self.journal is not None:
+                self.journal.append("node_commit", node=node.name,
+                                    fp=node.fingerprint,
+                                    files=len(manifest.get("files", ())))
+        except Exception:
+            logger.exception("cache commit for node %r failed; run continues uncached",
+                             node.name)
 
     def _run_sequential(self) -> None:
         for node in self._nodes:
@@ -345,6 +466,8 @@ class DagScheduler:
             chain.reverse()
         else:
             cp_len = 0.0
+        with self._cache_lock:
+            cache_stats = dict(self._cache_stats)
         return {
             "mode": mode,
             "workers": workers,  # the pool width this run actually used
@@ -353,6 +476,13 @@ class DagScheduler:
             "critical_path_s": round(cp_len, 4),
             "parallel_speedup": round(serial / wall_s, 3) if wall_s > 0 else 0.0,
             "critical_path": chain,
+            "cache": {
+                "enabled": self.cache_store is not None,
+                "hits": cache_stats["hits"],
+                "misses": cache_stats["misses"],
+                "restore_s": round(cache_stats["restore_s"], 4),
+                "uncacheable": sum(1 for n in self._nodes if n.fingerprint is None),
+            },
             "nodes": {
                 n.name: {
                     "start_s": round(n.start - origin, 4) if n.end else None,
@@ -361,6 +491,7 @@ class DagScheduler:
                     "queue_wait_s": round(n.queue_wait, 4) if n.end else None,
                     "thread": n.thread,
                     "state": n.state,
+                    "cached": n.cached,
                     "deps": [d.name for d in n.deps],
                 }
                 for n in self._nodes

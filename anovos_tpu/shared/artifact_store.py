@@ -16,15 +16,18 @@ being silent no-ops without scattering cloud commands through the modules:
 
 ``for_run_type`` resolves the store; third-party stores register with
 ``register_store`` (or ``ANOVOS_ARTIFACT_STORE=module:Class`` for an
-out-of-tree default override).  Cloud stores shell out to the same CLIs the
+out-of-tree default override).  Cloud stores invoke the same CLIs the
 reference uses (aws/azcopy) — no SDK dependency — and raise loudly when the
-CLI is absent rather than silently keeping artifacts local.
+CLI is absent rather than silently keeping artifacts local.  Commands are
+built as ARGV LISTS and executed without a shell: a dataset path containing
+spaces, globs or metacharacters is a single operand by construction, so it
+can neither break the copy nor inject a command (the reference interpolates
+raw paths into ``os.system`` strings).
 """
 
 from __future__ import annotations
 
 import os
-import shlex
 import subprocess
 import threading
 from typing import Callable, Dict, List, Type
@@ -101,37 +104,36 @@ class _ShellStore(ArtifactStore):
         digest = hashlib.sha1(p.encode()).hexdigest()[:8]
         return os.path.join(self.staging_root, f"{tail}-{digest}")
 
-    def _run(self, cmd: str) -> None:
-        subprocess.check_output(["bash", "-c", cmd])
+    def _run(self, argv: List[str]) -> None:
+        """Execute one CLI command.  ``argv`` is a list — there is NO shell
+        between us and the binary, so operands with spaces/metacharacters
+        are inert data (the quoting bug class cannot exist)."""
+        subprocess.check_output(argv)
 
 
 class S3Store(_ShellStore):
-    """emr: ``aws s3 cp`` shell-outs (reference report_preprocessing.py:97-105)."""
+    """emr: ``aws s3 cp`` invocations (reference report_preprocessing.py:97-105)."""
 
     name = "emr"
 
     def push(self, local_file: str, dest_dir: str) -> None:
         if not _is_remote(dest_dir):
             return
-        self._run(
-            f"aws s3 cp {shlex.quote(local_file)} "
-            f"{shlex.quote(dest_dir.rstrip('/') + '/')}"
-        )
+        self._run(["aws", "s3", "cp", str(local_file),
+                   str(dest_dir).rstrip("/") + "/"])
 
     def pull(self, src: str, local_file: str) -> str:
         if not _is_remote(src):
             return str(src)
-        self._run(f"aws s3 cp {shlex.quote(src)} {shlex.quote(local_file)}")
+        self._run(["aws", "s3", "cp", str(src), str(local_file)])
         return local_file
 
     def pull_dir(self, src_dir: str, local_dir: str) -> str:
         if not _is_remote(src_dir):
             return str(src_dir)
         os.makedirs(local_dir, exist_ok=True)
-        self._run(
-            f"aws s3 cp --recursive {shlex.quote(src_dir.rstrip('/') + '/')} "
-            f"{shlex.quote(local_dir)}"
-        )
+        self._run(["aws", "s3", "cp", "--recursive",
+                   str(src_dir).rstrip("/") + "/", str(local_dir)])
         return local_dir
 
 
@@ -155,16 +157,12 @@ class AzureStore(_ShellStore):
         if not _is_remote(dest_dir):
             return
         dest = self._https(dest_dir).rstrip("/") + "/"
-        self._run(
-            f"azcopy cp {shlex.quote(local_file)} {shlex.quote(dest + self.auth_key)}"
-        )
+        self._run(["azcopy", "cp", str(local_file), dest + self.auth_key])
 
     def pull(self, src: str, local_file: str) -> str:
         if not _is_remote(src):
             return str(src)
-        self._run(
-            f"azcopy cp {shlex.quote(self._https(src) + self.auth_key)} {shlex.quote(local_file)}"
-        )
+        self._run(["azcopy", "cp", self._https(src) + self.auth_key, str(local_file)])
         return local_file
 
     def pull_dir(self, src_dir: str, local_dir: str) -> str:
@@ -174,12 +172,13 @@ class AzureStore(_ShellStore):
         # '/*' copies the directory CONTENTS into local_dir — bare azcopy
         # places the source dir as a CHILD of the destination (unlike
         # 'aws s3 cp --recursive'), which would bury the staged CSVs one
-        # level too deep for the readers
-        self._run(
-            f"azcopy cp --recursive "
-            f"{shlex.quote(self._https(src_dir.rstrip('/')) + '/*' + self.auth_key)} "
-            f"{shlex.quote(local_dir)}"
-        )
+        # level too deep for the readers.  azcopy expands the '*' itself;
+        # with no shell in between it reaches the binary verbatim.
+        self._run([
+            "azcopy", "cp", "--recursive",
+            self._https(str(src_dir).rstrip("/")) + "/*" + self.auth_key,
+            str(local_dir),
+        ])
         return local_dir
 
 
@@ -230,16 +229,20 @@ class AsyncArtifactWriter:
             return self._pool
 
     @staticmethod
-    def _instrumented(key: str, fn: Callable, args, kwargs):
+    def _instrumented(key: str, fn: Callable, args, kwargs, recorder=None):
         """Run one write inside its span + metrics booking (the writer
-        thread's lane in the Chrome trace shows exactly what it wrote)."""
+        thread's lane in the Chrome trace shows exactly what it wrote).
+        ``recorder`` re-binds the SUBMITTING node's cache capture on this
+        writer thread, so queued writes stay attributed to their node."""
+        from anovos_tpu.cache import capture
         from anovos_tpu.obs import get_metrics, get_tracer
 
         import time as _time
 
         t0 = _time.perf_counter()
         with get_tracer().span(f"write:{key}", cat="artifact", key=key):
-            out = fn(*args, **kwargs)
+            with capture.recording(recorder):
+                out = fn(*args, **kwargs)
         reg = get_metrics()
         reg.counter("artifact_writes_total", "artifact writes queued+completed"
                     ).inc(key=key)
@@ -248,10 +251,17 @@ class AsyncArtifactWriter:
         return out
 
     def submit(self, key: str, fn: Callable, *args, **kwargs) -> None:
+        from anovos_tpu.cache import capture
+
+        recorder = capture.current()
+        if recorder is not None:
+            # book the key so the node's cache commit can barrier on it
+            recorder.add_key(key)
         if self._sync:
             self._instrumented(key, fn, args, kwargs)
             return
-        fut = self._ensure_pool().submit(self._instrumented, key, fn, args, kwargs)
+        fut = self._ensure_pool().submit(
+            self._instrumented, key, fn, args, kwargs, recorder)
         with self._lock:
             self._pending.setdefault(key, []).append(fut)
 

@@ -124,6 +124,22 @@ class Runtime:
         return b
 
 
+def compile_cache_dir() -> str:
+    """Resolve the persistent XLA compilation cache directory ('' = off).
+
+    ``ANOVOS_COMPILE_CACHE`` wins when set explicitly; otherwise the
+    incremental-recompute root (``ANOVOS_TPU_CACHE``, anovos_tpu.cache)
+    hosts the compile cache too at ``<root>/xla`` — one knob makes BOTH
+    the node results and the compiled programs persistent, so a cold
+    process pays compilation once per (program, jaxlib) instead of per
+    run.  The xla/ subtree is LRU-swept with the rest of the store
+    (``tools/cache_gc.py``)."""
+    cache_dir = os.environ.get("ANOVOS_COMPILE_CACHE", "")
+    if not cache_dir and os.environ.get("ANOVOS_TPU_CACHE", ""):
+        cache_dir = os.path.join(os.environ["ANOVOS_TPU_CACHE"], "xla")
+    return cache_dir
+
+
 def init_runtime(
     devices: Optional[Sequence[jax.Device]] = None,
     mesh_shape: Optional[tuple] = None,
@@ -153,7 +169,7 @@ def init_runtime(
     jax.config.update(
         "jax_default_matmul_precision", os.environ.get("ANOVOS_MATMUL_PRECISION", "highest")
     )
-    cache_dir = os.environ.get("ANOVOS_COMPILE_CACHE", "")
+    cache_dir = compile_cache_dir()
     if cache_dir:
         # persistent XLA compilation cache: pipeline stages produce many
         # distinct table shapes, and compilation dominates cold-run wall

@@ -57,11 +57,25 @@ from anovos_tpu.data_report.report_preprocessing import charts_to_objects, save_
 from anovos_tpu.data_transformer import transformers
 from anovos_tpu.drift_stability import drift_detector as ddetector
 from anovos_tpu.drift_stability import stability as dstability
+from anovos_tpu.cache import (
+    CacheStore,
+    NodeCachePolicy,
+    RunJournal,
+    base_material,
+    cache_root,
+    committed_fingerprints,
+    dataset_fingerprint,
+    node_fingerprint,
+    read_journal,
+)
+from anovos_tpu.cache import capture as cache_capture
 from anovos_tpu.obs import (
     build_manifest,
     compile_census,
+    config_hash,
     get_metrics,
     get_tracer,
+    record_cache_stats,
     record_device_memory,
     trace_destination,
     write_chrome_trace,
@@ -315,6 +329,52 @@ def _drift_source_matches_input(all_configs: dict) -> bool:
     return bool(src) and _clean_spec(src) == _clean_spec(all_configs.get("input_dataset"))
 
 
+def _uses_preexisting(cfg) -> bool:
+    """True when a config subtree loads pre-existing models/sources from
+    disk — state the cache key cannot see, so such nodes stay uncacheable
+    rather than risk a stale hit."""
+    if isinstance(cfg, dict):
+        for k, v in cfg.items():
+            if k in ("pre_existing_model", "pre_existing_source") and bool(v):
+                return True
+            if _uses_preexisting(v):
+                return True
+    elif isinstance(cfg, (list, tuple)):
+        return any(_uses_preexisting(v) for v in cfg)
+    return False
+
+
+def _slice_or_none(slice_: dict, *gate_cfgs) -> Optional[dict]:
+    """The cache slice, or None (uncacheable) when any gate config pulls
+    pre-existing on-disk state into the computation."""
+    if any(_uses_preexisting(g) for g in gate_cfgs):
+        return None
+    return slice_
+
+
+class _LazyTable:
+    """A df version restored from the cache, loaded on first access.
+
+    On a fully-cached run only the FINAL version is ever touched (by the
+    ``write_main`` save), so every intermediate spine checkpoint stays on
+    disk; an incremental run loads exactly the versions its re-executing
+    cone reads.  Resolution is lock-guarded — two fan-out nodes pinned to
+    the same restored version may race their first read."""
+
+    __slots__ = ("_path", "_table", "_lock")
+
+    def __init__(self, path: str):
+        self._path = path
+        self._table = None
+        self._lock = threading.Lock()
+
+    def get(self) -> Table:
+        with self._lock:
+            if self._table is None:
+                self._table = data_ingest.read_dataset(self._path, "parquet")
+            return self._table
+
+
 class _PipelineRun:
     """Per-run registrar: turns the YAML walk into scheduler nodes.
 
@@ -323,11 +383,22 @@ class _PipelineRun:
     position, so a later spine mutation can never leak backwards into a
     concurrently-running analyzer.  Versions are dropped once their last
     registered reader releases them, bounding peak memory to the live
-    working set instead of the whole version history."""
+    working set instead of the whole version history.
 
-    def __init__(self, sched: DagScheduler, writer: AsyncArtifactWriter, df0: Table):
+    With ``cache_base`` set (``ANOVOS_TPU_CACHE``), registrations that
+    pass a ``cache_slice`` get a :class:`NodeCachePolicy`: the slice is
+    the node's OWN config material, folded with the run base (version,
+    env knobs, dataset fingerprint, global paths) and, by the scheduler,
+    with RAW-dep fingerprints.  Spine nodes additionally checkpoint their
+    output df version into the store's payload dir so a cache hit can
+    skip the body yet still hand downstream nodes (and the final
+    ``write_main``) the table — lazily, via :class:`_LazyTable`."""
+
+    def __init__(self, sched: DagScheduler, writer: AsyncArtifactWriter, df0: Table,
+                 cache_base: Optional[str] = None):
         self.sched = sched
         self.writer = writer
+        self.cache_base = cache_base
         self._versions = {0: df0}
         self._planned_readers: dict = {}
         self._ver = 0
@@ -344,16 +415,42 @@ class _PipelineRun:
             if self._planned_readers[v] <= 0 and v != self._ver:
                 self._versions.pop(v, None)
 
+    def _resolve(self, v: int) -> Table:
+        df = self._versions[v]
+        if isinstance(df, _LazyTable):
+            df = df.get()
+        return df
+
     def current_df(self) -> Table:
-        return self._versions[self._ver]
+        return self._resolve(self._ver)
 
     def _track(self, writes) -> None:
         for w in writes:
             if w not in self.artifact_keys:
                 self.artifact_keys.append(w)
 
+    # -- cache wiring ------------------------------------------------------
+    def _policy(self, name, cache_slice, writes, payload_write=None, on_hit=None):
+        if self.cache_base is None or cache_slice is None:
+            return None
+        return NodeCachePolicy(
+            key_material=node_fingerprint(self.cache_base, name, cache_slice, writes),
+            flush=self.writer.wait,
+            payload_write=payload_write,
+            on_hit=on_hit,
+        )
+
+    def _save_df(self, v: int, payload_dir: str) -> None:
+        """Checkpoint a spine node's output version into the cache payload
+        (parquet through the pipeline's own writer/reader pair, so the
+        round trip has exactly the checkpoint path's tested semantics)."""
+        data_ingest.write_dataset(
+            self._resolve(v), os.path.join(payload_dir, "df"), "parquet",
+            {"mode": "overwrite"},
+        )
+
     # -- node registration -------------------------------------------------
-    def spine(self, name, fn, reads=(), writes=(), timed=None) -> None:
+    def spine(self, name, fn, reads=(), writes=(), timed=None, cache_slice=None) -> None:
         """``fn(df) -> df`` mutates the table: df version N → N+1."""
         v, out_v = self._ver, self._ver + 1
         self._ver = out_v
@@ -362,7 +459,7 @@ class _PipelineRun:
 
         def body():
             self.writer.wait(reads)
-            df_in = self._versions[v]
+            df_in = self._resolve(v)
             t0 = time.monotonic()
             df_out = fn(df_in)
             if timed:
@@ -370,11 +467,21 @@ class _PipelineRun:
             self._versions[out_v] = df_out if df_out is not None else df_in
             self._release(v)
 
+        def on_hit(payload_dir, v=v, out_v=out_v):
+            # skipped body: hand downstream the checkpointed output version
+            if payload_dir is None:  # entry committed without its df: unusable
+                raise RuntimeError("spine cache entry has no df payload")
+            self._versions[out_v] = _LazyTable(os.path.join(payload_dir, "df"))
+            self._release(v)
+
         self.sched.add(name, body, reads=(f"df:{v}",) + reads,
-                       writes=(f"df:{out_v}",) + tuple(writes))
+                       writes=(f"df:{out_v}",) + tuple(writes),
+                       cache=self._policy(name, cache_slice, writes,
+                                          payload_write=lambda d: self._save_df(out_v, d),
+                                          on_hit=on_hit))
         self._track(writes)
 
-    def fanout(self, name, fn, reads=(), writes=(), timed=None) -> None:
+    def fanout(self, name, fn, reads=(), writes=(), timed=None, cache_slice=None) -> None:
         """``fn(df)`` only reads the table: pinned to the current version."""
         v = self._ver
         self._claim(v)
@@ -382,18 +489,25 @@ class _PipelineRun:
 
         def body():
             self.writer.wait(reads)
-            df_in = self._versions[v]
+            df_in = self._resolve(v)
             t0 = time.monotonic()
             fn(df_in)
             if timed:
                 _log_block_time(timed, t0)
             self._release(v)
 
-        self.sched.add(name, body, reads=(f"df:{v}",) + reads, writes=tuple(writes))
+        self.sched.add(name, body, reads=(f"df:{v}",) + reads, writes=tuple(writes),
+                       cache=self._policy(name, cache_slice, writes,
+                                          on_hit=lambda _pdir, v=v: self._release(v)))
         self._track(writes)
 
 
-def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict] = None) -> None:
+def main(
+    all_configs: dict,
+    run_type: str = "local",
+    auth_key_val: Optional[dict] = None,
+    resume: bool = False,
+) -> None:
     global LAST_RUN_SUMMARY, LAST_MANIFEST_PATH
     start_main = time.monotonic()
     # per-run accounting: the metrics registry and trace buffer always
@@ -480,8 +594,21 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
         workers=int(os.environ.get("ANOVOS_TPU_WRITER_WORKERS", "2")),
         sync=(mode == "sequential"),
     )
-    sched = DagScheduler(name="workflow")
-    pipe = _PipelineRun(sched, writer, df)
+    # incremental recompute (anovos_tpu.cache): ANOVOS_TPU_CACHE=<dir> opts
+    # in.  Registrations below pass their config slice; the scheduler folds
+    # RAW-edge fingerprints and skips nodes whose committed results match.
+    cache_store = None
+    cache_base = None
+    cache_dir = cache_root()
+    if cache_dir:
+        cache_store = CacheStore(cache_dir)
+        cache_base = base_material(all_configs, run_type)
+        cache_capture.install_open_hook()
+    elif resume:
+        logger.warning("--resume requested but ANOVOS_TPU_CACHE is unset; "
+                       "nothing to resume from — executing every node")
+    sched = DagScheduler(name="workflow", cache_store=cache_store)
+    pipe = _PipelineRun(sched, writer, df, cache_base=cache_base)
 
     with mlflow_ctx:
         for key, args in all_configs.items():
@@ -493,7 +620,10 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                     )
                     return save(out, write_intermediate, "data_ingest/concatenate_dataset",
                                 reread=True, writer=writer)
-                pipe.spine("concatenate_dataset", _concat, timed="concatenate_dataset")
+                pipe.spine("concatenate_dataset", _concat, timed="concatenate_dataset",
+                           cache_slice={"concatenate_dataset": args, "dataset_fps": [
+                               dataset_fingerprint(args[k])
+                               for k in args if k not in ("method", "method_type")]})
                 continue
 
             if key == "join_dataset" and args is not None:
@@ -504,7 +634,10 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                     )
                     return save(out, write_intermediate, "data_ingest/join_dataset",
                                 reread=True, writer=writer)
-                pipe.spine("join_dataset", _join, timed="join_dataset")
+                pipe.spine("join_dataset", _join, timed="join_dataset",
+                           cache_slice={"join_dataset": args, "dataset_fps": [
+                               dataset_fingerprint(args[k])
+                               for k in args if k not in ("join_type", "join_cols")]})
                 continue
 
             if key == "timeseries_analyzer" and args is not None:
@@ -525,7 +658,8 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                             logger.exception("ts auto-detection failed; continuing with the raw table")
                             return df
                     pipe.spine("timeseries_analyzer/auto_detection", _ts_auto,
-                               writes=("report:ts_autodetect",), timed="timeseries_analyzer")
+                               writes=("report:ts_autodetect",), timed="timeseries_analyzer",
+                               cache_slice={"timeseries_analyzer": opt, "mode": "auto"})
                 if opt.get("inspection", False):
                     def _ts_inspect(df, opt=opt):
                         try:
@@ -541,7 +675,8 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                         except Exception:
                             logger.exception("ts inspection failed; continuing without ts analysis")
                     pipe.fanout("timeseries_analyzer/inspection", _ts_inspect,
-                                writes=("report:ts_inspection",), timed="timeseries_analyzer")
+                                writes=("report:ts_inspection",), timed="timeseries_analyzer",
+                                cache_slice={"timeseries_analyzer": opt, "mode": "inspect"})
                 continue
 
             if key == "geospatial_controller" and args is not None:
@@ -566,14 +701,16 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                         except Exception:
                             logger.exception("geospatial_analyzer failed; continuing without geo analysis")
                     pipe.fanout("geospatial_controller", _geo,
-                                writes=("report:geo",), timed="geospatial_controller")
+                                writes=("report:geo",), timed="geospatial_controller",
+                                cache_slice={"geospatial_controller": ga})
                 continue
 
             if key == "anovos_basic_report" and args is not None and args.get("basic_report", False):
                 def _basic(df, args=args):
                     anovos_basic_report(df, **args.get("report_args", {}), run_type=run_type, auth_key=auth_key)
                 pipe.fanout("anovos_basic_report", _basic,
-                            writes=("report:basic",), timed="Basic Report")
+                            writes=("report:basic",), timed="Basic Report",
+                            cache_slice={"anovos_basic_report": args})
                 continue
 
             if basic_report_flag:
@@ -593,7 +730,8 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                             save(df_stats, write_stats, "data_analyzer/stats_generator/" + m,
                                  reread=True, writer=writer, key=f"stats:{m}")
                     pipe.fanout(f"stats_generator/{m}", _stat,
-                                writes=(f"stats:{m}",), timed=f"stats_generator, {m}")
+                                writes=(f"stats:{m}",), timed=f"stats_generator, {m}",
+                                cache_slice={"metric": m, "metric_args": args["metric_args"]})
 
             if key == "quality_checker" and args is not None:
                 for subkey, value in args.items():
@@ -625,7 +763,11 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                         return df_out
                     pipe.spine(f"quality_checker/{subkey}", _qc,
                                reads=_stats_deps(all_configs, subkey),
-                               writes=(f"stats:{subkey}",), timed=f"quality_checker, {subkey}")
+                               writes=(f"stats:{subkey}",), timed=f"quality_checker, {subkey}",
+                               # the whole block: cross-subkey treatment flags
+                               # feed this node's stats_args invalidation
+                               cache_slice=_slice_or_none(
+                                   {"quality_checker": args}, value))
 
             if key == "association_evaluator" and args is not None:
                 for subkey, value in args.items():
@@ -648,9 +790,14 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                         else:
                             save(df_stats, write_stats, "data_analyzer/association_evaluator/" + subkey,
                                  reread=True, writer=writer, key=f"stats:{subkey}")
+                    assoc_slice = {subkey: value}
+                    if subkey == "correlation_matrix":
+                        assoc_slice["cat_to_num_transformer"] = all_configs.get(
+                            "cat_to_num_transformer")
                     pipe.fanout(f"association_evaluator/{subkey}", _assoc,
                                 reads=_stats_deps(all_configs, subkey),
-                                writes=(f"stats:{subkey}",), timed=f"{key}, {subkey}")
+                                writes=(f"stats:{subkey}",), timed=f"{key}, {subkey}",
+                                cache_slice=_slice_or_none(assoc_slice, value))
 
             if key == "drift_detector" and args is not None:
                 # one node body PER subkey (not a shared body branching on a
@@ -691,7 +838,14 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                                      reread=True, writer=writer, key="stats:drift_statistics")
                         pipe.fanout("drift_detector/drift_statistics", _drift_stats,
                                     writes=("stats:drift_statistics", "drift:model"),
-                                    timed=f"{key}, drift_statistics")
+                                    timed=f"{key}, drift_statistics",
+                                    # source files are a second input dataset:
+                                    # their stat signature joins the slice
+                                    cache_slice=_slice_or_none(
+                                        {"drift_statistics": value,
+                                         "source_fp": dataset_fingerprint(
+                                             value.get("source_dataset"))},
+                                        value))
                     else:
                         def _stability(df, value=value):
                             idfs = [ETL(value[k]) for k in value if k != "configs"]
@@ -710,9 +864,27 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                             else:
                                 save(df_stats, write_stats, "drift_detector/stability_index",
                                      reread=True, writer=writer, key="stats:stability_index")
+                        stab_cfg = value.get("configs") or {}
                         pipe.fanout("drift_detector/stability_index", _stability,
                                     writes=("stats:stability_index", "stats:stabilityIndex_metrics"),
-                                    timed=f"{key}, stability_index")
+                                    timed=f"{key}, stability_index",
+                                    # the metric paths are cross-RUN state (the
+                                    # computation appends to them): their current
+                                    # on-disk signature is part of the key, so a
+                                    # populated dir recomputes exactly like the
+                                    # uncached appending behavior would
+                                    cache_slice=_slice_or_none(
+                                        {"stability_index": value,
+                                         "dataset_fps": {
+                                             k: dataset_fingerprint(value[k])
+                                             for k in sorted(value) if k != "configs"},
+                                         "metric_path_fps": [
+                                             dataset_fingerprint(
+                                                 {"read_dataset": {"file_path": p}})
+                                             for p in (stab_cfg.get("appended_metric_path", ""),
+                                                       stab_cfg.get("existing_metric_path", ""))
+                                             if p]},
+                                        value))
 
             if key == "transformers" and args is not None:
                 for subkey, value in args.items():
@@ -733,7 +905,8 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                             )
                         pipe.spine(f"transformers/{subkey2}", _tf,
                                    reads=_stats_deps(all_configs, subkey2),
-                                   timed=f"{key}, {subkey2}")
+                                   timed=f"{key}, {subkey2}",
+                                   cache_slice=_slice_or_none({subkey2: value2}, value2))
 
             if key == "report_preprocessing" and args is not None:
                 for subkey, value in args.items():
@@ -752,7 +925,8 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                                               async_writer=writer, async_key="charts:objects")
                         pipe.fanout(f"report_preprocessing/{subkey}", _charts,
                                     reads=chart_reads, writes=("charts:objects",),
-                                    timed=f"{key}, {subkey}")
+                                    timed=f"{key}, {subkey}",
+                                    cache_slice={"charts_to_objects": value})
 
             if key == "report_generation" and args is not None:
                 # the report reads the whole master_path subtree: wait on
@@ -777,19 +951,48 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
         trace_dest = trace_destination(obs_dir)
         manifest_path = os.path.abspath(os.path.join(obs_dir, "obs", "run_manifest.json"))
 
+        journal = None
+        resumed_from = 0
+        if cache_store is not None:
+            journal_path = os.path.join(obs_dir, "obs", "run_journal.jsonl")
+            # the journal is append-only ACROSS runs: a killed run's
+            # committed frontier is still here when --resume re-runs
+            prior = committed_fingerprints(read_journal(journal_path))
+            if resume:
+                resumed_from = len(prior)
+                logger.info(
+                    "resume: journal at %s records %d previously committed "
+                    "node result(s); matching nodes will restore from %s",
+                    journal_path, resumed_from, cache_store.root)
+            journal = RunJournal(journal_path, writer)
+            journal.append("run_begin", config_hash=config_hash(all_configs),
+                           cache_root=cache_store.root, resume=bool(resume),
+                           executor=mode)
+            sched.journal = journal
+
         run_err = None
         try:
             summary = sched.run(mode=mode)
+            if journal is not None:
+                journal.append("run_end", hits=summary["cache"]["hits"],
+                               misses=summary["cache"]["misses"])
             # barrier BEFORE the metrics snapshot: every queued artifact
             # write has landed and booked its counters, so sequential-mode
             # manifests are deterministic run-to-run
             writer.drain()
             record_device_memory()
+            record_cache_stats(cache_store)
             manifest = build_manifest(
                 all_configs, summary, get_metrics().snapshot(),
                 run_type=run_type, block_times=block_times(),
                 trace_path=trace_dest and os.path.abspath(trace_dest),
                 compile_census=compile_census.census(since=census_mark),
+                cache={
+                    "enabled": cache_store is not None,
+                    "root": cache_store.root if cache_store else None,
+                    "resumed_from": resumed_from,
+                    **summary.get("cache", {}),
+                } if cache_store is not None else None,
             )
             # the manifest rides the same async write queue as every other
             # artifact; close() below drains it
@@ -804,6 +1007,22 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
                 if run_err is None:
                     raise
                 logger.exception("async artifact writes failed during aborted run")
+            if cache_store is not None:
+                cache_capture.uninstall_open_hook()
+                max_bytes = os.environ.get("ANOVOS_TPU_CACHE_MAX_BYTES", "")
+                if max_bytes:
+                    from anovos_tpu.cache.store import parse_bytes
+
+                    try:  # capacity bound: same LRU sweep as tools/cache_gc.py
+                        stats = cache_store.gc(parse_bytes(max_bytes))
+                        if stats["evicted_nodes"] or stats["evicted_xla_files"]:
+                            logger.info(
+                                "cache gc: %d node entr(ies) + %d xla file(s) "
+                                "evicted (%d -> %d bytes)",
+                                len(stats["evicted_nodes"]), stats["evicted_xla_files"],
+                                stats["before_bytes"], stats["after_bytes"])
+                    except Exception:
+                        logger.exception("cache gc failed; store left as-is")
             if trace_dest:
                 # export even on failure: the trace of an aborted run is
                 # exactly what the post-mortem needs
@@ -847,12 +1066,22 @@ def main(all_configs: dict, run_type: str = "local", auth_key_val: Optional[dict
     logger.info(f"execution time w/o report (in sec) = {round(time.monotonic() - start_main, 4)}")
 
 
-def run(config_path: str, run_type: str = "local", auth_key_val: Optional[dict] = None) -> None:
+def run(
+    config_path: str,
+    run_type: str = "local",
+    auth_key_val: Optional[dict] = None,
+    resume: bool = False,
+) -> None:
     """Entry (reference :873-888): load YAML → main.
 
     Tracing: the reference logs per-block wall times only (SURVEY.md §5);
     here ``ANOVOS_PROFILE=<dir>`` additionally wraps the run in a JAX
     profiler trace (xprof-compatible) for kernel-level timing.
+
+    ``resume=True`` (the CLI's ``--resume``) re-runs a killed config
+    against the same output directory: nodes whose results the journal /
+    cache store committed before the crash restore instead of executing.
+    Requires ``ANOVOS_TPU_CACHE`` (the entrypoints default it).
     """
     from anovos_tpu.shared.artifact_store import for_run_type
 
@@ -872,4 +1101,4 @@ def run(config_path: str, run_type: str = "local", auth_key_val: Optional[dict] 
     else:
         ctx = contextlib.nullcontext()
     with ctx:
-        main(all_configs, run_type, auth_key_val)
+        main(all_configs, run_type, auth_key_val, resume=resume)

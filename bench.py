@@ -370,7 +370,95 @@ def e2e_cold_warm() -> dict:
             "e2e_critical_path": " -> ".join(summary.get("critical_path", [])),
         })
         print("bench: " + workflow.DagScheduler.format_summary(summary), file=sys.stderr)
+    if os.environ.get("BENCH_CACHE", "1") == "1":
+        try:
+            result.update(e2e_cached_incremental())
+        except Exception as e:  # cache section must never sink the headline
+            result["e2e_cache_error"] = str(e)[-200:]
     return result
+
+
+def _cache_fields(label: str, cache: dict, wall_s: float) -> dict:
+    """Map one cached-sequence run's manifest cache section to bench JSON
+    fields.  The ``cached`` pass is the regression gate: 0 hits means the
+    cache silently stopped working, recorded as ``e2e_cache_error`` so the
+    round's record shows the breakage, not just a slower wall."""
+    out: dict = {}
+    if label == "cached":
+        out["e2e_cached_wall_s"] = wall_s
+        out["e2e_cache_hits"] = cache.get("hits", 0)
+        out["e2e_cache_misses"] = cache.get("misses", 0)
+        out["e2e_cache_restore_s"] = cache.get("restore_s")
+        if not cache.get("hits"):
+            out["e2e_cache_error"] = (
+                "0 cache hits on a fully-cached re-run — the "
+                "incremental-recompute cache is silently broken")
+    elif label == "incremental":
+        out["e2e_incremental_wall_s"] = wall_s
+        out["e2e_incremental_misses"] = cache.get("misses", 0)
+    return out
+
+
+def e2e_cached_incremental() -> dict:
+    """The incremental-recompute headline (anovos_tpu.cache): populate a
+    fresh cache (one warm in-process run), then measure a FULLY-CACHED
+    re-run (every analytic node restored; the "nothing changed" wall) and
+    an INCREMENTAL re-run with exactly one config block edited (only that
+    block's downstream cone re-executes).
+
+    ``e2e_cache_hits`` is the regression tripwire: 0 hits on the cached
+    re-run means the cache silently stopped working — reported loudly as
+    ``e2e_cache_error`` so the bench gate record shows it, not just a
+    quietly slower wall."""
+    import copy
+    import tempfile
+
+    import yaml
+
+    from anovos_tpu import workflow
+    from anovos_tpu.obs import load_manifest
+
+    out: dict = {}
+    cwd = os.getcwd()
+    prev_cache = os.environ.get("ANOVOS_TPU_CACHE")
+    with tempfile.TemporaryDirectory() as cache_dir, \
+            tempfile.TemporaryDirectory() as run_dir:
+        os.environ["ANOVOS_TPU_CACHE"] = os.path.join(cache_dir, "store")
+        try:
+            with open(E2E_CONFIG) as f:
+                cfg = yaml.safe_load(f)
+            # one-block edit for the incremental pass: IV bin count — a
+            # single fan-out node's cone (itself + report assembly)
+            cfg_inc = copy.deepcopy(cfg)
+            cfg_inc["association_evaluator"]["IV_calculation"][
+                "encoding_configs"]["bin_size"] = 12
+            inc_path = os.path.join(run_dir, "cfg_incremental.yaml")
+            with open(inc_path, "w") as f:
+                yaml.safe_dump(cfg_inc, f, sort_keys=False)
+            walls = {}
+            for label, cfg_path in (("populate", E2E_CONFIG),
+                                    ("cached", E2E_CONFIG),
+                                    ("incremental", inc_path)):
+                d = os.path.join(run_dir, label)
+                os.makedirs(d)
+                os.chdir(d)
+                try:
+                    t0 = time.perf_counter()
+                    workflow.run(cfg_path, "local")
+                    walls[label] = round(time.perf_counter() - t0, 1)
+                    man = load_manifest(workflow.LAST_MANIFEST_PATH)
+                finally:
+                    os.chdir(cwd)
+                fields = _cache_fields(label, man.get("cache") or {}, walls[label])
+                if "e2e_cache_error" in fields:
+                    print("bench: " + fields["e2e_cache_error"], file=sys.stderr)
+                out.update(fields)
+        finally:
+            if prev_cache is None:
+                os.environ.pop("ANOVOS_TPU_CACHE", None)
+            else:
+                os.environ["ANOVOS_TPU_CACHE"] = prev_cache
+    return out
 
 
 def measure_e2e() -> None:

@@ -20,8 +20,19 @@ _bp_spec.loader.exec_module(_bp)
 supervise_demo = _bp.supervise_demo
 
 if __name__ == "__main__":
+    # --resume: re-run a killed config against the same output directory;
+    # nodes whose results were committed to the cache store before the
+    # crash restore instead of executing (anovos_tpu.cache).  Resume needs
+    # a cache root — default one next to the outputs when unset, and set it
+    # BEFORE any jax/runtime import so the persistent XLA compile cache
+    # under the same root is wired too.
+    resume = "--resume" in sys.argv
+    if resume:
+        sys.argv = [a for a in sys.argv if a != "--resume"]
+        os.environ.setdefault("ANOVOS_TPU_CACHE", ".anovos_cache")
     if len(sys.argv) < 2:
-        sys.exit("usage: python main.py <config.yaml> [run_type] [auth_key_json]")
+        sys.exit("usage: python main.py <config.yaml> [run_type] "
+                 "[auth_key_json] [--resume]")
     # an unresponsive accelerator tunnel must not hang the CLI forever:
     # bounded backend probe + silence-based stall watchdog with a one-shot
     # CPU retry on stall (JAX_PLATFORMS=cpu runs unsupervised; a non-cpu
@@ -49,4 +60,4 @@ if __name__ == "__main__":
             auth_key_val = {"auth_key": sys.argv[3]}
     else:
         auth_key_val = {}
-    workflow.run(config_path, run_type, auth_key_val)
+    workflow.run(config_path, run_type, auth_key_val, resume=resume)

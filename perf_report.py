@@ -228,6 +228,13 @@ def bench_e2e() -> dict:
         "serial_s": r.get("e2e_serial_s"),
         "critical_path_s": r.get("e2e_critical_path_s"),
         "parallel_speedup": r.get("e2e_parallel_speedup"),
+        # incremental-recompute cache (anovos_tpu.cache): fully-cached and
+        # one-block-edited re-run walls + the hit count that gates silent
+        # cache regressions (bench.e2e_cached_incremental)
+        "cached_wall_s": r.get("e2e_cached_wall_s"),
+        "incremental_wall_s": r.get("e2e_incremental_wall_s"),
+        "cache_hits": r.get("e2e_cache_hits"),
+        "cache_error": r.get("e2e_cache_error"),
     }
 
 

@@ -1,0 +1,28 @@
+"""GC008 negative fixture: node bodies whose every input the cache key
+already sees — audited knobs, declared constants, slice-carried config."""
+
+import os
+
+DEFAULT_BINS = {"size": 10}  # ALL_CAPS: declared constant, exempt
+
+
+def save(data, cfg):
+    # audited knob: present in cache.fingerprint.KNOWN_ENV_KNOBS
+    if os.environ.get("ANOVOS_REREAD_FROM_DISK", "0") == "1":
+        return data
+    return data
+
+
+def register(sched, cfg, writer):
+    def _clean_body(df, cfg=cfg):
+        # params/closures are config-slice material, not hidden state
+        bins = cfg.get("bin_size", DEFAULT_BINS["size"])
+        return save(df, bins)
+
+    sched.add("stats/clean", _clean_body, reads=(), writes=())
+
+    def _unregistered_helper():
+        # env read OUTSIDE any registered node body: out of scope
+        return os.environ.get("SOME_TOOLING_ONLY_KNOB")
+
+    return _unregistered_helper

@@ -1,0 +1,30 @@
+"""GC008 positive fixture: node bodies reading inputs the cache key
+cannot see — unaudited env knobs, dynamic env names, mutable globals."""
+
+import os
+
+_runtime_state = {"sample_frac": 0.1}  # mutable module global, not ALL_CAPS
+
+
+def register(sched, cfg):
+    def _reads_unlisted_env(df):
+        # env knob absent from cache.fingerprint.KNOWN_ENV_KNOBS
+        frac = os.environ.get("TOTALLY_UNDECLARED_KNOB", "1.0")
+        return float(frac)
+
+    sched.add("env/unlisted", _reads_unlisted_env, reads=(), writes=())
+
+    def _reads_env_subscript(df):
+        return os.environ["ANOTHER_UNLISTED_KNOB"]
+
+    sched.add("env/subscript", _reads_env_subscript, reads=(), writes=())
+
+    def _reads_dynamic_env(df, which="X"):
+        return os.getenv(which)  # name unknowable statically
+
+    sched.add("env/dynamic", _reads_dynamic_env, reads=(), writes=())
+
+    def _reads_mutable_global(df):
+        return _runtime_state["sample_frac"]  # process state, key-invisible
+
+    sched.add("global/mutable", _reads_mutable_global, reads=(), writes=())

@@ -40,21 +40,36 @@ def test_invalid_run_type():
         ast.for_run_type("yarn")
 
 
-# ------------------------------------------------- shell-command shape ----
+# ---------------------------------------------------- CLI argv shape ----
+# The cloud stores build ARGV LISTS and execute them WITHOUT a shell — an
+# operand with spaces/metacharacters is inert data, so the reference's
+# quoting bug class (raw paths interpolated into os.system strings) cannot
+# exist.  These tests pin both the exact argv assembly and that hostile
+# paths stay single operands.
+
 def test_s3_store_commands(monkeypatch, tmp_path):
     cmds = []
     s = ast.for_run_type("emr")
     monkeypatch.setattr(s, "_run", cmds.append)
     s.push("stage/f.csv", "s3://bucket/out")
     s.pull("s3://bucket/cfg.yaml", "config.yaml")
-    s.push("stage/f.csv", "local/out")  # non-remote dest: no shell-out
+    s.push("stage/f.csv", "local/out")  # non-remote dest: no CLI invocation
     s.pull_dir("s3://bucket/master", str(tmp_path / "stage"))
     assert s.pull_dir("local/master", "x") == "local/master"  # non-remote passes through
     assert cmds == [
-        "aws s3 cp stage/f.csv s3://bucket/out/",
-        "aws s3 cp s3://bucket/cfg.yaml config.yaml",
-        f"aws s3 cp --recursive s3://bucket/master/ {tmp_path / 'stage'}",
+        ["aws", "s3", "cp", "stage/f.csv", "s3://bucket/out/"],
+        ["aws", "s3", "cp", "s3://bucket/cfg.yaml", "config.yaml"],
+        ["aws", "s3", "cp", "--recursive", "s3://bucket/master/", str(tmp_path / "stage")],
     ]
+
+
+def test_s3_store_hostile_paths_stay_single_operands(monkeypatch):
+    cmds = []
+    s = ast.for_run_type("emr")
+    monkeypatch.setattr(s, "_run", cmds.append)
+    evil = "stage/my data; rm -rf $(HOME) && echo *.csv"
+    s.push(evil, "s3://bucket/out dir")
+    assert cmds == [["aws", "s3", "cp", evil, "s3://bucket/out dir/"]]
 
 
 def test_azure_pull_dir_command(monkeypatch, tmp_path):
@@ -64,8 +79,11 @@ def test_azure_pull_dir_command(monkeypatch, tmp_path):
     s.pull_dir("wasbs://cont@acct.blob.core.windows.net/master", str(tmp_path / "stage"))
     # '/*' is load-bearing: bare azcopy would land master/ as a CHILD of the
     # staging dir, burying the CSVs one level too deep for the readers
+    # (azcopy expands the glob itself; no shell ever sees it)
     assert cmds == [
-        f"azcopy cp --recursive 'https://acct.blob.core.windows.net/cont/master/*?sig=TOKEN' {tmp_path / 'stage'}"
+        ["azcopy", "cp", "--recursive",
+         "https://acct.blob.core.windows.net/cont/master/*?sig=TOKEN",
+         str(tmp_path / "stage")],
     ]
 
 
@@ -74,11 +92,59 @@ def test_azure_store_commands(monkeypatch):
     s = ast.for_run_type("ak8s", auth_key="?sig=TOKEN")
     monkeypatch.setattr(s, "_run", cmds.append)
     s.push("stage/f.csv", "wasbs://cont@acct.blob.core.windows.net/out")
+    s.pull("wasbs://cont@acct.blob.core.windows.net/cfg.yaml", "config.yaml")
     # wasbs → https rewrite (reference utils.path_ak8s_modify) + SAS suffix,
-    # shell-quoted so no operand can be expanded/split by bash
+    # one argv element so no shell can expand/split it
     assert cmds == [
-        "azcopy cp stage/f.csv 'https://acct.blob.core.windows.net/cont/out/?sig=TOKEN'"
+        ["azcopy", "cp", "stage/f.csv",
+         "https://acct.blob.core.windows.net/cont/out/?sig=TOKEN"],
+        ["azcopy", "cp", "https://acct.blob.core.windows.net/cont/cfg.yaml?sig=TOKEN",
+         "config.yaml"],
     ]
+
+
+def test_shell_runner_has_no_shell(monkeypatch):
+    """_run executes the argv directly — no bash/sh wrapper layer."""
+    captured = {}
+
+    def fake_check_output(argv, **kw):
+        captured["argv"] = argv
+        return b""
+
+    monkeypatch.setattr(ast.subprocess, "check_output", fake_check_output)
+    s = ast.for_run_type("emr")
+    s.push("a file.csv", "s3://b/c")
+    assert captured["argv"][0] == "aws"  # the binary itself, not a shell
+    assert "a file.csv" in captured["argv"]
+
+
+def test_pull_dir_error_propagates(monkeypatch, tmp_path):
+    """A failing CLI copy surfaces as CalledProcessError to the caller —
+    a missing remote must never silently hand back an empty staging dir."""
+    import subprocess
+
+    def failing_run(argv):
+        raise subprocess.CalledProcessError(1, argv)
+
+    for run_type, remote in (("emr", "s3://bucket/master"),
+                             ("ak8s", "wasbs://c@a.blob.core.windows.net/m")):
+        s = ast.for_run_type(run_type, auth_key="?sig=T")
+        monkeypatch.setattr(s, "_run", failing_run)
+        with pytest.raises(subprocess.CalledProcessError):
+            s.pull_dir(remote, str(tmp_path / "stage"))
+        with pytest.raises(subprocess.CalledProcessError):
+            s.pull(remote + "/f.csv", str(tmp_path / "f.csv"))
+
+
+def test_databricks_map_edge_cases():
+    s = ast.for_run_type("databricks")
+    assert s._map("dbfs:/mnt/out") == "/dbfs/mnt/out"
+    assert s._map("dbfs:///mnt/out") == "/dbfs/mnt/out"   # redundant slashes collapse
+    assert s._map("dbfs:/") == "/dbfs/"
+    assert s._map("/already/local") == "/already/local"
+    assert s._map("s3://not-dbfs") == "s3://not-dbfs"     # foreign schemes untouched
+    # pull_dir/staging_dir ride the same mapping
+    assert s.pull_dir("dbfs:/mnt/stats", "ignored") == "/dbfs/mnt/stats"
 
 
 # ------------------------------------------- tmpdir-backed fake store ----
@@ -181,7 +247,8 @@ def test_workflow_run_pulls_remote_config(tmp_store, tmp_path, monkeypatch):
     with open(os.path.join(tmp_store.remote_root, "cfg.yaml"), "w") as f:
         f.write("{}")
     called = {}
-    monkeypatch.setattr(wf, "main", lambda cfgs, rt, ak: called.update(cfgs=cfgs, rt=rt))
+    monkeypatch.setattr(wf, "main",
+                        lambda cfgs, rt, ak, **kw: called.update(cfgs=cfgs, rt=rt))
     wf.run("rem://cfg.yaml", "faketype")
     assert called["rt"] == "faketype" and called["cfgs"] == {}
     assert os.path.exists(tmp_path / "config.yaml")

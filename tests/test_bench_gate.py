@@ -225,3 +225,22 @@ def test_steady_state_args_shapes():
     # histogram mass equals the (unpadded) row count per side
     assert num_h.sum(axis=1).tolist() == [150.0, 150.0]
     assert cat_h.sum() == 150.0
+
+
+def test_cache_gate_flags_zero_hits():
+    """The bench record must fail LOUDLY when the fully-cached re-run hits
+    nothing (a silently-broken cache otherwise just reads as a slower
+    warm wall)."""
+    import bench
+
+    ok = bench._cache_fields("cached", {"hits": 14, "misses": 0,
+                                        "restore_s": 0.1}, 0.5)
+    assert ok["e2e_cache_hits"] == 14 and "e2e_cache_error" not in ok
+
+    broken = bench._cache_fields("cached", {"hits": 0, "misses": 14}, 5.0)
+    assert "e2e_cache_error" in broken and broken["e2e_cache_hits"] == 0
+
+    inc = bench._cache_fields("incremental", {"hits": 13, "misses": 1}, 1.0)
+    assert inc == {"e2e_incremental_wall_s": 1.0, "e2e_incremental_misses": 1}
+    # populate pass contributes no fields
+    assert bench._cache_fields("populate", {"misses": 14}, 3.6) == {}

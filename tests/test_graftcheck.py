@@ -25,7 +25,8 @@ from tools.graftcheck import engine  # noqa: E402
 
 FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftcheck")
 PKG = os.path.join(REPO, "anovos_tpu")
-RULE_IDS = ["GC001", "GC002", "GC003", "GC004", "GC005", "GC006", "GC007"]
+RULE_IDS = ["GC001", "GC002", "GC003", "GC004", "GC005", "GC006", "GC007",
+            "GC008"]
 
 
 # -- the gate: repo scan is clean against the committed baseline ----------
@@ -116,7 +117,7 @@ def test_expected_positive_counts():
     """Pin the per-fixture finding counts so a silently-weakened rule fails
     loudly (update alongside deliberate fixture changes)."""
     expected = {"GC001": 5, "GC002": 4, "GC003": 6, "GC004": 3,
-                "GC005": 4, "GC006": 4, "GC007": 2}
+                "GC005": 4, "GC006": 4, "GC007": 2, "GC008": 4}
     for rule_id, n in expected.items():
         path = os.path.join(FIXTURES, f"{rule_id.lower()}_pos.py")
         hits = [f for f in scan([path]) if f.rule == rule_id]
@@ -173,6 +174,24 @@ def test_baseline_grandfathers_and_reports_stale():
 def test_rule_catalogue_complete():
     assert [r.id for r in all_rules()] == RULE_IDS
     assert all(r.title for r in all_rules())
+
+
+def test_gc008_knob_list_parsed_from_source():
+    """The audited env-knob list is read from cache/fingerprint.py's AST —
+    the rule and the fingerprint can never drift apart silently."""
+    from anovos_tpu.cache.fingerprint import KNOWN_ENV_KNOBS
+    from tools.graftcheck.rules.gc008_cache_key import known_env_knobs
+
+    assert tuple(known_env_knobs()) == tuple(KNOWN_ENV_KNOBS)
+
+
+def test_gc008_zero_findings_in_workflow():
+    """The acceptance contract for the cache subsystem: every env read
+    reachable from a scheduler node body in workflow.py names an audited
+    knob, so no node input is invisible to its cache key."""
+    wf = os.path.join(PKG, "workflow.py")
+    findings = [f for f in scan([wf]) if f.rule == "GC008"]
+    assert not findings, "\n".join(f.render() for f in findings)
 
 
 def test_cli_exits_zero_on_repo():

@@ -8,4 +8,5 @@ from tools.graftcheck.rules import (  # noqa: F401  (import = registration)
     gc005_global_mutation,
     gc006_effect_contract,
     gc007_no_print,
+    gc008_cache_key,
 )

@@ -1,0 +1,191 @@
+"""GC008 — cache-key completeness for scheduler node bodies.
+
+The incremental-recompute cache (``anovos_tpu.cache``) treats a node's
+artifacts as a pure function of (dataset fingerprint, config slice, code
+version, upstream fingerprints, audited env knobs).  That soundness claim
+dies silently the day a node body reads an input the key cannot see: an
+environment variable missing from ``fingerprint.KNOWN_ENV_KNOBS``, or a
+mutable module global whose value varies between processes.  Either one
+makes two runs with identical fingerprints produce different artifacts —
+a WRONG cache hit, the worst failure mode a cache can have.
+
+This rule cross-checks every scheduler registration's resolved body
+(``pipe.spine`` / ``pipe.fanout`` / ``sched.add``, plus same-file callees
+one level deep — the ``save``/``stats_args`` helpers node bodies route
+through):
+
+* ``os.environ[...]`` / ``os.environ.get`` / ``os.getenv`` reads whose
+  literal name is NOT in ``anovos_tpu/cache/fingerprint.py``'s
+  ``KNOWN_ENV_KNOBS`` are flagged — add the knob to the audited list (it
+  then folds into every fingerprint) or baseline with a justification
+  that it cannot change artifacts;
+* env reads with a non-literal name are flagged as unverifiable;
+* loads of module-level MUTABLE globals (same detection as GC005's
+  mutation tracking) are flagged unless the name is ALL_CAPS — the
+  repo's declared-constant convention.
+
+Config values, function parameters and closure variables of the
+registering function are fine: they are exactly what the config slice
+hashes.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftcheck.engine import ROOT
+from tools.graftcheck.jaxmodel import attr_chain, call_chain
+from tools.graftcheck.registry import FileContext, Rule, register
+from tools.graftcheck.rules.gc005_global_mutation import _module_mutable_globals
+
+_REGISTRAR_ATTRS = {"spine", "fanout", "add"}
+
+# mirror of fingerprint.KNOWN_ENV_KNOBS for standalone-tool checkouts;
+# the live list is parsed from the source so the two cannot drift silently
+_FALLBACK_KNOBS = (
+    "ANOVOS_MATMUL_PRECISION",
+    "ANOVOS_REPLICATE_MAX_BYTES",
+    "ANOVOS_REREAD_FROM_DISK",
+    "ANOVOS_SHAPE_BUCKETS",
+)
+
+_knobs_cache: Optional[Tuple[str, ...]] = None
+
+
+def known_env_knobs() -> Tuple[str, ...]:
+    """The audited knob list, parsed from cache/fingerprint.py's AST."""
+    global _knobs_cache
+    if _knobs_cache is not None:
+        return _knobs_cache
+    path = os.path.join(ROOT, "anovos_tpu", "cache", "fingerprint.py")
+    knobs: Tuple[str, ...] = _FALLBACK_KNOBS
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in tree.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "KNOWN_ENV_KNOBS"
+                            for t in node.targets)
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                knobs = tuple(
+                    e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+                break
+    except OSError:
+        pass
+    _knobs_cache = knobs
+    return knobs
+
+
+def _env_read(node: ast.AST) -> Optional[Tuple[Optional[str], ast.AST]]:
+    """(env var name | None-if-dynamic, anchor node) for an environ read."""
+    if isinstance(node, ast.Call):
+        chain = call_chain(node)
+        if chain in ("os.environ.get", "os.getenv", "environ.get", "getenv"):
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                return node.args[0].value, node
+            return None, node
+    if isinstance(node, ast.Subscript) and attr_chain(node.value) in ("os.environ", "environ"):
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            return sl.value, node
+        return None, node
+    return None
+
+
+def _registration_bodies(ctx: FileContext) -> Iterable[Tuple[str, ast.FunctionDef]]:
+    """(node name hint, resolved body def) for each scheduler registration."""
+    defs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, node)
+    for call in ast.walk(ctx.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _REGISTRAR_ATTRS):
+            continue
+        if len(call.args) < 2:
+            continue
+        kwargs = {kw.arg for kw in call.keywords}
+        if call.func.attr == "add" and not ({"reads", "writes", "cache"} & kwargs):
+            continue  # not a scheduler registration (e.g. set.add)
+        fn_arg = call.args[1]
+        if isinstance(fn_arg, ast.Name) and fn_arg.id in defs:
+            yield fn_arg.id, defs[fn_arg.id]
+
+
+@register
+class CacheKeyCompletenessRule(Rule):
+    id = "GC008"
+    title = "node-body inputs invisible to the cache key (env knobs, mutable globals)"
+
+    def check(self, ctx: FileContext):
+        knobs = set(known_env_knobs())
+        mutable_globals = _module_mutable_globals(ctx.tree)
+        defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                defs.setdefault(node.name, node)
+
+        seen: Set[Tuple] = set()
+        for body_name, body in _registration_bodies(ctx):
+            # the body plus same-file callees one level deep — the helper
+            # layer (save/stats_args) node bodies route their effects through
+            funcs: List[ast.FunctionDef] = [body]
+            for sub in ast.walk(body):
+                if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                        and sub.func.id in defs and defs[sub.func.id] is not body):
+                    callee = defs[sub.func.id]
+                    if callee not in funcs:
+                        funcs.append(callee)
+            local_names = set()
+            for fn in funcs:
+                a = fn.args
+                for arg in a.posonlyargs + a.args + a.kwonlyargs:
+                    local_names.add(arg.arg)
+            for fn in funcs:
+                for sub in ast.walk(fn):
+                    env = _env_read(sub)
+                    if env is not None:
+                        name, anchor = env
+                        if name is None:
+                            key = (ctx.relpath, ctx.qualname(anchor), "dyn")
+                            if key not in seen:
+                                seen.add(key)
+                                yield ctx.finding(
+                                    self.id, anchor,
+                                    f"node body {body_name!r} reads an environment "
+                                    "variable through a NON-LITERAL name — the cache "
+                                    "key cannot audit it; use a literal knob name "
+                                    "from cache.fingerprint.KNOWN_ENV_KNOBS")
+                            continue
+                        if name not in knobs:
+                            key = (ctx.relpath, ctx.qualname(anchor), name)
+                            if key not in seen:
+                                seen.add(key)
+                                yield ctx.finding(
+                                    self.id, anchor,
+                                    f"node body {body_name!r} reads env knob {name!r} "
+                                    "which is NOT in cache.fingerprint.KNOWN_ENV_KNOBS "
+                                    "— an identical fingerprint can then restore "
+                                    "artifacts this knob would have changed; add it "
+                                    "to the audited list or justify in the baseline")
+                        continue
+                    if (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+                            and sub.id in mutable_globals
+                            and not sub.id.isupper()
+                            and sub.id not in local_names):
+                        key = (ctx.relpath, ctx.qualname(sub), sub.id)
+                        if key not in seen:
+                            seen.add(key)
+                            yield ctx.finding(
+                                self.id, sub,
+                                f"node body {body_name!r} reads mutable module "
+                                f"global {sub.id!r} — process state the cache key "
+                                "cannot see; thread it through the config slice or "
+                                "rename ALL_CAPS if it is a declared constant")
