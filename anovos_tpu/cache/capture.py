@@ -54,22 +54,39 @@ _WRITE_MODE_CHARS = frozenset("wax+")
 class Recorder:
     """One node's captured effects: created file paths + submitted async-
     writer keys.  Thread-safe — the node thread and writer threads book
-    into the same recorder concurrently."""
+    into the same recorder concurrently.
 
-    __slots__ = ("paths", "keys", "_lock")
+    ``appended`` is the subset of ``paths`` first seen through an
+    append-mode open: those files carried pre-existing content, so the
+    scheduler's retry path must NOT unlink them when discarding a failed
+    attempt's partial artifacts (deleting an appended-to metrics CSV
+    would destroy prior-run data, a worse outcome than the double-append
+    it is avoiding)."""
+
+    __slots__ = ("paths", "keys", "appended", "_lock")
 
     def __init__(self):
         self.paths: Set[str] = set()
         self.keys: Set[str] = set()
+        self.appended: Set[str] = set()
         self._lock = threading.Lock()
 
-    def add_path(self, path) -> None:
+    def add_path(self, path, mode: str = "w") -> None:
         try:
             p = os.path.abspath(os.fspath(path))
         except TypeError:  # non-path file argument (fd int, buffer)
             return
         with self._lock:
             self.paths.add(p)
+            if "a" in mode:
+                self.appended.add(p)
+
+    def discardable_paths(self) -> Set[str]:
+        """Paths safe to unlink when a failed attempt retries: everything
+        this attempt created, minus append-mode files (pre-existing
+        content) — re-execution overwrites write-mode files anyway."""
+        with self._lock:
+            return self.paths - self.appended
 
     def add_key(self, key: str) -> None:
         with self._lock:
@@ -116,7 +133,7 @@ def _hooked_open(file, mode="r", *args, **kwargs):
     if _WRITE_MODE_CHARS.intersection(mode):
         rec = current()
         if rec is not None and not isinstance(file, int):
-            rec.add_path(file)
+            rec.add_path(file, mode)
     return f
 
 

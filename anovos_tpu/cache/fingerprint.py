@@ -51,6 +51,13 @@ KNOWN_ENV_KNOBS = (
     "ANOVOS_REPLICATE_MAX_BYTES",
     "ANOVOS_REREAD_FROM_DISK",
     "ANOVOS_SHAPE_BUCKETS",
+    # the chaos harness can change artifacts (an injected fault that
+    # exhausts retries leaves a DEGRADED section with missing stats), so
+    # a chaos run must never share cache entries with a clean one.  The
+    # resilience PERFORMANCE knobs (ANOVOS_TPU_RETRIES, ANOVOS_TPU_DEGRADE,
+    # ANOVOS_TPU_HEALTH_TIMEOUT) stay off the list: successful recovery is
+    # byte-identical by contract (tests/test_resilience.py)
+    "ANOVOS_TPU_CHAOS",
 )
 
 

@@ -2,7 +2,15 @@
 
 One JSON line per lifecycle event — ``run_begin``, ``node_begin``
 (cache miss, about to execute), ``node_commit`` (artifacts committed to
-the store), ``node_restored`` (cache hit), ``node_failed``, ``run_end``.
+the store), ``node_restored`` (cache hit), ``node_failed``, ``run_end``;
+plus the resilience records (``anovos_tpu.resilience``): ``node_retry``
+(a failed attempt re-executes — ``kind`` distinguishes policy retries
+from the one escalated-timeout and the one post-failover re-execution),
+``node_timeout_escalated`` (watchdog raised a node's bound instead of
+aborting), ``node_degraded`` (retries exhausted; the section is marked,
+the run continues), and ``backend_failover`` (mid-run flip to CPU — the
+committed frontier above this line is exactly what the failover run
+kept).
 The journal is append-only ACROSS runs in the same output directory, so
 a killed run's committed frontier is still on disk when ``--resume``
 re-runs the config: resumed nodes hit the cache store (the store commit,

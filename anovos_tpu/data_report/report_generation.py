@@ -1309,6 +1309,37 @@ def anovos_report(
         _table_seq[0] = 0
     tabs: List[tuple] = []
 
+    # graceful degradation (anovos_tpu.resilience): analytics nodes that
+    # exhausted their retries did NOT abort the run — their sections are in
+    # the degradation registry, their CSVs are absent (per-section readers
+    # below already tolerate that), and the report leads with an explicit
+    # placeholder naming each one instead of silently thinner tabs.  Empty
+    # registry (every healthy run) adds nothing, keeping clean-run HTML
+    # byte-identical.
+    try:
+        from anovos_tpu.resilience import degraded_sections
+
+        degraded = degraded_sections()
+    except Exception:  # the report must render even if resilience is absent
+        logger.exception("degradation registry unavailable; rendering without placeholders")
+        degraded = {}
+    if degraded:
+        items = "".join(
+            f"<li><b>{escape(node)}</b> — {escape(reason)}</li>"
+            for node, reason in sorted(degraded.items())
+        )
+        tabs.append((
+            "Degraded Sections",
+            "<div class='anv-degraded'><p><b>"
+            f"{len(degraded)} analytics section(s) DEGRADED this run"
+            "</b>: the nodes below exhausted their retry budget and were "
+            "skipped rather than aborting the pipeline (see the run "
+            "manifest's <code>resilience</code> section and "
+            "<code>obs/run_journal.jsonl</code> for the failure record). "
+            "Their statistics are missing from the tabs that follow.</p>"
+            f"<ul>{items}</ul></div>",
+        ))
+
     tabs.append(
         (
             "Executive Summary",

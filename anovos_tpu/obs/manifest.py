@@ -50,6 +50,7 @@ def build_manifest(
     generated_unix: Optional[float] = None,
     compile_census: Optional[dict] = None,
     cache: Optional[dict] = None,
+    resilience: Optional[dict] = None,
 ) -> dict:
     """Assemble the manifest dict from the scheduler summary + metrics.
 
@@ -90,6 +91,12 @@ def build_manifest(
         # per-run hits/misses/restore wall, resumed frontier — present only
         # when ANOVOS_TPU_CACHE was set for the run
         "cache": cache,
+        # recovery record (anovos_tpu.resilience): retries by kind, timeout
+        # escalations, backend failovers, degraded sections (node -> failure
+        # reason), and — under the chaos harness — what was injected where.
+        # All zeros/empty on a healthy run; a transient fault leaves its
+        # trace here instead of killing the run
+        "resilience": resilience,
         "trace_path": trace_path,
         "backend": backend,
         "generated_unix": round(
@@ -117,7 +124,11 @@ def load_manifest(path: str) -> dict:
 # between two otherwise-identical runs ("cached" depends on STORE history:
 # the same run misses cold and hits warm)
 _VOLATILE_NODE_FIELDS = ("start_s", "end_s", "dur_s", "queue_wait_s", "thread",
-                         "cached")
+                         "cached",
+                         # recovery state depends on FAULT history (chaos
+                         # plan, real flakes, watchdog timing), never on what
+                         # the run computes
+                         "attempts", "escalated", "degraded")
 _VOLATILE_TOP_FIELDS = (
     "generated_unix", "block_seconds", "trace_path", "backend",
     # the critical path is the longest chain BY MEASURED DURATION — two
@@ -128,6 +139,8 @@ _VOLATILE_TOP_FIELDS = (
     "compile_census",
     # hit/miss split depends on cache-store history, not run identity
     "cache",
+    # retries/failovers/degradations depend on fault history, not identity
+    "resilience",
 )
 
 
@@ -143,7 +156,7 @@ def stable_view(manifest: dict) -> dict:
     out = {k: v for k, v in manifest.items() if k not in _VOLATILE_TOP_FIELDS}
     sched = dict(out.get("scheduler") or {})
     for k in ("wall_s", "serial_s", "critical_path_s", "parallel_speedup",
-              "critical_path", "cache"):
+              "critical_path", "cache", "resilience"):
         sched.pop(k, None)
     sched["nodes"] = {
         name: {k: v for k, v in node.items() if k not in _VOLATILE_NODE_FIELDS}

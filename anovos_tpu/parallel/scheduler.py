@@ -25,10 +25,38 @@ Design properties:
   node as ``"raise"`` and keeps the reference's best-effort try/except
   INSIDE the geo/ts node bodies (so both executors share one isolation
   path); ``"continue"`` is the generic policy for other graph authors.
-* **Hang watchdog.**  ``node_timeout`` bounds any single node; a stuck
-  node raises :class:`NodeTimeout` naming the block instead of deadlocking
-  the suite.  Workers are daemon threads so a wedged node cannot block
-  interpreter exit either.
+* **Hang watchdog with escalation.**  ``node_timeout`` bounds any single
+  node.  A node's FIRST expiry no longer aborts the run: the attempt is
+  interrupted (cooperatively, via the per-attempt ``interrupt`` event
+  that chaos hangs and library checkpoints can observe) and re-allowed
+  under a raised bound (``policy.timeout_factor`` — spine nodes get more
+  patience than read-only fan-out nodes).  Only when the ESCALATED bound
+  also expires does the node's error policy apply: ``NodeTimeout`` naming
+  the block (the legacy behavior), or degradation for retry+degrade
+  policies — the stuck worker thread is abandoned (daemon) and a
+  replacement spawned so the pool keeps its width.  Workers are daemon
+  threads so a wedged node cannot block interpreter exit either.
+* **Retry / failover / degradation** (``anovos_tpu.resilience``).
+  ``on_error="retry:N[:degrade|:continue]"`` re-executes a failed node up
+  to N times with exponential backoff + deterministic jitter; between
+  attempts the capture recorder's partial artifacts are discarded (append
+  -mode files excepted) and the WAL journal logs ``node_retry``.  Retry
+  soundness rides the same GC006-verified effect contracts the cache
+  keys ride: a node's writes are exactly its declared, capturable
+  artifacts, so re-execution overwrites rather than corrupts.  A failure
+  that looks backend-shaped (or an escalated timeout) triggers a bounded
+  in-run health probe; a wedged accelerator flips the runtime to CPU
+  ONCE (``resilience.failover``) and the in-flight frontier re-executes
+  from the last WAL-committed state — a mid-run wedge costs seconds, not
+  the run.  Re-execution of ANY kind (policy, timeout, failover) applies
+  only to retry-mode nodes: ``raise``/``continue`` registrations opted
+  out, and a failover still flips the backend for the rest of the run
+  while their own error follows the declared policy.  Exhausted
+  ``retry:N:degrade`` nodes mark themselves
+  ``degraded`` (registry + manifest + report placeholder) and the run
+  continues.  Every path is exercised by the seeded chaos harness
+  (``ANOVOS_TPU_CHAOS`` → ``resilience.chaos``), whose injection sites
+  the executor visits before each node body.
 * **Observability.**  Per-node start/end/thread spans are recorded and
   ``run()`` returns a summary with the measured critical path (longest
   dependency chain by wall time) and the parallel speedup — surfaced in the
@@ -67,7 +95,9 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional, Union
+
+from anovos_tpu.resilience.policy import ErrorPolicy, parse_policy
 
 logger = logging.getLogger("anovos_tpu.parallel.scheduler")
 
@@ -106,18 +136,24 @@ class Node:
         "name", "fn", "reads", "writes", "on_error", "deps", "dependents",
         "pending", "state", "start", "end", "ready", "thread", "error",
         "cache", "fingerprint", "cached",
+        # resilience state (anovos_tpu.resilience)
+        "policy", "attempts", "attempt_start", "interrupt",
+        "timeout_retried", "failover_retried", "failover_granted",
+        "escalated", "degraded", "abandoned", "rec",
     )
 
-    def __init__(self, name: str, fn: Callable[[], None], reads, writes, on_error: str):
+    def __init__(self, name: str, fn: Callable[[], None], reads, writes,
+                 on_error: Union[str, ErrorPolicy]):
         self.name = name
         self.fn = fn
         self.reads = tuple(reads)
         self.writes = tuple(writes)
-        self.on_error = on_error
+        self.policy = parse_policy(on_error)   # raises on an unknown mode
+        self.on_error = self.policy.describe()
         self.deps: List["Node"] = []
         self.dependents: List["Node"] = []
         self.pending = 0            # unfinished deps (concurrent mode)
-        self.state = "pending"      # pending|running|done|failed|failed-continued|skipped
+        self.state = "pending"      # pending|running|done|failed|failed-continued|degraded|skipped
         self.start = self.end = 0.0
         self.ready = 0.0            # when the last dep finished (queue-wait origin)
         self.thread = ""
@@ -125,6 +161,16 @@ class Node:
         self.cache = None           # NodeCachePolicy (or None: always execute)
         self.fingerprint: Optional[str] = None
         self.cached = False         # True when this run restored instead of ran
+        self.attempts = 0           # executions of the body this run
+        self.attempt_start = 0.0    # monotonic start of the CURRENT attempt
+        self.interrupt = threading.Event()  # per-attempt cooperative interrupt
+        self.timeout_retried = False   # the one escalated-bound re-execution
+        self.failover_retried = False  # the one post-failover re-execution
+        self.failover_granted = False  # watchdog flipped while this node ran
+        self.escalated = False      # watchdog raised this node's bound once
+        self.degraded = False       # retries exhausted; section marked degraded
+        self.abandoned = False      # watchdog gave up on a stuck attempt
+        self.rec = None             # the CURRENT attempt's capture recorder
 
     @property
     def queue_wait(self) -> float:
@@ -147,6 +193,9 @@ class DagScheduler:
         self.journal = journal           # anovos_tpu.cache.RunJournal | None
         self._cache_lock = threading.Lock()
         self._cache_stats = {"hits": 0, "misses": 0, "restore_s": 0.0}
+        self._res_lock = threading.Lock()
+        self._res_stats = {"retries": 0, "timeout_retries": 0,
+                           "failover_retries": 0, "timeout_escalations": 0}
 
     # -- registration ----------------------------------------------------
     def add(
@@ -155,7 +204,7 @@ class DagScheduler:
         fn: Callable[[], None],
         reads: Iterable[str] = (),
         writes: Iterable[str] = (),
-        on_error: str = "raise",
+        on_error: Union[str, ErrorPolicy] = "raise",
         cache=None,
     ) -> Node:
         """Register ``fn`` as node ``name``.
@@ -165,12 +214,19 @@ class DagScheduler:
         runner, where a consumer registered before its producer would also
         find only whatever pre-exists on disk.
 
+        ``on_error`` is ``"raise"``, ``"continue"``,
+        ``"retry:N[:degrade|:continue]"`` or an
+        :class:`~anovos_tpu.resilience.ErrorPolicy` (see
+        ``resilience.policy``).  Retry is only SOUND for nodes whose
+        effect contract is exact — declared ``writes`` matching the
+        body's real artifacts (graftcheck GC006 verifies this for the
+        workflow's registrations); re-execution then overwrites the
+        discarded partial outputs instead of corrupting shared state.
+
         ``cache`` (a :class:`~anovos_tpu.cache.NodeCachePolicy`) makes the
         node cacheable: its fingerprint is the policy's key material folded
         with the fingerprints of its RAW-edge producers.
         """
-        if on_error not in ("raise", "continue"):
-            raise ValueError(f"on_error must be 'raise' or 'continue', got {on_error!r}")
         if name in self._by_name:
             raise ValueError(f"duplicate node name {name!r}")
         node = Node(name, fn, reads, writes, on_error)
@@ -255,13 +311,18 @@ class DagScheduler:
                 scheduler=self.name,
             ):
                 if not self._try_restore(node):
-                    self._run_body(node)
-            node.state = "done"
+                    self._run_attempts(node)
+            if not node.abandoned:
+                node.state = "degraded" if node.degraded else "done"
         except BaseException as e:
             node.error = e
-            if node.on_error == "continue":
+            if node.policy.mode == "continue" or (
+                node.policy.mode == "retry"
+                and node.policy.on_exhausted == "continue"
+            ):
                 node.state = "failed-continued"
-                logger.exception("node %r failed; continuing (on_error=continue)", node.name)
+                logger.exception("node %r failed; continuing (on_error=%s)",
+                                 node.name, node.on_error)
             else:
                 node.state = "failed"
                 raise
@@ -274,6 +335,130 @@ class DagScheduler:
             reg.histogram("node_queue_wait_seconds",
                           "ready-to-start wait behind the worker pool"
                           ).observe(node.queue_wait, node=node.name)
+
+    # -- resilience --------------------------------------------------------
+    def _run_attempts(self, node: Node) -> None:
+        """Execute the node body under its error policy: chaos injection
+        site, bounded retries with backoff, the one escalated-timeout
+        re-execution, the one post-failover re-execution, and terminal
+        degradation — in that precedence order."""
+        from anovos_tpu.resilience import chaos
+        from anovos_tpu.resilience import policy as rpolicy
+
+        pol = node.policy
+        # re-execution of ANY kind (policy retry, interrupted-timeout retry,
+        # post-failover retry) is only sound for retry-mode nodes: a node
+        # registered "raise"/"continue" opted out — e.g. the stability node,
+        # whose cross-run metric-file appends a re-execution could double-book
+        retryable = pol.mode == "retry"
+        retries_left = pol.retries if retryable else 0
+        while True:
+            node.attempts += 1
+            node.attempt_start = time.monotonic()
+            if node.interrupt.is_set():
+                node.interrupt = threading.Event()  # fresh event per attempt
+            try:
+                chaos.chaos_point(f"node:{node.name}", interrupt=node.interrupt)
+                self._run_body(node)
+                return
+            except KeyboardInterrupt:
+                raise
+            except BaseException as e:
+                # 1) watchdog-interrupted attempt: one re-execution at the
+                #    escalated bound before the error policy applies at all
+                if (retryable and node.interrupt.is_set()
+                        and not node.timeout_retried):
+                    node.timeout_retried = True
+                    self._note_retry(node, e, kind="timeout_retry")
+                    self._discard_partial(node)
+                    continue
+                # 2) backend failover: when the failure is a wedge (chaos
+                #    flag, backend-shaped error, failed health probe, or the
+                #    watchdog flipped while this node ran — failover_granted)
+                #    the flip earns ONE re-execution outside the budget —
+                #    the node was never given a healthy backend to run on
+                flipped = self._maybe_failover(node, e) or node.failover_granted
+                node.failover_granted = False
+                if retryable and flipped and not node.failover_retried:
+                    node.failover_retried = True
+                    self._note_retry(node, e, kind="failover_retry")
+                    self._discard_partial(node)
+                    continue
+                # 3) policy retries with exponential backoff + jitter
+                if retries_left > 0:
+                    retries_left -= 1
+                    self._note_retry(node, e, kind="retry")
+                    self._discard_partial(node)
+                    time.sleep(rpolicy.backoff_delay(node.name, node.attempts, pol))
+                    continue
+                # 4) exhausted: degrade (the run continues, the section is
+                #    marked) or propagate to _execute's raise/continue
+                if pol.mode == "retry" and pol.on_exhausted == "degrade":
+                    node.degraded = True
+                    node.error = e
+                    rpolicy.record_degraded(node.name, f"{type(e).__name__}: {e}")
+                    if self.journal is not None:
+                        self.journal.append("node_degraded", node=node.name,
+                                            attempts=node.attempts,
+                                            error=repr(e)[:300])
+                    logger.warning(
+                        "node %r exhausted %d attempt(s) (%r); marking its "
+                        "section DEGRADED and continuing — the report renders "
+                        "a placeholder", node.name, node.attempts, e)
+                    return
+                raise
+
+    def _note_retry(self, node: Node, exc: BaseException, kind: str) -> None:
+        from anovos_tpu.obs import get_metrics
+
+        with self._res_lock:
+            self._res_stats["retries"] += 1
+            if kind == "timeout_retry":
+                self._res_stats["timeout_retries"] += 1
+            elif kind == "failover_retry":
+                self._res_stats["failover_retries"] += 1
+        get_metrics().counter(
+            "node_retries_total", "scheduler node re-executions after failure",
+        ).inc(node=node.name, kind=kind)
+        if self.journal is not None:
+            self.journal.append("node_retry", node=node.name, kind=kind,
+                                attempt=node.attempts, error=repr(exc)[:300])
+        logger.warning("node %r attempt %d failed (%r); re-executing (%s)",
+                       node.name, node.attempts, exc, kind)
+
+    def _discard_partial(self, node: Node) -> None:
+        """Between attempts, drop the failed attempt's partial artifacts:
+        wait out its in-flight async writes (so a stale queued write can
+        never land AFTER the retry's fresh one), then unlink the files the
+        capture recorder booked — except append-mode files, whose
+        pre-existing content must survive.  Best-effort: a retry that
+        re-overwrites is already safe for exact-contract nodes."""
+        rec, node.rec = node.rec, None
+        if rec is None:
+            return
+        try:
+            if node.cache is not None and node.cache.flush is not None and rec.keys:
+                node.cache.flush(sorted(rec.keys))
+        except Exception:
+            logger.debug("retry of node %r: async flush of partial writes "
+                         "failed (likely the original error)", node.name,
+                         exc_info=True)
+        for p in sorted(rec.discardable_paths()):
+            try:
+                if os.path.isfile(p):
+                    os.remove(p)
+            except OSError:
+                pass
+
+    def _maybe_failover(self, node: Node, exc: BaseException) -> bool:
+        """True when THIS failure triggered the run's backend failover."""
+        try:
+            from anovos_tpu.resilience import failover
+
+            return failover.maybe_failover(exc, journal=self.journal)
+        except Exception:
+            logger.exception("backend failover check for node %r failed", node.name)
+            return False
 
     # -- cache ------------------------------------------------------------
     def _try_restore(self, node: Node) -> bool:
@@ -333,6 +518,7 @@ class DagScheduler:
         if self.journal is not None:
             self.journal.append("node_begin", node=node.name, fp=node.fingerprint)
         rec = capture.Recorder()
+        node.rec = rec  # the retry path discards this attempt's partials
         try:
             with capture.recording(rec):
                 node.fn()
@@ -340,6 +526,18 @@ class DagScheduler:
             if self.journal is not None:
                 self.journal.append("node_failed", node=node.name, fp=node.fingerprint)
             raise
+        if node.abandoned:
+            # a zombie attempt the watchdog already gave up on (the node is
+            # booked DEGRADED, dependents ran, the manifest/report say so):
+            # its late result must NOT become a committed cache entry a
+            # future run would restore as if the node had succeeded.  Its
+            # direct file writes cannot be unwound at thread level — that
+            # is the documented cost of abandoning — but the durable record
+            # stays consistent.
+            logger.warning(
+                "abandoned node %r finished late; its result is NOT "
+                "committed (section already degraded)", node.name)
+            return
         try:
             if node.cache.flush is not None and rec.keys:
                 # the node's queued async writes must land before commit
@@ -364,8 +562,8 @@ class DagScheduler:
     def _run_concurrent(self, max_workers: int, node_timeout: float) -> None:
         cv = threading.Condition()
         ready: "deque[Node]" = deque()
-        running: Dict[str, float] = {}
-        state = {"stop": False, "fatal": None, "done": 0}
+        running: Dict[str, Node] = {}
+        state = {"stop": False, "fatal": None, "done": 0, "spawned": 0}
         total = len(self._nodes)
         t_ready0 = time.monotonic()
         for n in self._nodes:
@@ -376,12 +574,18 @@ class DagScheduler:
 
         def finish(node: Node) -> None:
             with cv:
+                if node.abandoned:
+                    # the watchdog already booked this node (degraded) and
+                    # unblocked its dependents; this is the zombie attempt
+                    # finally waking — its result is discarded
+                    cv.notify_all()
+                    return
                 running.pop(node.name, None)
                 state["done"] += 1
                 if node.state == "failed" and state["fatal"] is None:
                     state["fatal"] = node.error
                     state["stop"] = True
-                elif node.state in ("done", "failed-continued"):
+                elif node.state in ("done", "failed-continued", "degraded"):
                     for dep in node.dependents:
                         dep.pending -= 1
                         if dep.pending == 0 and not state["stop"]:
@@ -398,45 +602,156 @@ class DagScheduler:
                         return
                     node = ready.popleft()
                     node.state = "claimed"
-                    running[node.name] = time.monotonic()
+                    # attempt_start is the watchdog's clock origin; set it
+                    # BEFORE dispatch so a node is never observed at 0.0
+                    node.attempt_start = time.monotonic()
+                    running[node.name] = node
                 try:
                     self._execute(node)
                 except BaseException:
                     pass  # recorded on the node; surfaced via state["fatal"]
                 finish(node)
+                if node.abandoned:
+                    # this thread is the zombie the watchdog replaced: a
+                    # substitute worker already holds its pool slot, so
+                    # rejoining would widen the pool by one per abandonment
+                    return
 
-        threads = [
-            threading.Thread(target=worker, name=f"{self.name}-w{i}", daemon=True)
-            for i in range(min(max_workers, max(total, 1)))
-        ]
-        for t in threads:
-            t.start()
-        with cv:
+        def spawn_worker() -> None:
+            state["spawned"] += 1
+            threading.Thread(
+                target=worker, name=f"{self.name}-w{state['spawned'] - 1}",
+                daemon=True,
+            ).start()
+
+        def abandon(node: Node, reason: str) -> None:
+            """Watchdog verdict on a truly stuck retry+degrade node: book it
+            degraded WITHOUT its (zombie) thread, unblock dependents, and
+            replace the lost worker.  Caller holds ``cv``."""
+            from anovos_tpu.resilience import policy as rpolicy
+
+            node.abandoned = True
+            node.degraded = True
+            node.error = NodeTimeout(reason)
+            node.state = "degraded"
+            node.end = time.monotonic()
+            rpolicy.record_degraded(node.name, reason)
+            if self.journal is not None:
+                self.journal.append("node_degraded", node=node.name,
+                                    attempts=node.attempts, error=reason[:300])
+            logger.warning("%s — abandoning the stuck attempt (thread leaked, "
+                           "worker replaced) and DEGRADING the section", reason)
+            running.pop(node.name, None)
+            state["done"] += 1
+            for dep in node.dependents:
+                dep.pending -= 1
+                if dep.pending == 0 and not state["stop"]:
+                    dep.ready = time.monotonic()
+                    ready.append(dep)
+            spawn_worker()
+
+        for _ in range(min(max_workers, max(total, 1))):
+            spawn_worker()
+        cv.acquire()
+        try:
             while state["done"] < total:
                 if state["stop"] and not running:
                     break
                 cv.wait(0.1)
-                if node_timeout and node_timeout > 0:
-                    now = time.monotonic()
-                    for name, started in running.items():
-                        if now - started > node_timeout:
-                            state["stop"] = True
-                            state["fatal"] = NodeTimeout(
-                                f"scheduler node {name!r} still running after "
-                                f"{node_timeout:.0f}s — likely hung; aborting the run "
-                                f"(raise ANOVOS_TPU_NODE_TIMEOUT if the block is "
-                                f"legitimately slow)"
-                            )
-                            cv.notify_all()
-                            break
-                    if isinstance(state["fatal"], NodeTimeout):
-                        break
+                if not (node_timeout and node_timeout > 0):
+                    continue
+                now = time.monotonic()
+                expired: Optional[Node] = None
+                for node in list(running.values()):
+                    factor = node.policy.timeout_factor if node.escalated else 1.0
+                    if now - node.attempt_start <= node_timeout * factor:
+                        continue
+                    if not node.escalated:
+                        # first expiry: escalate, don't abort — interrupt the
+                        # attempt (cooperative: chaos hangs and library
+                        # checkpoints observe the event and unwind into the
+                        # timeout-retry path) and grant the raised bound
+                        node.escalated = True
+                        node.attempt_start = now
+                        node.interrupt.set()
+                        with self._res_lock:
+                            self._res_stats["timeout_escalations"] += 1
+                        from anovos_tpu.obs import get_metrics
+
+                        get_metrics().counter(
+                            "node_timeout_escalations_total",
+                            "watchdog timeouts escalated instead of fatal",
+                        ).inc(node=node.name)
+                        if self.journal is not None:
+                            self.journal.append("node_timeout_escalated",
+                                                node=node.name,
+                                                bound_s=round(node_timeout, 3),
+                                                factor=node.policy.timeout_factor)
+                        logger.warning(
+                            "node %r exceeded its %.1fs bound; interrupting the "
+                            "attempt and escalating once to %.1fs before the "
+                            "error policy applies", node.name, node_timeout,
+                            node_timeout * node.policy.timeout_factor)
+                        continue
+                    expired = node
+                    break
+                if expired is None:
+                    continue
+                # escalated bound ALSO blown: probe the backend OUTSIDE the
+                # lock (bounded, but seconds) — a wedge flips to CPU and the
+                # interrupt gets one more bound to unwind into re-execution
+                cv.release()
+                try:
+                    flipped = self._watchdog_failover(expired)
+                finally:
+                    cv.acquire()
+                if expired.name not in running:
+                    continue  # the attempt finished while we probed
+                if flipped and not expired.failover_retried:
+                    # the grant must not consume the node's retry budget:
+                    # _run_attempts sees failover_granted and books the
+                    # re-execution as the one budget-free failover retry
+                    expired.failover_granted = True
+                    expired.attempt_start = time.monotonic()
+                    expired.interrupt.set()
+                    continue
+                name = expired.name
+                reason = (
+                    f"scheduler node {name!r} still running after its escalated "
+                    f"bound ({node_timeout:.0f}s x{expired.policy.timeout_factor:g}) "
+                    f"— likely hung; (raise ANOVOS_TPU_NODE_TIMEOUT if the block "
+                    f"is legitimately slow)"
+                )
+                if (expired.policy.mode == "retry"
+                        and expired.policy.on_exhausted == "degrade"):
+                    abandon(expired, reason)
+                    cv.notify_all()
+                    continue
+                state["stop"] = True
+                state["fatal"] = NodeTimeout(reason)
+                cv.notify_all()
+                break
+        finally:
+            cv.release()
         for n in self._nodes:
             if n.state in ("pending", "claimed"):
                 n.state = "skipped"
         if state["fatal"] is not None:
             raise state["fatal"]
         # workers exit on their own once done == total (daemon threads)
+
+    def _watchdog_failover(self, node: Node) -> bool:
+        """Escalated-timeout health verdict: a node stuck past its raised
+        bound is exactly the mid-run-wedge signature, so ALWAYS probe here
+        (unlike the failure path, which probes only suspicious errors)."""
+        try:
+            from anovos_tpu.resilience import failover
+
+            return failover.maybe_failover(node.error, journal=self.journal,
+                                           force_probe=True)
+        except Exception:
+            logger.exception("watchdog failover probe for node %r failed", node.name)
+            return False
 
     # -- observability ---------------------------------------------------
     def _summary(self, wall_s: float, mode: str, workers: int) -> dict:
@@ -468,6 +783,10 @@ class DagScheduler:
             cp_len = 0.0
         with self._cache_lock:
             cache_stats = dict(self._cache_stats)
+        with self._res_lock:
+            res_stats = dict(self._res_stats)
+        from anovos_tpu.resilience import failover as _failover
+
         return {
             "mode": mode,
             "workers": workers,  # the pool width this run actually used
@@ -483,6 +802,11 @@ class DagScheduler:
                 "restore_s": round(cache_stats["restore_s"], 4),
                 "uncacheable": sum(1 for n in self._nodes if n.fingerprint is None),
             },
+            "resilience": {
+                **res_stats,
+                "failovers": _failover.failover_count(),
+                "degraded": sorted(n.name for n in self._nodes if n.degraded),
+            },
             "nodes": {
                 n.name: {
                     "start_s": round(n.start - origin, 4) if n.end else None,
@@ -492,6 +816,9 @@ class DagScheduler:
                     "thread": n.thread,
                     "state": n.state,
                     "cached": n.cached,
+                    "attempts": n.attempts,
+                    "escalated": n.escalated,
+                    "degraded": n.degraded,
                     "deps": [d.name for d in n.deps],
                 }
                 for n in self._nodes

@@ -29,6 +29,7 @@ Call it BEFORE the first jax backend touch — config updates after backend
 initialization do not take effect.
 """
 
+import functools
 import logging
 import os
 import select
@@ -100,6 +101,47 @@ def probe_default_backend(timeout_s: float):
         return None, "backend probe failed: " + (
             lines[-1][-200:] if lines else f"rc={p.returncode}"
         )
+
+
+@functools.lru_cache(maxsize=1)
+def _inproc_probe_fn():
+    """One tiny jitted program for the in-process health check — built
+    once ever, so repeated probes hit the compile cache instead of
+    re-tracing (graftcheck GC003 discipline)."""
+    import jax
+
+    return jax.jit(lambda a: a + 1.0)
+
+
+def probe_in_process(timeout_s: float) -> bool:
+    """Bounded IN-PROCESS dispatch check: the mid-run sibling of
+    :func:`probe_default_backend`.
+
+    The subprocess probe answers "can a fresh process reach the backend"
+    before the run commits; this answers "is THIS process's backend still
+    dispatching" between scheduler nodes, where a subprocess would pay
+    interpreter + backend init per check.  One tiny jitted program must
+    round-trip (compute + device→host fetch) within ``timeout_s`` on a
+    helper thread; a wedged dispatch leaves the daemon thread behind —
+    unavoidable at thread level, bounded to one probe at a time by the
+    caller (``resilience.failover`` flips to CPU after the first failed
+    probe, and CPU probes cannot wedge)."""
+    done = threading.Event()
+    result = {"ok": False}
+
+    def _dispatch():
+        try:
+            result["ok"] = float(_inproc_probe_fn()(1.0)) == 2.0
+        except Exception:
+            result["ok"] = False
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_dispatch, name="backend-health-probe", daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        return False  # the probe thread is wedged with the backend
+    return result["ok"]
 
 
 def ensure_responsive_backend(timeout_s: float | None = None, quiet: bool = False) -> str:

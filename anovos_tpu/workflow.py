@@ -82,6 +82,9 @@ from anovos_tpu.obs import (
     write_manifest,
 )
 from anovos_tpu.parallel.scheduler import DagScheduler
+from anovos_tpu.resilience import ErrorPolicy, chaos
+from anovos_tpu.resilience import failover as res_failover
+from anovos_tpu.resilience import policy as res_policy
 from anovos_tpu.shared.artifact_store import AsyncArtifactWriter
 from anovos_tpu.shared.table import Table
 
@@ -352,6 +355,36 @@ def _slice_or_none(slice_: dict, *gate_cfgs) -> Optional[dict]:
     return slice_
 
 
+def _node_policies() -> tuple:
+    """(spine policy, fanout policy) for this run's registrations.
+
+    Both classes retry transient failures (``ANOVOS_TPU_RETRIES``
+    re-executions, default 1 — a flaky node no longer costs the run);
+    retry is sound here because every registration's effect contract is
+    GC006-verified exact, so a re-execution overwrites the discarded
+    partial artifacts.  They differ on the two policy axes the scheduler
+    exposes:
+
+    * **timeout escalation** — spine nodes (df treatments, transformers)
+      get 2x patience on escalation: they are load-bearing and
+      legitimately slow on big tables.  Read-only fan-out analyzers get
+      1.5x: a stuck analyzer should resolve to degradation quickly.
+    * **exhaustion** — a spine node that still fails aborts (its output
+      df version is every downstream node's input); a fan-out analytics
+      node degrades: the run completes, the manifest ``resilience``
+      section records the section, and the report renders a placeholder.
+      ``ANOVOS_TPU_DEGRADE=0`` restores abort-on-exhaustion everywhere.
+    """
+    retries = int(os.environ.get("ANOVOS_TPU_RETRIES", "1"))
+    degrade = os.environ.get("ANOVOS_TPU_DEGRADE", "1") != "0"
+    spine = ErrorPolicy(mode="retry", retries=retries, on_exhausted="raise",
+                        timeout_factor=2.0)
+    fanout = ErrorPolicy(mode="retry", retries=retries,
+                         on_exhausted="degrade" if degrade else "raise",
+                         timeout_factor=1.5)
+    return spine, fanout
+
+
 class _LazyTable:
     """A df version restored from the cache, loaded on first access.
 
@@ -399,6 +432,7 @@ class _PipelineRun:
         self.sched = sched
         self.writer = writer
         self.cache_base = cache_base
+        self.spine_policy, self.fanout_policy = _node_policies()
         self._versions = {0: df0}
         self._planned_readers: dict = {}
         self._ver = 0
@@ -450,7 +484,8 @@ class _PipelineRun:
         )
 
     # -- node registration -------------------------------------------------
-    def spine(self, name, fn, reads=(), writes=(), timed=None, cache_slice=None) -> None:
+    def spine(self, name, fn, reads=(), writes=(), timed=None, cache_slice=None,
+              on_error=None) -> None:
         """``fn(df) -> df`` mutates the table: df version N → N+1."""
         v, out_v = self._ver, self._ver + 1
         self._ver = out_v
@@ -476,12 +511,14 @@ class _PipelineRun:
 
         self.sched.add(name, body, reads=(f"df:{v}",) + reads,
                        writes=(f"df:{out_v}",) + tuple(writes),
+                       on_error=on_error if on_error is not None else self.spine_policy,
                        cache=self._policy(name, cache_slice, writes,
                                           payload_write=lambda d: self._save_df(out_v, d),
                                           on_hit=on_hit))
         self._track(writes)
 
-    def fanout(self, name, fn, reads=(), writes=(), timed=None, cache_slice=None) -> None:
+    def fanout(self, name, fn, reads=(), writes=(), timed=None, cache_slice=None,
+               on_error=None) -> None:
         """``fn(df)`` only reads the table: pinned to the current version."""
         v = self._ver
         self._claim(v)
@@ -497,6 +534,7 @@ class _PipelineRun:
             self._release(v)
 
         self.sched.add(name, body, reads=(f"df:{v}",) + reads, writes=tuple(writes),
+                       on_error=on_error if on_error is not None else self.fanout_policy,
                        cache=self._policy(name, cache_slice, writes,
                                           on_hit=lambda _pdir, v=v: self._release(v)))
         self._track(writes)
@@ -523,6 +561,12 @@ def main(
     census_mark = compile_census.mark()
     LAST_RUN_SUMMARY = {}
     LAST_MANIFEST_PATH = ""
+    # resilience state is per-run: a fresh chaos plan from the env spec
+    # (inert when ANOVOS_TPU_CHAOS is unset), an empty degradation
+    # registry, and a re-armed failover (a new run may probe/flip again)
+    chaos.install_from_env()
+    res_policy.reset_degraded()
+    res_failover.reset()
     auth_key = _auth_key(auth_key_val)
     with get_tracer().span("input_dataset/ETL", cat="node"):
         df = ETL(all_configs.get("input_dataset"))
@@ -654,8 +698,13 @@ def main(
                                 df, opt.get("id_col"), output_path=report_input_path or ".",
                                 tz_offset=opt.get("tz_offset", "local"), run_type=run_type,
                             )
-                        except Exception:
+                        except Exception as e:
                             logger.exception("ts auto-detection failed; continuing with the raw table")
+                            # best-effort fallback, but no longer a SILENT one:
+                            # the manifest + report placeholder name the section
+                            res_policy.record_degraded(
+                                "timeseries_analyzer/auto_detection",
+                                f"{type(e).__name__}: {e}")
                             return df
                     pipe.spine("timeseries_analyzer/auto_detection", _ts_auto,
                                writes=("report:ts_autodetect",), timed="timeseries_analyzer",
@@ -672,8 +721,11 @@ def main(
                                 df, opt.get("id_col"), output_path=report_input_path or ".",
                                 run_type=run_type, **kw,
                             )
-                        except Exception:
+                        except Exception as e:
                             logger.exception("ts inspection failed; continuing without ts analysis")
+                            res_policy.record_degraded(
+                                "timeseries_analyzer/inspection",
+                                f"{type(e).__name__}: {e}")
                     pipe.fanout("timeseries_analyzer/inspection", _ts_inspect,
                                 writes=("report:ts_inspection",), timed="timeseries_analyzer",
                                 cache_slice={"timeseries_analyzer": opt, "mode": "inspect"})
@@ -698,8 +750,10 @@ def main(
                             geospatial_autodetection(
                                 df, ga.get("id_col"), report_input_path or ".", run_type=run_type, **kw
                             )
-                        except Exception:
+                        except Exception as e:
                             logger.exception("geospatial_analyzer failed; continuing without geo analysis")
+                            res_policy.record_degraded(
+                                "geospatial_controller", f"{type(e).__name__}: {e}")
                     pipe.fanout("geospatial_controller", _geo,
                                 writes=("report:geo",), timed="geospatial_controller",
                                 cache_slice={"geospatial_controller": ga})
@@ -865,9 +919,19 @@ def main(
                                 save(df_stats, write_stats, "drift_detector/stability_index",
                                      reread=True, writer=writer, key="stats:stability_index")
                         stab_cfg = value.get("configs") or {}
+                        # the metric paths are APPENDED to across runs: a
+                        # retry after a partial append could double-book a
+                        # window, so this node opts out of re-execution
+                        # (the discard pass protects append files, but not
+                        # against the append itself having landed twice)
+                        stab_retry = None
+                        if stab_cfg.get("appended_metric_path") or stab_cfg.get(
+                                "existing_metric_path"):
+                            stab_retry = "raise"
                         pipe.fanout("drift_detector/stability_index", _stability,
                                     writes=("stats:stability_index", "stats:stabilityIndex_metrics"),
                                     timed=f"{key}, stability_index",
+                                    on_error=stab_retry,
                                     # the metric paths are cross-RUN state (the
                                     # computation appends to them): their current
                                     # on-disk signature is part of the key, so a
@@ -936,8 +1000,13 @@ def main(
 
                 def _report(df, args=args):
                     anovos_report(**args, run_type=run_type, auth_key=auth_key)
+                # the report is the run's PRODUCT: retry a transient failure,
+                # never degrade it away
                 pipe.fanout("report_generation", _report, reads=art_reads,
-                            timed=f"{key}, full_report")
+                            timed=f"{key}, full_report",
+                            on_error=ErrorPolicy(mode="retry", retries=1,
+                                                 on_exhausted="raise",
+                                                 timeout_factor=2.0))
 
         # ---- obs destinations (manifest + optional chrome trace) -------
         # the manifest lands next to the run's other artifacts: under the
@@ -982,6 +1051,7 @@ def main(
             writer.drain()
             record_device_memory()
             record_cache_stats(cache_store)
+            chaos_plan = chaos.plan()
             manifest = build_manifest(
                 all_configs, summary, get_metrics().snapshot(),
                 run_type=run_type, block_times=block_times(),
@@ -993,6 +1063,11 @@ def main(
                     "resumed_from": resumed_from,
                     **summary.get("cache", {}),
                 } if cache_store is not None else None,
+                resilience={
+                    **summary.get("resilience", {}),
+                    "degraded_sections": res_policy.degraded_sections(),
+                    "chaos": chaos_plan.summary() if chaos_plan else None,
+                },
             )
             # the manifest rides the same async write queue as every other
             # artifact; close() below drains it
@@ -1003,10 +1078,22 @@ def main(
         finally:
             try:
                 writer.close()  # drain: surface any queued-write failure
-            except Exception:
+            except Exception as close_err:
                 if run_err is None:
                     raise
+                # an aborted run's close() failure must NOT mask the original
+                # node exception (the queued-write error is usually a
+                # downstream symptom of it): log it AND chain it onto the
+                # propagating exception's __context__ so the traceback shows
+                # both, with the node error on top
                 logger.exception("async artifact writes failed during aborted run")
+                if run_err.__context__ is None:
+                    # raising inside this finally implicitly set
+                    # close_err.__context__ = run_err; clear that
+                    # back-reference first or the chain becomes a cycle
+                    if close_err.__context__ is run_err:
+                        close_err.__context__ = None
+                    run_err.__context__ = close_err
             if cache_store is not None:
                 cache_capture.uninstall_open_hook()
                 max_bytes = os.environ.get("ANOVOS_TPU_CACHE_MAX_BYTES", "")

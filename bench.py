@@ -375,7 +375,53 @@ def e2e_cold_warm() -> dict:
             result.update(e2e_cached_incremental())
         except Exception as e:  # cache section must never sink the headline
             result["e2e_cache_error"] = str(e)[-200:]
+    if os.environ.get("BENCH_CHAOS", "1") == "1":
+        try:
+            result.update(e2e_chaos_recovery())
+        except Exception as e:  # recovery section must never sink the headline
+            result["e2e_chaos_error"] = str(e)[-200:]
     return result
+
+
+def e2e_chaos_recovery() -> dict:
+    """Recovery-overhead trajectory (anovos_tpu.resilience): run the
+    tools/chaos_run.py `full` scenario — one injected exception, one hang,
+    one simulated backend wedge — in a fresh single-device process and
+    record what recovery COST: the chaos run's wall next to its clean
+    golden wall, plus the retry/escalation/failover counts.  Parity
+    failure or a dead run is recorded as ``e2e_chaos_error`` so a broken
+    recovery path shows up in the round record, not as silence."""
+    env = {**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS") or "cpu"}
+    for k in ("ANOVOS_TPU_CHAOS", "ANOVOS_TPU_CACHE", "ANOVOS_TPU_EXECUTOR",
+              "XLA_FLAGS"):  # fresh-process shape: 1 device, concurrent DAG
+        env.pop(k, None)
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.chaos_run", "--scenario", "full", "--json"],
+        capture_output=True, text=True, env=env, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    out: dict = {}
+    try:
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        out["e2e_chaos_error"] = (
+            f"chaos_run produced no result (rc={p.returncode}): "
+            + (p.stderr or p.stdout)[-160:])
+        return out
+    res = rec.get("resilience") or {}
+    out["e2e_chaos_recovery_wall_s"] = rec.get("chaos_wall_s")
+    out["e2e_chaos_clean_wall_s"] = rec.get("clean_wall_s")
+    if rec.get("chaos_wall_s") and rec.get("clean_wall_s"):
+        out["e2e_chaos_overhead_s"] = round(
+            rec["chaos_wall_s"] - rec["clean_wall_s"], 3)
+    out["e2e_chaos_retries"] = res.get("retries")
+    out["e2e_chaos_escalations"] = res.get("timeout_escalations")
+    out["e2e_chaos_failovers"] = res.get("failovers")
+    out["e2e_chaos_parity"] = rec.get("parity")
+    if not rec.get("ok"):
+        out["e2e_chaos_error"] = rec.get("error", "chaos scenario gate failed")
+        print("bench: " + out["e2e_chaos_error"], file=sys.stderr)
+    return out
 
 
 def _cache_fields(label: str, cache: dict, wall_s: float) -> dict:

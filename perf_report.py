@@ -235,6 +235,16 @@ def bench_e2e() -> dict:
         "incremental_wall_s": r.get("e2e_incremental_wall_s"),
         "cache_hits": r.get("e2e_cache_hits"),
         "cache_error": r.get("e2e_cache_error"),
+        # resilience recovery overhead (bench.e2e_chaos_recovery): the
+        # chaos-scenario run's wall vs its clean golden, and what the
+        # recovery did — tracked like the cache and compile trajectories
+        "chaos_recovery_wall_s": r.get("e2e_chaos_recovery_wall_s"),
+        "chaos_clean_wall_s": r.get("e2e_chaos_clean_wall_s"),
+        "chaos_overhead_s": r.get("e2e_chaos_overhead_s"),
+        "chaos_retries": r.get("e2e_chaos_retries"),
+        "chaos_failovers": r.get("e2e_chaos_failovers"),
+        "chaos_parity": r.get("e2e_chaos_parity"),
+        "chaos_error": r.get("e2e_chaos_error"),
     }
 
 

@@ -1,0 +1,58 @@
+"""GC009 negative fixture: handlers that actually HANDLE the failure."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def narrow_catch(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:  # narrow: deliberate by construction
+        return None
+
+
+def log_and_reraise(fn):
+    try:
+        return fn()
+    except Exception:
+        logger.exception("fn failed")
+        raise
+
+
+def translate(fn):
+    try:
+        return fn()
+    except Exception as e:
+        raise RuntimeError("wrapped") from e
+
+
+def cleanup_then_continue(proc):
+    try:
+        proc.communicate(timeout=5)
+    except Exception:
+        proc.kill()  # real work: the handler cleans up
+
+
+def fallback_assignment(fn):
+    try:
+        result = fn()
+    except Exception:
+        result = None  # the fallback value IS the handling
+    return result
+
+
+def error_by_value(fn):
+    try:
+        return fn(), None
+    except Exception as e:
+        return None, str(e)  # the error propagates by value
+
+
+def marks_degraded(fn, record_degraded):
+    try:
+        return fn()
+    except Exception as e:
+        record_degraded("section", repr(e))  # degradation explicitly recorded
+        return None

@@ -1,0 +1,38 @@
+"""GC009 positive fixture: broad handlers that DROP the exception."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def silent_pass(fn):
+    try:
+        return fn()
+    except Exception:  # finding 1: swallowed outright
+        pass
+
+
+def bare_except_pass(fn):
+    try:
+        return fn()
+    except:  # noqa: E722 — finding 2: bare except, swallowed
+        pass
+
+
+def log_and_continue(items):
+    out = []
+    for it in items:
+        try:
+            out.append(it.compute())
+        except Exception as e:  # finding 3: log-only, error never escapes
+            logger.warning("item failed: %s", e)
+            continue
+    return out
+
+
+def log_and_fallback_return(df, fn):
+    try:
+        return fn(df)
+    except Exception:  # finding 4: log + unmodified-input fallback return
+        logger.exception("analyzer failed; continuing with the raw table")
+        return df

@@ -1,0 +1,240 @@
+"""Chaos scenario gate: run a config under fault injection, verify recovery.
+
+One command answers "do the resilience paths actually work on this
+checkout": it runs a pipeline config twice — once clean (the golden
+tree), once under a named ``ANOVOS_TPU_CHAOS`` scenario — and exits
+nonzero unless
+
+* the chaos run COMPLETES (no injected fault escaped recovery),
+* its artifact tree is BYTE-IDENTICAL to the clean run's (``obs/``
+  telemetry excluded — same exclusion as the cache golden tests), and
+* the run manifest's ``resilience`` section records the expected
+  recovery events (retries for ``exc``, a timeout escalation for
+  ``hang``, a backend failover for ``wedge``).
+
+Scenarios (sites target the default synthetic config's nodes; use
+``--spec`` to inject into an arbitrary ``--config``):
+
+* ``exc``   — one injected exception on a stats node → absorbed by the
+  per-node retry policy.
+* ``hang``  — one injected hang on a quality node → watchdog escalation
+  interrupts the attempt, which re-executes under the raised bound
+  (needs the concurrent executor; this scenario forces it and a small
+  ``ANOVOS_TPU_NODE_TIMEOUT``).
+* ``wedge`` — one simulated backend wedge on the drift node → in-run
+  health probe + failover to CPU, node re-executes.
+* ``full``  — all three in one run.
+
+Usage::
+
+    python -m tools.chaos_run --scenario full [--workdir DIR] [--json]
+    python -m tools.chaos_run --config cfg.yaml --spec 'exc@node:my_node'
+
+``bench.py`` runs the ``full`` scenario in a subprocess and records the
+recovery overhead (``e2e_chaos_recovery_wall_s``) next to the cache and
+compile trajectories; tier-1 wires the fast ``exc`` scenario
+(``tests/test_resilience.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import hashlib
+import json
+import os
+import pathlib
+import sys
+import tempfile
+import time
+
+SCENARIOS = {
+    "exc": "seed=7;exc@node:stats_generator/*",
+    "hang": "seed=7;hang@node:quality_checker/*:secs=600",
+    "wedge": "seed=7;wedge@node:drift_detector/*",
+    "full": ("seed=7;exc@node:stats_generator/*;"
+             "hang@node:quality_checker/*:secs=600;"
+             "wedge@node:drift_detector/*"),
+}
+
+# which manifest resilience counters must be > 0 per scenario
+EXPECT = {
+    "exc": ("retries",),
+    "hang": ("timeout_escalations", "timeout_retries"),
+    "wedge": ("failovers",),
+    "full": ("retries", "timeout_escalations", "timeout_retries", "failovers"),
+}
+
+
+def tree_hash(root) -> str:
+    """sha256 over (relpath, bytes) of every artifact; obs/ telemetry is
+    run-varying by design and excluded (same rule as tests/test_cache.py)."""
+    h = hashlib.sha256()
+    root = pathlib.Path(root)
+    for p in sorted(root.rglob("*")):
+        if p.is_file() and "obs" not in p.parts:
+            h.update(str(p.relative_to(root)).encode())
+            h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+def synthetic_config(workdir: str) -> dict:
+    """A small self-contained config whose node set covers every scenario
+    site (stats fan-out, quality spine, drift)."""
+    import numpy as np
+    import pandas as pd
+
+    data = os.path.join(workdir, "data")
+    if not os.path.isdir(data):
+        os.makedirs(data)
+        rng = np.random.default_rng(7)
+        pd.DataFrame({
+            "age": rng.normal(40, 9, 1500).round(1),
+            "fnlwgt": rng.normal(2e5, 4e4, 1500).round(0),
+            "workclass": rng.choice(["private", "gov", "self"], 1500),
+            "income": rng.choice(["<=50K", ">50K"], 1500),
+        }).to_parquet(os.path.join(data, "part-0.parquet"), index=False)
+    return {
+        "input_dataset": {"read_dataset": {"file_path": data,
+                                           "file_type": "parquet"}},
+        "stats_generator": {
+            "metric": ["global_summary", "measures_of_counts",
+                       "measures_of_cardinality"],
+            "metric_args": {"list_of_cols": "all", "drop_cols": []},
+        },
+        "quality_checker": {
+            "duplicate_detection": {"list_of_cols": "all", "drop_cols": [],
+                                    "treatment": True},
+            "IDness_detection": {"list_of_cols": "all", "drop_cols": [],
+                                 "treatment": True, "treatment_threshold": 0.9},
+        },
+        "drift_detector": {"drift_statistics": {
+            "configs": {"list_of_cols": "all", "drop_cols": [],
+                        "method_type": "PSI", "threshold": 0.1},
+            "source_dataset": {"read_dataset": {"file_path": data,
+                                                "file_type": "parquet"}},
+        }},
+        "report_preprocessing": {"master_path": "report_stats"},
+        "write_main": {"file_path": "output", "file_type": "parquet",
+                       "file_configs": {"mode": "overwrite"}},
+    }
+
+
+def _run_once(cfg: dict, rundir: str, chaos_spec: str, node_timeout: str) -> dict:
+    """One workflow.main run in ``rundir``; returns the manifest."""
+    from anovos_tpu import workflow
+    from anovos_tpu.obs import load_manifest
+
+    os.makedirs(rundir, exist_ok=True)
+    prev_cwd = os.getcwd()
+    prev_env = {k: os.environ.get(k) for k in
+                ("ANOVOS_TPU_CHAOS", "ANOVOS_TPU_EXECUTOR",
+                 "ANOVOS_TPU_NODE_TIMEOUT", "ANOVOS_TPU_CACHE")}
+    try:
+        os.environ.pop("ANOVOS_TPU_CACHE", None)  # parity gate runs uncached
+        os.environ["ANOVOS_TPU_EXECUTOR"] = "concurrent"
+        os.environ["ANOVOS_TPU_NODE_TIMEOUT"] = node_timeout
+        if chaos_spec:
+            os.environ["ANOVOS_TPU_CHAOS"] = chaos_spec
+        else:
+            os.environ.pop("ANOVOS_TPU_CHAOS", None)
+        os.chdir(rundir)
+        workflow.main(copy.deepcopy(cfg), "local")
+        return load_manifest(workflow.LAST_MANIFEST_PATH)
+    finally:
+        os.chdir(prev_cwd)
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_scenario(scenario: str, workdir: str, config: dict = None,
+                 spec: str = None, node_timeout: str = "5") -> dict:
+    """Clean + chaos run, parity + counter checks.  Returns the result
+    record (``ok`` plus per-check fields) without exiting."""
+    cfg = config if config is not None else synthetic_config(workdir)
+    chaos_spec = spec if spec is not None else SCENARIOS[scenario]
+    result = {"scenario": scenario, "spec": chaos_spec}
+
+    t0 = time.monotonic()
+    _run_once(cfg, os.path.join(workdir, "clean"), "", node_timeout)
+    result["clean_wall_s"] = round(time.monotonic() - t0, 3)
+    golden = tree_hash(os.path.join(workdir, "clean"))
+
+    t0 = time.monotonic()
+    try:
+        manifest = _run_once(cfg, os.path.join(workdir, "chaos"),
+                             chaos_spec, node_timeout)
+    except Exception as e:
+        result["ok"] = False
+        result["error"] = f"chaos run DIED (recovery failed): {type(e).__name__}: {e}"
+        return result
+    result["chaos_wall_s"] = round(time.monotonic() - t0, 3)
+
+    res = manifest.get("resilience") or {}
+    result["resilience"] = {k: v for k, v in res.items() if k != "chaos"}
+    result["injections"] = (res.get("chaos") or {}).get("injections", 0)
+    chaos_hash = tree_hash(os.path.join(workdir, "chaos"))
+    result["parity"] = chaos_hash == golden
+    missing = [k for k in EXPECT.get(scenario, ()) if not res.get(k)]
+    result["missing_counters"] = missing
+    result["degraded"] = res.get("degraded", [])
+    result["ok"] = bool(
+        result["parity"] and not missing and not result["degraded"]
+        and result["injections"] > 0)
+    if not result["ok"] and "error" not in result:
+        reasons = []
+        if not result["parity"]:
+            reasons.append("artifact tree differs from the clean golden run")
+        if missing:
+            reasons.append(f"expected recovery counters missing: {missing}")
+        if result["degraded"]:
+            reasons.append(f"sections degraded (recovery should have absorbed "
+                           f"the faults): {result['degraded']}")
+        if result["injections"] == 0:
+            reasons.append("chaos plan fired nothing (site names drifted?)")
+        result["error"] = "; ".join(reasons)
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run a config under a chaos scenario; exit nonzero "
+                    "unless recovery and artifact parity hold")
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS), default="full")
+    ap.add_argument("--config", help="YAML config (default: built-in synthetic)")
+    ap.add_argument("--spec", help="explicit ANOVOS_TPU_CHAOS spec override")
+    ap.add_argument("--workdir", help="run directory (default: a fresh tempdir)")
+    ap.add_argument("--node-timeout", default="5",
+                    help="ANOVOS_TPU_NODE_TIMEOUT for both runs (seconds; "
+                         "small so the hang scenario escalates quickly)")
+    ap.add_argument("--json", action="store_true", help="machine-readable result")
+    ns = ap.parse_args(argv)
+
+    cfg = None
+    if ns.config:
+        import yaml
+
+        with open(ns.config) as f:
+            cfg = yaml.load(f, yaml.SafeLoader)
+    workdir = ns.workdir or tempfile.mkdtemp(prefix="anovos_chaos_")
+    result = run_scenario(ns.scenario, workdir, config=cfg, spec=ns.spec,
+                          node_timeout=ns.node_timeout)
+    if ns.json:
+        print(json.dumps(result, sort_keys=True))
+    else:
+        status = "OK" if result["ok"] else "FAIL"
+        print(f"chaos_run[{ns.scenario}]: {status} — "
+              f"injections={result.get('injections')} "
+              f"parity={result.get('parity')} "
+              f"resilience={result.get('resilience')}")
+        if not result["ok"]:
+            print("chaos_run: " + result.get("error", "unknown failure"),
+                  file=sys.stderr)
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

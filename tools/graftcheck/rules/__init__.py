@@ -9,4 +9,5 @@ from tools.graftcheck.rules import (  # noqa: F401  (import = registration)
     gc006_effect_contract,
     gc007_no_print,
     gc008_cache_key,
+    gc009_swallowed_exception,
 )

@@ -49,6 +49,7 @@ _FALLBACK_KNOBS = (
     "ANOVOS_REPLICATE_MAX_BYTES",
     "ANOVOS_REREAD_FROM_DISK",
     "ANOVOS_SHAPE_BUCKETS",
+    "ANOVOS_TPU_CHAOS",
 )
 
 _knobs_cache: Optional[Tuple[str, ...]] = None

@@ -1,0 +1,126 @@
+"""GC009 — swallowed exceptions in library code.
+
+A broad ``except Exception:`` whose handler only passes or logs DROPS the
+failure: the caller proceeds on partial state with no machine-readable
+record that anything went wrong.  In a pipeline with an explicit
+degradation channel (``anovos_tpu.resilience``: retry policies, the
+degradation registry, the manifest ``resilience`` section) that is
+exactly the failure mode the channel exists to replace — a fault should
+either propagate, be retried, or mark degraded state the report and
+manifest surface; it should never just vanish into a log line.
+
+Flagged: a handler that catches broadly (bare ``except``, ``Exception``,
+``BaseException``, or a tuple containing one) AND does nothing with the
+failure beyond logging — no re-raise, no cleanup/fallback calls, no
+degradation marking, no propagation of the error by value.
+
+NOT flagged (the handler *handles*):
+
+* any ``raise`` in the handler (re-raise or translate);
+* narrow catches (``except OSError:`` …) — deliberate by construction;
+* calls besides logging (cleanup like ``p.kill()``, fallback compute,
+  anything with ``degrad`` in its name — the resilience registry);
+* assignments (a fallback value IS the handling);
+* the bound exception name used outside logging calls (returned or
+  stored: the error propagates by value).
+
+Deliberate best-effort fallbacks (the reference semantics for ts/geo
+analyzers, cache-miss fallthroughs, obs export) are baselined with
+per-entry justifications, same as GC006's — the point is that new
+swallow sites need a stated reason, not that zero exist.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Set
+
+from tools.graftcheck.jaxmodel import attr_chain
+from tools.graftcheck.registry import FileContext, Rule, register
+
+_BROAD = {"Exception", "BaseException"}
+
+# method names that identify a call as "just logging" — the attribute
+# spelling (logger.warning / logging.exception / self._log.error) varies,
+# the verb set does not
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> Optional[str]:
+    """The caught-type label when the catch is broad, else None."""
+    t = handler.type
+    if t is None:
+        return "bare except"
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [getattr(e, "id", None) or attr_chain(e) or "" for e in t.elts]
+    else:
+        names = [getattr(t, "id", None) or attr_chain(t) or ""]
+    for n in names:
+        leaf = n.rsplit(".", 1)[-1]
+        if leaf in _BROAD:
+            return f"except {leaf}"
+    return None
+
+
+def _is_logging_call(call: ast.Call) -> bool:
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if func.attr in _LOG_METHODS:
+            return True
+        chain = attr_chain(func) or ""
+        return chain in ("warnings.warn",)
+    if isinstance(func, ast.Name):
+        return func.id in ("print",)  # still a swallow; GC007 owns the print itself
+    return False
+
+
+@register
+class SwallowedExceptionRule(Rule):
+    id = "GC009"
+    title = "broad except that drops the exception without marking degraded state"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("anovos_tpu/") or "gc009" in relpath
+
+    def check(self, ctx: FileContext) -> Iterable:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            label = _is_broad(node)
+            if label is None:
+                continue
+            if self._swallows(node):
+                yield ctx.finding(
+                    self.id, node,
+                    f"broad `{label}` handler only passes/logs — the failure "
+                    "vanishes with no degraded-state record; re-raise, narrow "
+                    "the catch, call resilience.record_degraded, or baseline "
+                    "with a justification for a deliberate fallback")
+
+    @staticmethod
+    def _swallows(handler: ast.ExceptHandler) -> bool:
+        bound = handler.name  # `except Exception as e:` -> "e"
+        # everything syntactically INSIDE a logging call (the call itself,
+        # its f-string args, str(e)/repr(e) formatting) counts as logging
+        logged: Set[int] = set()
+        for stmt in handler.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and _is_logging_call(sub):
+                    for inner in ast.walk(sub):
+                        logged.add(id(inner))
+        for stmt in handler.body:
+            # a fallback-value assignment IS the handling
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                return False
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Raise):
+                    return False  # re-raised / translated
+                if isinstance(sub, ast.Call) and id(sub) not in logged:
+                    return False  # real work: cleanup, fallback, record_degraded
+                if (bound and isinstance(sub, ast.Name)
+                        and isinstance(sub.ctx, ast.Load)
+                        and sub.id == bound and id(sub) not in logged):
+                    return False  # error escapes by value (returned/stored)
+        return True
