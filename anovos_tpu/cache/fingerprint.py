@@ -40,8 +40,11 @@ __all__ = [
 ]
 
 # Environment variables whose value changes node ARTIFACTS.  Pure
-# performance knobs (worker counts, timeouts, trace paths, probe budgets)
-# deliberately stay off the list — they must NOT invalidate the cache.
+# performance/telemetry knobs (worker counts, timeouts, trace paths, probe
+# budgets, and the obs knobs ANOVOS_TPU_DEVPROF / ANOVOS_TPU_FLIGHTREC /
+# ANOVOS_PERF_LEDGER — their outputs live under the parity-excluded obs/
+# subtree) deliberately stay off the list — they must NOT invalidate the
+# cache.
 # ANOVOS_SHAPE_BUCKETS is on it defensively: bucketed-vs-exact parity is
 # tested byte-identical, but the knob exists precisely to flip compiled
 # program shapes, and a false invalidation is cheap while a false hit is

@@ -53,6 +53,12 @@ class RunJournal:
     def append(self, event: str, **fields) -> None:
         rec = {"event": event, "t": round(time.time(), 3), **fields}
         line = json.dumps(rec, sort_keys=True, separators=(",", ":"))
+        try:  # every WAL event also feeds the flight recorder's ring
+            from anovos_tpu.obs import flight
+
+            flight.record("journal", event=event, **fields)
+        except Exception:
+            pass
         if self._writer is not None:
             self._writer.submit(JOURNAL_KEY, self._append_line, line)
         else:

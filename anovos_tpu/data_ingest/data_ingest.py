@@ -318,11 +318,22 @@ def write_dataset(
 # combination
 # ----------------------------------------------------------------------
 def _concat_columns(cols: List[Column], nrows: List[int], name: str) -> Column:
+    from anovos_tpu.obs import devprof
+
     rt = get_runtime()
     kinds = {c.kind for c in cols}
     if len(kinds) > 1:
         raise TypeError(f"column {name}: mixed kinds {kinds} across concatenated tables")
     kind = kinds.pop()
+    # d2h materialization boundary (host-side shard assembly): book the
+    # fetched bytes before the device_gets below pull them down.  Wide
+    # columns' payloads are EXCLUDED here — they materialize through
+    # Column.exact_host, whose own bracket books the (hi, lo) pair, and
+    # pre-booking them too would double-count d2h bytes
+    devprof.record_transfer(
+        "d2h",
+        sum(c.mask.nbytes + (0 if c.is_wide else c.data.nbytes) for c in cols),
+        0.0, label="data_ingest.concat")
     # host-side assembly: concat is a stage boundary, and device-side eager
     # concatenation of differently-sharded arrays would dispatch independent
     # collective programs per column (rendezvous-interleave hazard — see

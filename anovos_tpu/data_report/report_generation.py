@@ -1260,6 +1260,115 @@ def run_timings_gen(master_path: str = ".") -> str:
             columns=["block", "wall_s"],
         )
         html.append(_table_html(blk, "per-block wall time"))
+    html.append(_devprof_split_html(man.get("devprof") or {}))
+    return "".join(html)
+
+
+# devprof stacked-bar segment colors: categorical slots 1-3 of the
+# validated default palette (all-pairs CVD-clean on a light surface) for
+# the three attributed categories, neutral gray for the host remainder;
+# identity never rides color alone — the legend + per-segment tooltips
+# carry it, and the numbers are in the adjacent table
+_DEVPROF_SEGMENTS = (
+    ("device", "device_time_s", "#2a78d6"),
+    ("dispatch", "dispatch_s", "#eb6834"),
+    ("transfer", "transfer_s", "#1baf7a"),
+    ("host", "host_s", "#b4b2ab"),
+)
+
+
+def _devprof_split_html(dev: dict) -> str:
+    """Per-node device/dispatch/transfer/host stacked split from the
+    manifest's ``devprof`` section (obs.devprof); empty string when the
+    manifest predates the section."""
+    rows = [(name, e) for name, e in dev.items()
+            if isinstance(e, dict) and (e.get("wall_s") or 0) > 0]
+    if not rows:
+        return ""
+    rows.sort(key=lambda kv: -(kv[1].get("wall_s") or 0))
+    max_wall = max(e.get("wall_s") or 0 for _, e in rows) or 1.0
+    html = ["<h4>Device-time attribution (obs.devprof)</h4>",
+            "<p>Each node's wall split into <b>device</b> (device-queue "
+            "drain), <b>dispatch</b> (host wall inside jitted ops), "
+            "<b>transfer</b> (host&harr;device materialization) and "
+            "<b>host</b> (the remainder). Bar lengths are scaled to the "
+            "slowest node.</p>"]
+    legend = "".join(
+        f"<span style='display:inline-block;margin-right:14px'>"
+        f"<span style='display:inline-block;width:10px;height:10px;"
+        f"background:{color};border-radius:2px;margin-right:4px'></span>"
+        f"{escape(label)}</span>"
+        for label, _, color in _DEVPROF_SEGMENTS)
+    html.append(f"<div style='margin:4px 0 8px 0'>{legend}</div>")
+    for name, e in rows:
+        wall = e.get("wall_s") or 0.0
+        width_pct = wall / max_wall * 100.0
+        segs = []
+        for label, key, color in _DEVPROF_SEGMENTS:
+            v = float(e.get(key) or 0.0)
+            if v <= 0:
+                continue
+            seg_pct = v / wall * 100.0
+            segs.append(
+                f"<span title='{escape(label)} {v:.4f}s "
+                f"({seg_pct:.0f}%)' style='display:inline-block;"
+                f"height:12px;background:{color};width:{seg_pct:.2f}%;"
+                # 2px surface gap between stacked segments
+                f"border-right:2px solid #fff;box-sizing:border-box'>"
+                "</span>")
+        xfer = (e.get("h2d_bytes") or 0) + (e.get("d2h_bytes") or 0)
+        html.append(
+            "<div style='margin:3px 0;font-size:12px'>"
+            f"<code>{escape(name)}</code> — {wall:.3f}s"
+            + (f", {xfer / 1e6:.1f} MB moved" if xfer else "")
+            + f"<div style='width:{width_pct:.1f}%;min-width:40px;"
+              f"white-space:nowrap;font-size:0'>{''.join(segs)}</div></div>")
+    tbl = pd.DataFrame([
+        {"node": name,
+         "wall_s": e.get("wall_s"),
+         "device_s": e.get("device_time_s"),
+         "dispatch_s": e.get("dispatch_s"),
+         "transfer_s": e.get("transfer_s"),
+         "host_s": e.get("host_s"),
+         "h2d_bytes": e.get("h2d_bytes"),
+         "d2h_bytes": e.get("d2h_bytes"),
+         "last_op": e.get("last_op")}
+        for name, e in rows
+    ])
+    html.append(_table_html(tbl, "devprof per node"))
+    return "".join(html)
+
+
+def perf_ledger_gen() -> str:
+    """"Perf Ledger" tab: the bench trajectory + gate verdicts from the
+    append-only ledger (tools/perf_ledger).  Env-gated: rendered only when
+    ``ANOVOS_PERF_LEDGER`` names a ledger file — the ledger lives in the
+    repo, not under a run's master_path, so an un-gated lookup would make
+    report bytes depend on checkout state (golden parity)."""
+    path = os.environ.get("ANOVOS_PERF_LEDGER", "")
+    if not path or not os.path.exists(path):
+        return ""
+    try:
+        from tools.perf_ledger import field_trends, load
+
+        entries = load(path)
+        rows = field_trends(entries)
+    except Exception as e:
+        logger.warning("perf ledger at %s unreadable (%s); omitting tab", path, e)
+        return ""
+    if not rows:
+        return ""
+    html = ["<h3>Perf Ledger</h3>",
+            f"<p>Bench trajectory from <code>{escape(path)}</code> "
+            f"({len(entries)} entries; see <code>tools/perf_ledger.py "
+            "--check</code> for the regression gate).</p>"]
+    html.append(_table_html(pd.DataFrame(rows), "tracked fields"))
+    regress = [e for e in entries if e.get("regressions")]
+    if regress:
+        items = "".join(
+            f"<li><code>{escape(str(e.get('source')))}</code>: "
+            f"{escape(', '.join(e['regressions']))}</li>" for e in regress)
+        html.append(f"<p><b>Entries flagged by the gate:</b></p><ul>{items}</ul>")
     return "".join(html)
 
 
@@ -1376,6 +1485,9 @@ def anovos_report(
     timings_html = run_timings_gen(master_path)
     if timings_html:
         tabs.append(("Run Timings", timings_html))
+    ledger_html = perf_ledger_gen()
+    if ledger_html:
+        tabs.append(("Perf Ledger", ledger_html))
 
     nav = "".join(
         f"<button class=\"{'active' if i == 0 else ''}\" onclick='showTab({i})'>{escape(t)}</button>"

@@ -22,12 +22,22 @@ Three cooperating, stdlib-only pieces:
   listener counting every real XLA backend compile with per-program
   attribution; the per-run delta lands in the manifest and
   ``tools/compile_census.py`` renders / CI-gates it.
+* **Device-time attribution** (``obs.devprof``): per-scheduler-node
+  split of wall into device / dispatch / transfer / host via boundary
+  drain probes, ``timed()`` dispatch brackets, and transfer brackets at
+  the Table materialization choke points, plus per-device HBM deltas —
+  the manifest ``devprof`` section and bench's ``e2e_device_time_s``.
+* **Flight recorder** (``obs.flight``): a bounded ring of lifecycle
+  events dumped synchronously to ``obs/flightrec_<node>.json`` on
+  timeout escalation, abandonment, backend failover, or fatal error —
+  the postmortem a merely-survived wedge used to throw away.
 
 Recording is always on at negligible cost; trace-file export is gated by
-``ANOVOS_TPU_TRACE=<path|1>``.
+``ANOVOS_TPU_TRACE=<path|1>``, attribution by ``ANOVOS_TPU_DEVPROF``,
+the flight recorder by ``ANOVOS_TPU_FLIGHTREC``.
 """
 
-from anovos_tpu.obs import compile_census
+from anovos_tpu.obs import compile_census, devprof, flight
 from anovos_tpu.obs.manifest import (
     MANIFEST_VERSION,
     build_manifest,
@@ -42,6 +52,7 @@ from anovos_tpu.obs.metrics import (
     Histogram,
     MetricsRegistry,
     get_metrics,
+    memory_by_device,
     record_cache_stats,
     record_device_memory,
 )
@@ -57,6 +68,9 @@ from anovos_tpu.obs.tracing import (
 
 __all__ = [
     "compile_census",
+    "devprof",
+    "flight",
+    "memory_by_device",
     "MANIFEST_VERSION",
     "build_manifest",
     "config_hash",

@@ -51,6 +51,7 @@ def build_manifest(
     compile_census: Optional[dict] = None,
     cache: Optional[dict] = None,
     resilience: Optional[dict] = None,
+    devprof: Optional[dict] = None,
 ) -> dict:
     """Assemble the manifest dict from the scheduler summary + metrics.
 
@@ -97,6 +98,12 @@ def build_manifest(
         # All zeros/empty on a healthy run; a transient fault leaves its
         # trace here instead of killing the run
         "resilience": resilience,
+        # per-node device-time attribution (obs.devprof): node wall split
+        # into device_time_s / dispatch_s / transfer_s / host_s plus
+        # h2d/d2h byte counts and per-device HBM deltas — the section
+        # bench.py's e2e_device_time_s / e2e_transfer_bytes fields and
+        # the HTML report's devprof split read
+        "devprof": devprof,
         "trace_path": trace_path,
         "backend": backend,
         "generated_unix": round(
@@ -141,6 +148,9 @@ _VOLATILE_TOP_FIELDS = (
     "cache",
     # retries/failovers/degradations depend on fault history, not identity
     "resilience",
+    # every devprof field is duration/byte-rate telemetry (and byte counts
+    # depend on cache-store history: a restored node transfers nothing)
+    "devprof",
 )
 
 
@@ -166,7 +176,10 @@ def stable_view(manifest: dict) -> dict:
     metrics = {}
     for name, m in (out.get("metrics") or {}).items():
         if (name.startswith("op_") or name.startswith("device_")
-                or name.startswith("xla_") or name.startswith("cache_")):
+                or name.startswith("xla_") or name.startswith("cache_")
+                # devprof_/transfer_ families are duration- and cache-
+                # history-dependent, like the op_ families
+                or name.startswith("devprof_") or name.startswith("transfer_")):
             # compile-cache state (op_compile vs op_execute/op_cache_hit)
             # depends on PROCESS history — a warm in-process rerun shifts
             # families even though the run is identical; device-memory

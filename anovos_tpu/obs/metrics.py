@@ -32,6 +32,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "get_metrics",
+    "memory_by_device",
     "record_cache_stats",
     "record_device_memory",
 ]
@@ -227,33 +228,66 @@ def get_metrics() -> MetricsRegistry:
     return _REGISTRY
 
 
-def record_device_memory(registry: Optional[MetricsRegistry] = None) -> None:
-    """Record device-memory usage + high-water mark when the backend
-    exposes it (``jax.local_devices()[0].memory_stats()`` — TPU and GPU
-    runtimes do, CPU returns None).  Never raises; never imports jax unless
-    it is already loaded (keeps stdlib-only callers stdlib-only)."""
+def memory_by_device() -> Dict[str, dict]:
+    """``{device label: memory_stats dict}`` across ALL local devices.
+
+    Empty when jax is not loaded or no device exposes ``memory_stats()``
+    (the CPU runtime returns None).  Never raises; never imports jax
+    unless it is already loaded (keeps stdlib-only callers stdlib-only).
+    Labels are ``<platform>:<id>`` (``tpu:3``)."""
     import sys
 
     jax = sys.modules.get("jax")
     if jax is None:
+        return {}
+    out: Dict[str, dict] = {}
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            continue
+        if stats:
+            out[f"{getattr(d, 'platform', 'dev')}:{getattr(d, 'id', len(out))}"] = stats
+    return out
+
+
+def record_device_memory(registry: Optional[MetricsRegistry] = None) -> None:
+    """Record device-memory usage + high-water marks across ALL local
+    devices — per-device labeled gauges plus a mesh-wide sum/high-water.
+
+    The former single-device sampling (``jax.local_devices()[0]``) left
+    7 of 8 chips invisible on the mesh: a node that ballooned HBM on a
+    non-zero device never moved the gauge.  Never raises."""
+    per_dev = memory_by_device()
+    if not per_dev:
         return
     reg = registry or _REGISTRY
-    try:
-        stats = jax.local_devices()[0].memory_stats()
-    except Exception:
-        return
-    if not stats:
-        return
-    in_use = stats.get("bytes_in_use")
-    if in_use is not None:
-        reg.gauge("device_bytes_in_use",
-                  "current device memory allocation").set(float(in_use))
-        reg.gauge("device_bytes_high_water",
-                  "max observed device memory allocation").set_max(float(in_use))
-    peak = stats.get("peak_bytes_in_use")
-    if peak is not None:
-        reg.gauge("device_peak_bytes",
-                  "allocator-reported peak device memory").set_max(float(peak))
+    mesh_in_use = 0.0
+    for label, stats in sorted(per_dev.items()):
+        in_use = stats.get("bytes_in_use")
+        if in_use is not None:
+            mesh_in_use += float(in_use)
+            reg.gauge("device_bytes_in_use",
+                      "current device memory allocation"
+                      ).set(float(in_use), device=label)
+            reg.gauge("device_bytes_high_water",
+                      "max observed device memory allocation"
+                      ).set_max(float(in_use), device=label)
+        peak = stats.get("peak_bytes_in_use")
+        if peak is not None:
+            reg.gauge("device_peak_bytes",
+                      "allocator-reported peak device memory"
+                      ).set_max(float(peak), device=label)
+    reg.gauge("device_mesh_bytes_in_use",
+              "current device memory allocation summed over all local devices"
+              ).set(mesh_in_use)
+    reg.gauge("device_mesh_bytes_high_water",
+              "max observed mesh-wide device memory allocation"
+              ).set_max(mesh_in_use)
 
 
 def record_cache_stats(store, registry: Optional[MetricsRegistry] = None) -> None:

@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Optional
 
+from anovos_tpu.obs import devprof
 from anovos_tpu.obs.metrics import get_metrics
 from anovos_tpu.obs.tracing import get_tracer
 
@@ -104,7 +105,11 @@ def timed(name: Optional[str] = None):
             phase = "compile" if first else "execute"
             reg = get_metrics()
             t0 = time.perf_counter()
-            with get_tracer().span(label, cat="op", phase=phase):
+            # the devprof bracket books execute-phase wall as this node's
+            # dispatch time (outermost bracket only — nested timed ops
+            # would double-count) and stamps last_op for flight dumps
+            with get_tracer().span(label, cat="op", phase=phase), \
+                    devprof.dispatch_bracket(label, phase=phase):
                 out = fn(*args, **kwargs)
             dt = time.perf_counter() - t0
             if first:

@@ -430,6 +430,7 @@ def dbscan_host_grid_multi(
     return out
 
 
+@timed("ops.dbscan_grid")
 def dbscan_grid(
     X: np.ndarray,
     eps: float,

@@ -21,6 +21,7 @@ from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+from anovos_tpu.obs import timed
 
 SECS_PER_DAY = 86400
 
@@ -109,6 +110,7 @@ def _days_in_month(m: jax.Array, leap: jax.Array) -> jax.Array:
     return dim[m - 1] + ((m == 2) & leap)
 
 
+@timed("ops.extract_unit")
 @functools.partial(jax.jit, static_argnames=("unit",))
 def extract_unit(secs: jax.Array, unit: str) -> jax.Array:
     """One calendar component (pandas .dt semantics; dayofweek is 1-based
@@ -121,6 +123,7 @@ def extract_unit(secs: jax.Array, unit: str) -> jax.Array:
     return c[unit]
 
 
+@timed("ops.period_boundary")
 @functools.partial(jax.jit, static_argnames=("which", "period"))
 def period_boundary(secs: jax.Array, which: str, period: str) -> jax.Array:
     """start/end of month/quarter/year as epoch-seconds (midnight), device."""
@@ -140,6 +143,7 @@ def period_boundary(secs: jax.Array, which: str, period: str) -> jax.Array:
     return days * SECS_PER_DAY
 
 
+@timed("ops.is_period_boundary")
 @functools.partial(jax.jit, static_argnames=("which", "period"))
 def is_period_boundary(secs: jax.Array, which: str, period: str) -> jax.Array:
     """pandas is_{month,quarter,year}_{start,end} parity: calendar-day
@@ -148,6 +152,7 @@ def is_period_boundary(secs: jax.Array, which: str, period: str) -> jax.Array:
     return c["days"] * SECS_PER_DAY == period_boundary(secs, which, period)
 
 
+@timed("ops.add_months")
 @functools.partial(jax.jit, static_argnames=("months",))
 def add_months(secs: jax.Array, months: int) -> jax.Array:
     """Month-aware shift with end-of-month clamping (DateOffset parity)."""

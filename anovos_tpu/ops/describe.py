@@ -26,11 +26,13 @@ import numpy as np
 
 from anovos_tpu.shared.runtime import column_parallel, wants_column_parallel
 from anovos_tpu.shared.table import Table
+from anovos_tpu.obs import timed
 
 # the percentile grid every consumer shares (measures_of_percentiles order)
 PCTL_QS = (0.0, 0.01, 0.05, 0.10, 0.25, 0.50, 0.75, 0.90, 0.95, 0.99, 1.0)
 
 
+@timed("ops.describe_numeric")
 def describe_numeric(X: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
     """One program: moments + percentiles + distinct counts for (rows, k).
 
@@ -133,6 +135,7 @@ def _chunked_chunk_moments(X: jax.Array, M: jax.Array, chunk: int) -> Dict[str, 
 _COMPENSATED_CHUNK = 1 << 16
 
 
+@timed("ops.compensated_moments")
 def compensated_moments(X: jax.Array, M: jax.Array, chunk: int = _COMPENSATED_CHUNK) -> Dict[str, np.ndarray]:
     """Chunked-Chan compensated moments (SURVEY §7 hard-part 7): f32 error
     stops growing with the row count because each 2^16-row chunk is centered
@@ -184,6 +187,7 @@ def _compensated_enabled(rows: int) -> bool:
     return rows >= _COMPENSATED_AUTO_ROWS
 
 
+@timed("ops.describe_wide_int")
 def describe_wide_int(hi: jax.Array, lo: jax.Array, M: jax.Array) -> Dict[str, jax.Array]:
     """Exact order statistics for wide-int64 columns stored as (hi, lo) int32
     pairs (Table docstring encoding: signed lexicographic pair order == int64
@@ -270,6 +274,7 @@ def describe_cat(C: jax.Array, M: jax.Array, max_vocab: int) -> Dict[str, jax.Ar
 _CAT_SWEEP_MAX_VOCAB = 1024
 
 
+@timed("ops.table_describe")
 def table_describe(idf: Table, num_cols: List[str], cat_cols: List[str]) -> Tuple[dict, dict]:
     """Memoized fused description: (numeric dict of host arrays, cat dict
     with per-column count/nunique/mode_code/mode_count).

@@ -16,6 +16,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from anovos_tpu.obs import timed
 
 
 # Above this lane count, compare-and-reduce's O(rows·k·nbins) sweep loses to
@@ -69,6 +70,7 @@ def _binned_histograms_xla(X: jax.Array, M: jax.Array, cutoffs: jax.Array, nbins
     return _flat_counts(bins, M, nbins)
 
 
+@timed("ops.binned_histograms")
 def binned_histograms(X: jax.Array, M: jax.Array, cutoffs: jax.Array, nbins: int) -> jax.Array:
     """Numeric columns → per-column bin frequencies in one program.
 
@@ -95,6 +97,7 @@ def code_histograms(C: jax.Array, M: jax.Array, nbins: int) -> jax.Array:
     return _flat_counts(jnp.maximum(C, 0), M & (C >= 0), nbins)
 
 
+@timed("ops.drift_side_histograms")
 @functools.partial(jax.jit, static_argnames=("nbins", "n_cat_bins"))
 def drift_side_histograms(
     X: jax.Array,
@@ -112,6 +115,7 @@ def drift_side_histograms(
     )
 
 
+@timed("ops.drift_side_full")
 @functools.partial(jax.jit, static_argnames=("nbins", "n_cat_bins"))
 def drift_side_full(
     num_datas: Tuple[jax.Array, ...],

@@ -15,6 +15,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from anovos_tpu.obs import timed
 
 EARTH_RADIUS_M = 6371009.0  # matches geo_utils.py host codec
 
@@ -69,6 +70,7 @@ _WGS84_B = 6_356_752.314245
 _WGS84_F = 1 / 298.257223563
 
 
+@timed("ops.vincenty")
 @functools.partial(jax.jit, static_argnames=("iters",))
 def vincenty(lat1, lon1, lat2, lon2, iters: int = 20):
     """Vincenty inverse geodesic on the WGS-84 ellipsoid, fixed-iteration
@@ -197,6 +199,7 @@ def point_in_polygon_set(lat, lon, ex1, ey1, ex2, ey2, poly_id, n_poly: int) -> 
     return (counts % 2 == 1).any(axis=0)
 
 
+@timed("ops.segment_centroid")
 @functools.partial(jax.jit, static_argnames=("nseg",))
 def segment_centroid(x, y, z, seg, valid, nseg: int):
     """Per-segment cartesian means → (clat, clon, count) arrays (nseg,)."""
@@ -210,6 +213,7 @@ def segment_centroid(x, y, z, seg, valid, nseg: int):
     return clat, clon, cnt
 
 
+@timed("ops.segment_weighted_centroid")
 @functools.partial(jax.jit, static_argnames=("nseg",))
 def segment_weighted_centroid(x, y, z, w, seg, valid, nseg: int):
     s = jnp.where(valid, seg, nseg)
@@ -222,6 +226,7 @@ def segment_weighted_centroid(x, y, z, w, seg, valid, nseg: int):
     return clat, clon, sw
 
 
+@timed("ops.segment_rog")
 @functools.partial(jax.jit, static_argnames=("nseg",))
 def segment_rog(lat, lon, seg, valid, nseg: int):
     """Radius of gyration per segment: RMS haversine distance to the

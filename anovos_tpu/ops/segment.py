@@ -16,8 +16,10 @@ import jax
 import jax.numpy as jnp
 
 from anovos_tpu.shared.runtime import column_parallel, wants_column_parallel
+from anovos_tpu.obs import timed
 
 
+@timed("ops.masked_nunique")
 def masked_nunique(X: jax.Array, M: jax.Array) -> jax.Array:
     """Exact distinct count per column (valid entries only).
 
@@ -78,6 +80,7 @@ def _code_counts_p(codes: jax.Array, M: jax.Array, vocab_size: int) -> jax.Array
     )
 
 
+@timed("ops.code_counts")
 def code_counts(codes: jax.Array, M: jax.Array, vocab_size: int) -> jax.Array:
     """Frequency of each dictionary code for ONE categorical column.
 
@@ -102,6 +105,7 @@ def _code_label_counts_p(
     )
 
 
+@timed("ops.code_label_counts")
 def code_label_counts(
     codes: jax.Array, M: jax.Array, y: jax.Array, vocab_size: int
 ) -> jax.Array:
@@ -117,6 +121,7 @@ def _lut_gather(lut: jax.Array, codes: jax.Array) -> jax.Array:
     return lut[jnp.clip(codes, 0, lut.shape[0] - 1)]
 
 
+@timed("ops.vocab_lookup")
 def vocab_lookup(lut_host, codes: jax.Array) -> jax.Array:
     """Per-code lookup through a small host-built table.
 

@@ -196,6 +196,11 @@ class DagScheduler:
         self._res_lock = threading.Lock()
         self._res_stats = {"retries": 0, "timeout_retries": 0,
                            "failover_retries": 0, "timeout_escalations": 0}
+        # live views for the flight recorder's postmortem dumps: the nodes
+        # currently executing and the ready queue (depth only).  Maintained
+        # by both executors; read (racily, by design) at dump time.
+        self._running: Dict[str, Node] = {}
+        self._ready_view = None
 
     # -- registration ----------------------------------------------------
     def add(
@@ -289,6 +294,13 @@ class DagScheduler:
         if node_timeout is None:
             node_timeout = float(os.environ.get("ANOVOS_TPU_NODE_TIMEOUT", "900"))
         t0 = time.monotonic()
+        # devprof boundary drain probes are device syncs: fine when nodes
+        # run one at a time, but with concurrent nodes sharing a device
+        # queue they would serialize the async overlap — so concurrent
+        # runs skip them unless ANOVOS_TPU_DEVPROF=full opts in
+        self._devprof_drain = (
+            mode == "sequential"
+            or os.environ.get("ANOVOS_TPU_DEVPROF", "") == "full")
         if mode == "sequential":
             workers = 1
             self._run_sequential()
@@ -298,7 +310,7 @@ class DagScheduler:
         return self._summary(time.monotonic() - t0, mode, workers)
 
     def _execute(self, node: Node) -> None:
-        from anovos_tpu.obs import get_metrics, get_tracer
+        from anovos_tpu.obs import devprof, get_metrics, get_tracer
 
         node.state = "running"
         node.thread = threading.current_thread().name
@@ -309,7 +321,8 @@ class DagScheduler:
                 deps=[d.name for d in node.deps],
                 queue_wait_s=round(node.queue_wait, 4),
                 scheduler=self.name,
-            ):
+            ), devprof.node_bracket(node.name,
+                                    drain=getattr(self, "_devprof_drain", True)):
                 if not self._try_restore(node):
                     self._run_attempts(node)
             if not node.abandoned:
@@ -325,6 +338,10 @@ class DagScheduler:
                                  node.name, node.on_error)
             else:
                 node.state = "failed"
+                # the run is about to abort: capture the postmortem NOW,
+                # while the in-flight state still exists
+                self._flight_dump("fatal_error", node,
+                                  extra={"error": repr(e)[:300]})
                 raise
         finally:
             node.end = time.monotonic()
@@ -377,7 +394,17 @@ class DagScheduler:
                 #    watchdog flipped while this node ran — failover_granted)
                 #    the flip earns ONE re-execution outside the budget —
                 #    the node was never given a healthy backend to run on
-                flipped = self._maybe_failover(node, e) or node.failover_granted
+                pre_flip = self._backend_state()
+                flipped = self._maybe_failover(node, e)
+                if flipped:
+                    # the wedge evidence (which node, which op, what the
+                    # device looked like) dies with the flip — the dump
+                    # runs post-flip, so the pre-flip backend/HBM/wedge
+                    # snapshot rides along explicitly
+                    self._flight_dump("backend_failover", node,
+                                      extra={"error": repr(e)[:300],
+                                             "pre_flip": pre_flip})
+                flipped = flipped or node.failover_granted
                 node.failover_granted = False
                 if retryable and flipped and not node.failover_retried:
                     node.failover_retried = True
@@ -423,6 +450,16 @@ class DagScheduler:
         if self.journal is not None:
             self.journal.append("node_retry", node=node.name, kind=kind,
                                 attempt=node.attempts, error=repr(exc)[:300])
+        else:
+            # journal-less runs still feed the flight-recorder ring, in the
+            # SAME shape the journal path produces (journal.append records
+            # as ev="journal", event=<name>) so postmortem consumers match
+            # one schema regardless of whether a journal was armed
+            from anovos_tpu.obs import flight
+
+            flight.record("journal", event="node_retry", node=node.name,
+                          kind=kind, attempt=node.attempts,
+                          error=repr(exc)[:300])
         logger.warning("node %r attempt %d failed (%r); re-executing (%s)",
                        node.name, node.attempts, exc, kind)
 
@@ -459,6 +496,66 @@ class DagScheduler:
         except Exception:
             logger.exception("backend failover check for node %r failed", node.name)
             return False
+
+    # -- flight recorder ---------------------------------------------------
+    def _backend_state(self) -> dict:
+        """Backend name + per-device HBM + simulated-wedge flag, sampled
+        BEFORE a potential failover flips the runtime — the postmortem
+        must show the wedged accelerator, not the CPU it flipped to.
+        Cheap, and only called on node failures / escalated timeouts."""
+        try:
+            import sys
+
+            from anovos_tpu.obs.metrics import memory_by_device
+            from anovos_tpu.resilience import chaos
+
+            jax = sys.modules.get("jax")
+            backend = None
+            if jax is not None:
+                try:
+                    backend = jax.default_backend()
+                except Exception:
+                    backend = None
+            return {
+                "backend": backend,
+                "hbm": {dev: stats.get("bytes_in_use")
+                        for dev, stats in memory_by_device().items()},
+                "wedged": chaos.backend_wedged(),
+            }
+        except Exception:
+            return {}
+
+    def _flight_dump(self, trigger: str, node: Optional[Node] = None,
+                     extra: Optional[dict] = None) -> None:
+        """Postmortem hook (obs.flight): no-op unless workflow.main armed
+        the recorder for this run.  Reads the live running/ready views
+        racily — a dump races the pool by construction."""
+        try:
+            from anovos_tpu.obs import flight
+
+            if not flight.enabled():
+                return
+            now = time.monotonic()
+            inflight = []
+            for n in list(self._running.values()):
+                inflight.append({
+                    "node": n.name,
+                    "state": n.state,
+                    "attempts": n.attempts,
+                    "escalated": n.escalated,
+                    "elapsed_s": round(now - n.attempt_start, 3)
+                    if n.attempt_start else None,
+                    "thread": n.thread,
+                    "deps": [d.name for d in n.deps],
+                })
+            try:
+                queue_depth = len(self._ready_view) if self._ready_view is not None else 0
+            except Exception:
+                queue_depth = None
+            flight.dump(trigger, node=node.name if node is not None else "",
+                        inflight=inflight, queue_depth=queue_depth, extra=extra)
+        except Exception:
+            logger.exception("flight-recorder dump (%s) failed", trigger)
 
     # -- cache ------------------------------------------------------------
     def _try_restore(self, node: Node) -> bool:
@@ -557,12 +654,18 @@ class DagScheduler:
     def _run_sequential(self) -> None:
         for node in self._nodes:
             node.ready = time.monotonic()  # no pool: ready == start
-            self._execute(node)
+            self._running[node.name] = node
+            try:
+                self._execute(node)
+            finally:
+                self._running.pop(node.name, None)
 
     def _run_concurrent(self, max_workers: int, node_timeout: float) -> None:
         cv = threading.Condition()
         ready: "deque[Node]" = deque()
-        running: Dict[str, Node] = {}
+        self._running.clear()
+        running: Dict[str, Node] = self._running  # flight-dump live view
+        self._ready_view = ready
         state = {"stop": False, "fatal": None, "done": 0, "spawned": 0}
         total = len(self._nodes)
         t_ready0 = time.monotonic()
@@ -641,6 +744,8 @@ class DagScheduler:
                                     attempts=node.attempts, error=reason[:300])
             logger.warning("%s — abandoning the stuck attempt (thread leaked, "
                            "worker replaced) and DEGRADING the section", reason)
+            # the postmortem dump happens at the call site AFTER cv is
+            # released — file I/O under the scheduler lock stalls the pool
             running.pop(node.name, None)
             state["done"] += 1
             for dep in node.dependents:
@@ -662,6 +767,10 @@ class DagScheduler:
                     continue
                 now = time.monotonic()
                 expired: Optional[Node] = None
+                # non-fatal postmortem dumps (escalation, abandonment) do
+                # file I/O + fsync — collected here and written OUTSIDE cv
+                # so a slow disk never stalls the whole worker pool
+                pending_dumps: List[tuple] = []
                 for node in list(running.values()):
                     factor = node.policy.timeout_factor if node.escalated else 1.0
                     if now - node.attempt_start <= node_timeout * factor:
@@ -692,9 +801,24 @@ class DagScheduler:
                             "attempt and escalating once to %.1fs before the "
                             "error policy applies", node.name, node_timeout,
                             node_timeout * node.policy.timeout_factor)
+                        # first sign of a hang: dump the postmortem NOW —
+                        # if the escalated bound also blows, the evidence
+                        # of what the node was doing is already on disk
+                        pending_dumps.append(
+                            ("timeout_escalation", node,
+                             {"bound_s": round(node_timeout, 3),
+                              "factor": node.policy.timeout_factor}))
                         continue
                     expired = node
                     break
+                if pending_dumps:
+                    cv.release()
+                    try:
+                        for trig, dnode, extra in pending_dumps:
+                            self._flight_dump(trig, dnode, extra=extra)
+                    finally:
+                        cv.acquire()
+                    continue  # re-scan: state may have moved while unlocked
                 if expired is None:
                     continue
                 # escalated bound ALSO blown: probe the backend OUTSIDE the
@@ -702,7 +826,12 @@ class DagScheduler:
                 # interrupt gets one more bound to unwind into re-execution
                 cv.release()
                 try:
+                    pre_flip = self._backend_state()
                     flipped = self._watchdog_failover(expired)
+                    if flipped:
+                        self._flight_dump("backend_failover", expired,
+                                          extra={"via": "watchdog",
+                                                 "pre_flip": pre_flip})
                 finally:
                     cv.acquire()
                 if expired.name not in running:
@@ -726,10 +855,25 @@ class DagScheduler:
                         and expired.policy.on_exhausted == "degrade"):
                     abandon(expired, reason)
                     cv.notify_all()
+                    cv.release()  # the run survives: dump without stalling it
+                    try:
+                        self._flight_dump("node_abandoned", expired,
+                                          extra={"reason": reason})
+                    finally:
+                        cv.acquire()
                     continue
                 state["stop"] = True
                 state["fatal"] = NodeTimeout(reason)
                 cv.notify_all()
+                # dump OUTSIDE cv even on the fatal path: a stalled disk
+                # (the very pathology being recorded) must not turn the
+                # abort into a scheduler hang — stop is already signalled
+                cv.release()
+                try:
+                    self._flight_dump("fatal_timeout", expired,
+                                      extra={"reason": reason})
+                finally:
+                    cv.acquire()
                 break
         finally:
             cv.release()

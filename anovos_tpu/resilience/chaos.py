@@ -245,12 +245,13 @@ def chaos_point(site: str, interrupt: Optional[threading.Event] = None) -> None:
     if p is None:
         return
     for d in p.claim(site):
-        from anovos_tpu.obs import get_metrics, get_tracer
+        from anovos_tpu.obs import flight, get_metrics, get_tracer
 
         get_metrics().counter(
             "chaos_injections_total",
             "deliberate chaos-harness fault injections",
         ).inc(kind=d.kind, site=site)
+        flight.record("chaos", kind=d.kind, site=site)
         with get_tracer().span(f"chaos:{d.kind}:{site}", cat="chaos",
                                directive=d.describe()):
             logger.warning("chaos: injecting %s at %s", d.kind, site)

@@ -68,9 +68,18 @@ class Runtime:
         return NamedSharding(self.mesh, P())
 
     def shard_rows(self, arr) -> jax.Array:
-        """Place a host array on device, row-sharded over the data axis."""
+        """Place a host array on device, row-sharded over the data axis.
+
+        This is THE h2d choke point for Table construction, so it carries
+        the devprof transfer bracket: exact byte counts, dispatch-side wall
+        (``device_put`` is async — the wall is enqueue time, the bytes are
+        exact; see ``obs.devprof``)."""
+        from anovos_tpu.obs import devprof
+
         spec = P(*((self.data_axis,) + (None,) * (arr.ndim - 1)))
-        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+        with devprof.transfer_bracket("h2d", getattr(arr, "nbytes", 0),
+                                      label="runtime.shard_rows"):
+            return jax.device_put(arr, NamedSharding(self.mesh, spec))
 
     def pad_rows(self, n: int) -> int:
         """Rows are padded to a multiple of the data-axis size so every

@@ -87,15 +87,22 @@ class Column:
 
     def exact_host(self, nrows: Optional[int] = None) -> np.ndarray:
         """Host values with exactness preserved (wide pair → int64/float64)."""
+        from anovos_tpu.obs import devprof
+
         n = self.data.shape[0] if nrows is None else nrows
         if self.wide_hi is not None:
-            hi = np.asarray(jax.device_get(self.wide_hi))[:n].astype(np.int64)
-            lo = np.asarray(jax.device_get(self.wide_lo))[:n].astype(np.int64) + (1 << 31)
+            with devprof.transfer_bracket(
+                    "d2h", self.wide_hi.nbytes + self.wide_lo.nbytes,
+                    label="column.exact_host"):
+                hi = np.asarray(jax.device_get(self.wide_hi))[:n].astype(np.int64)
+                lo = np.asarray(jax.device_get(self.wide_lo))[:n].astype(np.int64) + (1 << 31)
             key = (hi << 32) + lo
             if self.wide_kind == "float":
                 return float_from_order_key(key)
             return key
-        return np.asarray(jax.device_get(self.data))[:n]
+        with devprof.transfer_bracket("d2h", self.data.nbytes,
+                                      label="column.exact_host"):
+            return np.asarray(jax.device_get(self.data))[:n]
 
 
 def wide_int_parts(v64: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -417,12 +424,19 @@ class Table:
     # host materialization
     # ------------------------------------------------------------------
     def to_pandas(self):
+        from anovos_tpu.obs import devprof
 
         out = {}
         n = self.nrows
         for name, c in self.columns.items():
-            data = np.asarray(jax.device_get(c.data))[:n]
-            mask = np.asarray(jax.device_get(c.mask))[:n]
+            # d2h materialization boundary: device_get blocks until the
+            # producing programs retire, so this wall includes the device
+            # tail a fetch waits on (devprof books it as transfer — "what
+            # the host was waiting ON", see obs.devprof)
+            with devprof.transfer_bracket("d2h", c.data.nbytes + c.mask.nbytes,
+                                          label="table.to_pandas"):
+                data = np.asarray(jax.device_get(c.data))[:n]
+                mask = np.asarray(jax.device_get(c.mask))[:n]
             if c.kind == "cat":
                 vals = np.empty(n, dtype=object)
                 valid = mask & (data >= 0)

@@ -73,6 +73,8 @@ from anovos_tpu.obs import (
     build_manifest,
     compile_census,
     config_hash,
+    devprof,
+    flight,
     get_metrics,
     get_tracer,
     record_cache_stats,
@@ -1019,6 +1021,13 @@ def main(
         obs_dir = obs_store.staging_dir(obs_base)
         trace_dest = trace_destination(obs_dir)
         manifest_path = os.path.abspath(os.path.join(obs_dir, "obs", "run_manifest.json"))
+        # device-time attribution + flight recorder are armed per run: a
+        # fresh devprof result set (and a warmed drain probe, so the first
+        # node doesn't book the probe's compile), and postmortem dumps
+        # pointed at this run's obs/ subtree (ANOVOS_TPU_FLIGHTREC=0 opts
+        # out; a clean run writes no dump either way)
+        devprof.reset()
+        flight.configure(os.path.join(obs_dir, "obs"))
 
         journal = None
         resumed_from = 0
@@ -1067,7 +1076,12 @@ def main(
                     **summary.get("resilience", {}),
                     "degraded_sections": res_policy.degraded_sections(),
                     "chaos": chaos_plan.summary() if chaos_plan else None,
+                    # postmortems written this run (empty on a clean run);
+                    # each names the trigger + node in its own JSON
+                    "flight_dumps": [os.path.basename(p)
+                                     for p in flight.dump_paths()],
                 },
+                devprof=devprof.results() or None,
             )
             # the manifest rides the same async write queue as every other
             # artifact; close() below drains it

@@ -311,6 +311,7 @@ def e2e_cold_warm() -> dict:
     blocks = {}
     summary = {}
     census = {}
+    devprof = {}
     cwd = os.getcwd()
     for label in ("cold", "warm"):
         with tempfile.TemporaryDirectory() as d:
@@ -330,6 +331,9 @@ def e2e_cold_warm() -> dict:
                 # per-run XLA compile census (cold = the shape-bucketing
                 # regression signal; warm should be ~zero)
                 census[label] = dict(man.get("compile_census") or {})
+                # per-node device-time attribution (warm run wins the
+                # loop): where the steady-state wall actually goes
+                devprof = dict(man.get("devprof") or {})
             finally:
                 os.chdir(cwd)
     try:
@@ -357,6 +361,21 @@ def e2e_cold_warm() -> dict:
             "e2e_distinct_programs": census["cold"].get("distinct_programs"),
             "e2e_cold_compile_wall_s": census["cold"].get("compile_seconds_total"),
             "e2e_warm_compiles": (census.get("warm") or {}).get("compiles_total"),
+        })
+    if devprof:
+        # devprof attribution sums over the warm run's nodes: device-queue
+        # drain vs dispatch vs host↔device transfer (obs.devprof; the
+        # perf ledger tracks the first two as regression fields)
+        result.update({
+            "e2e_device_time_s": round(
+                sum(v.get("device_time_s", 0.0) for v in devprof.values()), 4),
+            "e2e_dispatch_s": round(
+                sum(v.get("dispatch_s", 0.0) for v in devprof.values()), 4),
+            "e2e_transfer_s": round(
+                sum(v.get("transfer_s", 0.0) for v in devprof.values()), 4),
+            "e2e_transfer_bytes": int(
+                sum(v.get("h2d_bytes", 0) + v.get("d2h_bytes", 0)
+                    for v in devprof.values())),
         })
     if summary:
         # DAG-executor observability (warm run): serial work vs wall,
@@ -712,6 +731,18 @@ def main() -> None:
         os.unlink(ref_path)
     except OSError:
         pass
+
+    # ---- perf ledger: append this run + gate it against its history -----
+    # a HARD field of every round record from now on: ledger_ok/regressions
+    # always present (ledger_error when the machinery itself broke), so a
+    # perf regression shows in the round JSON instead of a human diff
+    try:
+        from tools.perf_ledger import record_and_check
+
+        result.update(record_and_check(result))
+    except Exception as e:
+        result["ledger_ok"] = False
+        result["ledger_error"] = str(e)[-200:]
     print(json.dumps(result))
 
 

@@ -235,6 +235,13 @@ def bench_e2e() -> dict:
         "incremental_wall_s": r.get("e2e_incremental_wall_s"),
         "cache_hits": r.get("e2e_cache_hits"),
         "cache_error": r.get("e2e_cache_error"),
+        # device-time attribution (obs.devprof via the warm manifest):
+        # where the steady-state wall goes — device-queue drain vs op
+        # dispatch vs host<->device transfer — plus the moved bytes
+        "device_time_s": r.get("e2e_device_time_s"),
+        "dispatch_s": r.get("e2e_dispatch_s"),
+        "transfer_s": r.get("e2e_transfer_s"),
+        "transfer_bytes": r.get("e2e_transfer_bytes"),
         # resilience recovery overhead (bench.e2e_chaos_recovery): the
         # chaos-scenario run's wall vs its clean golden, and what the
         # recovery did — tracked like the cache and compile trajectories
@@ -395,6 +402,12 @@ def _write_md(r: dict) -> None:
         if "warm_wall_s" in e:
             lines.append(f"| | warm wall | {e['warm_wall_s']} s |")
             lines.append(f"| | warm rows/sec/chip (headline) | {e['warm_rows_per_sec_per_chip']} |")
+        if e.get("device_time_s") is not None:
+            mb = (e.get("transfer_bytes") or 0) / 1e6
+            lines.append(
+                f"| | warm devprof split | device {e['device_time_s']} s / "
+                f"dispatch {e.get('dispatch_s')} s / transfer "
+                f"{e.get('transfer_s')} s ({mb:.1f} MB moved) |")
         for blk, secs in (e.get("warm_blocks") or {}).items():
             lines.append(f"| | warm block: {blk} | {secs} s |")
         if e.get("warm_blocks"):

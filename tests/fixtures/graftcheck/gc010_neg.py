@@ -1,0 +1,40 @@
+"""GC010 negative fixture: attributed dispatch patterns stay quiet."""
+
+import jax
+
+from anovos_tpu.obs import devprof, timed
+
+_kernel = jax.jit(lambda x: x * 2.0)
+
+
+@timed("ops.wrapped_entry")
+def wrapped_entry(x):
+    # the timed() wrapper owns the attribution
+    return _kernel(x)
+
+
+def helper_under_timed(x):
+    # public but called directly by a timed() function below: attribution
+    # flows to the wrapper (double-wrapping would double-count dispatch)
+    return _kernel(x)
+
+
+@timed("ops.wrapped_caller")
+def wrapped_caller(x):
+    return helper_under_timed(x)
+
+
+def bracketed_entry(x):
+    # explicit devprof bracket instead of the decorator
+    with devprof.dispatch_bracket("ops.bracketed_entry"):
+        return _kernel(x)
+
+
+def _private_dispatch(x):
+    # private helper: not an entry point
+    return _kernel(x)
+
+
+def host_only(n):
+    # no device dispatch at all
+    return [i * 2 for i in range(n)]

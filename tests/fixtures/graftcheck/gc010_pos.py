@@ -1,0 +1,34 @@
+"""GC010 positive fixture: public ops entry points dispatching device
+programs with no timed()/devprof attribution."""
+
+import jax
+
+_kernel = jax.jit(lambda x: x * 2.0)
+
+
+@jax.jit
+def _decorated_kernel(x):
+    return x + 1.0
+
+
+def bare_entry(x):
+    # calls a module-level jitted callable, unattributed
+    return _kernel(x)
+
+
+def fetches_result(x):
+    # host-blocking fetch — the dispatch tail by definition
+    return jax.device_get(_kernel(x))
+
+
+def blocks_on_ready(x):
+    return _decorated_kernel(x).block_until_ready()
+
+
+def via_private_helper(x):
+    # the dispatch hides one level down in a private same-file helper
+    return _helper(x)
+
+
+def _helper(x):
+    return _kernel(x)

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import argparse
 import copy
+import fnmatch
 import hashlib
 import json
 import os
@@ -64,6 +65,35 @@ EXPECT = {
     "wedge": ("failovers",),
     "full": ("retries", "timeout_escalations", "timeout_retries", "failovers"),
 }
+
+# flight-recorder postmortems the chaos run must produce: (trigger, node
+# glob) pairs per scenario.  A CLEAN run must produce none — asserted for
+# every scenario (obs/ is excluded from the artifact tree hash, so the
+# dumps never perturb byte parity; their ABSENCE on clean runs is the
+# contract being gated here).
+EXPECT_FLIGHT = {
+    "exc": (),  # an absorbed retry is not a postmortem trigger
+    "hang": (("timeout_escalation", "quality_checker/*"),),
+    "wedge": (("backend_failover", "drift_detector/*"),),
+    "full": (("timeout_escalation", "quality_checker/*"),
+             ("backend_failover", "drift_detector/*")),
+}
+
+
+def flight_dumps(root) -> list:
+    """(path, trigger, node) of every flight-recorder dump under ``root``."""
+    import glob as _glob
+
+    out = []
+    for p in sorted(_glob.glob(os.path.join(root, "**", "flightrec_*.json"),
+                               recursive=True)):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+            out.append((p, doc.get("trigger", ""), doc.get("node", "")))
+        except (OSError, ValueError):
+            out.append((p, "<unreadable>", ""))
+    return out
 
 
 def tree_hash(root) -> str:
@@ -129,9 +159,13 @@ def _run_once(cfg: dict, rundir: str, chaos_spec: str, node_timeout: str) -> dic
     prev_cwd = os.getcwd()
     prev_env = {k: os.environ.get(k) for k in
                 ("ANOVOS_TPU_CHAOS", "ANOVOS_TPU_EXECUTOR",
-                 "ANOVOS_TPU_NODE_TIMEOUT", "ANOVOS_TPU_CACHE")}
+                 "ANOVOS_TPU_NODE_TIMEOUT", "ANOVOS_TPU_CACHE",
+                 "ANOVOS_TPU_FLIGHTREC")}
     try:
         os.environ.pop("ANOVOS_TPU_CACHE", None)  # parity gate runs uncached
+        # the flightrec gate asserts dumps appear (and that clean runs have
+        # none) — an ambient ANOVOS_TPU_FLIGHTREC=0 would fail it spuriously
+        os.environ.pop("ANOVOS_TPU_FLIGHTREC", None)
         os.environ["ANOVOS_TPU_EXECUTOR"] = "concurrent"
         os.environ["ANOVOS_TPU_NODE_TIMEOUT"] = node_timeout
         if chaos_spec:
@@ -159,9 +193,16 @@ def run_scenario(scenario: str, workdir: str, config: dict = None,
     result = {"scenario": scenario, "spec": chaos_spec}
 
     t0 = time.monotonic()
-    _run_once(cfg, os.path.join(workdir, "clean"), "", node_timeout)
+    # the small node_timeout exists so the CHAOS run's injected hang
+    # escalates quickly; the clean run gets a generous bound — otherwise a
+    # legitimately slow node on a loaded box escalates, writes a flight
+    # dump, and fails the clean_flightrec==0 assertion spuriously
+    clean_timeout = str(max(float(node_timeout), 600.0))
+    _run_once(cfg, os.path.join(workdir, "clean"), "", clean_timeout)
     result["clean_wall_s"] = round(time.monotonic() - t0, 3)
     golden = tree_hash(os.path.join(workdir, "clean"))
+    clean_dumps = flight_dumps(os.path.join(workdir, "clean"))
+    result["clean_flightrec"] = len(clean_dumps)
 
     t0 = time.monotonic()
     try:
@@ -181,9 +222,25 @@ def run_scenario(scenario: str, workdir: str, config: dict = None,
     missing = [k for k in EXPECT.get(scenario, ()) if not res.get(k)]
     result["missing_counters"] = missing
     result["degraded"] = res.get("degraded", [])
+    # flight-recorder postmortems: each expected (trigger, node glob) must
+    # have a dump naming a matching node; the clean run must have produced
+    # none at all
+    dumps = flight_dumps(os.path.join(workdir, "chaos"))
+    result["flightrec"] = [
+        {"file": os.path.basename(p), "trigger": trig, "node": node}
+        for p, trig, node in dumps
+    ]
+    flight_missing = [
+        f"{trig}@{pat}"
+        for trig, pat in EXPECT_FLIGHT.get(scenario, ())
+        if not any(t == trig and fnmatch.fnmatchcase(n, pat)
+                   for _, t, n in dumps)
+    ]
+    result["flightrec_missing"] = flight_missing
     result["ok"] = bool(
         result["parity"] and not missing and not result["degraded"]
-        and result["injections"] > 0)
+        and result["injections"] > 0 and not flight_missing
+        and result["clean_flightrec"] == 0)
     if not result["ok"] and "error" not in result:
         reasons = []
         if not result["parity"]:
@@ -195,6 +252,13 @@ def run_scenario(scenario: str, workdir: str, config: dict = None,
                            f"the faults): {result['degraded']}")
         if result["injections"] == 0:
             reasons.append("chaos plan fired nothing (site names drifted?)")
+        if flight_missing:
+            reasons.append("expected flight-recorder dump(s) missing: "
+                           f"{flight_missing} (got {result['flightrec']})")
+        if result["clean_flightrec"]:
+            reasons.append(
+                f"{result['clean_flightrec']} flight-recorder dump(s) on the "
+                "CLEAN run — postmortems must only fire on real trouble")
         result["error"] = "; ".join(reasons)
     return result
 

@@ -10,4 +10,5 @@ from tools.graftcheck.rules import (  # noqa: F401  (import = registration)
     gc007_no_print,
     gc008_cache_key,
     gc009_swallowed_exception,
+    gc010_unattributed_dispatch,
 )
