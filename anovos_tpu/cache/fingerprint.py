@@ -61,6 +61,11 @@ KNOWN_ENV_KNOBS = (
     # ANOVOS_TPU_HEALTH_TIMEOUT) stay off the list: successful recovery is
     # byte-identical by contract (tests/test_resilience.py)
     "ANOVOS_TPU_CHAOS",
+    # node placement changes float artifacts (a device-placed analyzer and
+    # its mesh-placed twin reduce in different layouts); the per-node
+    # placement string is also folded into each node's key material, but
+    # the global override must invalidate runs wholesale too
+    "ANOVOS_TPU_PLACEMENT",
 )
 
 
@@ -121,18 +126,29 @@ def dataset_fingerprint(spec: Optional[dict]) -> str:
 
 
 def env_fingerprint() -> str:
-    """The audited runtime knobs (KNOWN_ENV_KNOBS) plus the backend name —
-    cpu and tpu runs of the same config legitimately differ in float
-    artifacts, so they must never share cache entries."""
+    """The audited runtime knobs (KNOWN_ENV_KNOBS) plus the backend name
+    and device count — cpu and tpu runs of the same config legitimately
+    differ in float artifacts, and so do 1- and 8-device runs (row
+    padding and reduction layouts follow the mesh, and node placement
+    resolves against the device set), so none of them may share cache
+    entries."""
     backend = ""
+    n_devices = 0
     jax = sys.modules.get("jax")  # never import jax for a hash
     if jax is not None:
         try:
             backend = jax.default_backend()
         except Exception:
             backend = ""
+        try:
+            from anovos_tpu.shared.runtime import peek_runtime
+
+            rt = peek_runtime()  # never INIT a runtime for a hash either
+            n_devices = rt.n_devices if rt is not None else 0
+        except Exception:
+            n_devices = 0
     knobs = {k: os.environ.get(k, "") for k in KNOWN_ENV_KNOBS}
-    return digest(canonical(knobs), backend)
+    return digest(canonical(knobs), backend, str(n_devices))
 
 
 def base_material(all_configs: dict, run_type: str = "local") -> str:

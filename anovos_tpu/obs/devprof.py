@@ -108,10 +108,17 @@ def enabled() -> bool:
 class _Frame:
     __slots__ = ("name", "t0", "dispatch_s", "transfer_s", "device_s",
                  "h2d_bytes", "d2h_bytes", "dispatches", "transfers",
-                 "last_op", "hbm0", "_lock")
+                 "last_op", "hbm0", "lane", "devices", "_lock")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, lane: Optional[str] = None,
+                 devices: Optional[list] = None):
         self.name = name
+        # executor-lane attribution (PR 8): which lane the scheduler ran
+        # this node on (mesh | submesh | device | host) and the leased
+        # device labels — rides every result/snapshot so postmortems and
+        # the manifest can tell rendezvous-lane time from fan-out time
+        self.lane = lane
+        self.devices = list(devices or [])
         self.t0 = time.perf_counter()
         self.dispatch_s = 0.0
         self.transfer_s = 0.0
@@ -140,14 +147,18 @@ class _Frame:
             self.transfers += 1
             if direction == "h2d":
                 self.h2d_bytes += nbytes
-            else:
+            elif direction == "d2h":
                 self.d2h_bytes += nbytes
+            # d2d (placement re-lays) books wall only; bytes live in the
+            # process-wide transfer_d2d_bytes_total counter
             self.last_op = label
 
     def snapshot(self) -> dict:
         """In-flight view (flight-recorder dumps read this mid-node)."""
         with self._lock:
             return {
+                "lane": self.lane,
+                "devices": list(self.devices),
                 "elapsed_s": round(time.perf_counter() - self.t0, 4),
                 "dispatch_s": round(self.dispatch_s, 4),
                 "transfer_s": round(self.transfer_s, 4),
@@ -203,6 +214,9 @@ class _Frame:
             "last_op": self.last_op,
             "clamped": clamped,
         }
+        if self.lane is not None:
+            out["lane"] = self.lane
+            out["devices"] = list(self.devices)
         if any(hbm_delta.values()):
             out["hbm_delta_bytes"] = hbm_delta
         return out
@@ -249,7 +263,9 @@ def reset() -> None:
 
 
 @contextmanager
-def node_bracket(name: str, drain: Optional[bool] = None):
+def node_bracket(name: str, drain: Optional[bool] = None,
+                 lane: Optional[str] = None,
+                 devices: Optional[list] = None):
     """Attribute one scheduler node; results land in :func:`results`.
 
     ``drain`` controls the exit boundary probe.  The probe is a device
@@ -271,7 +287,7 @@ def node_bracket(name: str, drain: Optional[bool] = None):
         return
     if drain is None:
         drain = True
-    frame = _Frame(name)
+    frame = _Frame(name, lane=lane, devices=devices)
     prev = getattr(_TL, "frame", None)
     _TL.frame = frame
     with _LOCK:
@@ -366,14 +382,15 @@ def record_transfer(direction: str, nbytes: int, seconds: float,
     (``data_ingest._concat_columns``) must go quiet under
     ``ANOVOS_TPU_DEVPROF=0`` too, or a disabled run reports a partial,
     inconsistent transfer tally."""
-    if direction not in ("h2d", "d2h"):
-        raise ValueError(f"direction must be h2d|d2h, got {direction!r}")
+    if direction not in ("h2d", "d2h", "d2d"):
+        raise ValueError(f"direction must be h2d|d2h|d2d, got {direction!r}")
     if not enabled():
         return
     get_metrics().counter(
         f"transfer_{direction}_bytes_total",
-        f"bytes moved {'host->device' if direction == 'h2d' else 'device->host'} "
-        "at Table materialization boundaries",
+        "bytes moved %s at Table materialization/placement boundaries"
+        % {"h2d": "host->device", "d2h": "device->host",
+           "d2d": "device->device (placement re-lays)"}[direction],
     ).inc(nbytes)
     frame = getattr(_TL, "frame", None)
     if frame is None:

@@ -143,10 +143,17 @@ def _span_tail() -> List[dict]:
 
 def dump(trigger: str, node: str = "", inflight: Optional[List[dict]] = None,
          queue_depth: Optional[int] = None,
+         rendezvous_holders: Optional[List[str]] = None,
          extra: Optional[dict] = None) -> Optional[str]:
     """Write the postmortem for ``trigger`` (one of the four classes in
     the module doc).  Returns the path, or None when disarmed/failed —
-    a flight recorder must never take the run down with it."""
+    a flight recorder must never take the run down with it.
+
+    ``inflight`` entries carry each node's executor ``lane`` and leased
+    ``devices`` (multi-device DAG execution), and ``rendezvous_holders``
+    names the node(s) holding the collective rendezvous lane — together
+    they are the evidence a rendezvous-deadlock postmortem needs: WHICH
+    collective was in flight, on which chips."""
     with _LOCK:
         ring, out_dir = _RING, _DIR
         events = list(ring) if ring is not None else []
@@ -182,6 +189,7 @@ def dump(trigger: str, node: str = "", inflight: Optional[List[dict]] = None,
             "backend": backend,
             "inflight": inflight_out,
             "queue_depth": queue_depth,
+            "rendezvous_holders": list(rendezvous_holders or []),
             "hbm": {
                 dev: {k: stats.get(k) for k in
                       ("bytes_in_use", "peak_bytes_in_use") if k in stats}

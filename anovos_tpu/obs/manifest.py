@@ -132,6 +132,10 @@ def load_manifest(path: str) -> dict:
 # the same run misses cold and hits warm)
 _VOLATILE_NODE_FIELDS = ("start_s", "end_s", "dur_s", "queue_wait_s", "thread",
                          "cached",
+                         # which chips the lease registry handed out depends
+                         # on worker timing; the node's LANE is identity,
+                         # its leased device ids are not
+                         "devices",
                          # recovery state depends on FAULT history (chaos
                          # plan, real flakes, watchdog timing), never on what
                          # the run computes
@@ -166,7 +170,9 @@ def stable_view(manifest: dict) -> dict:
     out = {k: v for k, v in manifest.items() if k not in _VOLATILE_TOP_FIELDS}
     sched = dict(out.get("scheduler") or {})
     for k in ("wall_s", "serial_s", "critical_path_s", "parallel_speedup",
-              "critical_path", "cache", "resilience"):
+              "critical_path", "cache", "resilience",
+              # measured-span overlap is wall-clock-derived, like speedup
+              "multidev_overlap"):
         sched.pop(k, None)
     sched["nodes"] = {
         name: {k: v for k, v in node.items() if k not in _VOLATILE_NODE_FIELDS}

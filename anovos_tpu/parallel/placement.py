@@ -1,0 +1,95 @@
+"""Declarative node placement: where a scheduler node's device work runs.
+
+PR 1's DagScheduler degraded to sequential whenever more than one device
+was present, because two concurrently dispatched programs that both carry
+cross-device collectives can enqueue onto the per-device streams in
+different orders and deadlock at their AllReduce rendezvous.  The fix is
+not "never overlap" — it is *knowing which nodes dispatch collectives*.
+That classification is data, not folklore: every scheduler registration
+declares a :class:`Placement`, graftcheck's GC011 rule audits the
+declaration against the body's actual dispatches, and the executor
+derives its lane discipline from it:
+
+* ``mesh`` — the node's programs span the global mesh and carry
+  cross-device collectives (psum/all-gather/all-to-all).  Collective
+  nodes run on the **rendezvous lane**: at most one collective program
+  set in flight mesh-wide, so the rendezvous order is total and cannot
+  deadlock.
+* ``submesh:N`` — collective, but over a carved N-device sub-mesh.  Two
+  sub-mesh nodes whose device sets are disjoint may overlap (their
+  collectives never share a stream); the lease registry enforces
+  disjointness.
+* ``device`` — the node's device work is confined to ONE leased chip.
+  The executor re-places the node's table inputs onto a single-device
+  mesh (``Table.to_active_placement``) and pins uncommitted dispatches
+  with ``jax.default_device``; single-device programs carry no
+  rendezvous, so any number may overlap each other and the rendezvous
+  lane.
+* ``host`` — the node dispatches no device programs at all (report
+  rendering, CSV shuffling).  No lease, no pinning; free overlap.
+
+The dataclass is deliberately jax-free so the scheduler can reason about
+lanes without importing a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+__all__ = ["Placement", "parse_placement", "MESH", "DEVICE", "HOST"]
+
+_KINDS = ("mesh", "submesh", "device", "host")
+
+
+@dataclasses.dataclass(frozen=True)
+class Placement:
+    """Where one node's device work is allowed to run.
+
+    ``kind`` is one of ``mesh`` / ``submesh`` / ``device`` / ``host``;
+    ``n_devices`` is the sub-mesh width request (``submesh`` only).
+    """
+
+    kind: str = "host"
+    n_devices: int = 0
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"placement kind must be one of {_KINDS}, got {self.kind!r}")
+        if self.kind == "submesh" and self.n_devices < 1:
+            raise ValueError("submesh placement needs n_devices >= 1")
+
+    @property
+    def collective(self) -> bool:
+        """True when the node's programs carry cross-device collectives —
+        the property the rendezvous lane serializes on."""
+        return self.kind in ("mesh", "submesh")
+
+    def describe(self) -> str:
+        if self.kind == "submesh":
+            return f"submesh:{self.n_devices}"
+        return self.kind
+
+
+MESH = Placement("mesh")
+DEVICE = Placement("device")
+HOST = Placement("host")
+
+
+def parse_placement(spec: Union[None, str, Placement]) -> Placement:
+    """``None`` (unplaced library nodes) → ``host``; strings are
+    ``"mesh"`` / ``"device"`` / ``"host"`` / ``"submesh:N"``."""
+    if spec is None:
+        return HOST
+    if isinstance(spec, Placement):
+        return spec
+    if not isinstance(spec, str):
+        raise ValueError(f"placement must be a string or Placement, got {spec!r}")
+    if spec.startswith("submesh:"):
+        try:
+            n = int(spec.split(":", 1)[1])
+        except ValueError:
+            raise ValueError(f"bad submesh placement {spec!r} (submesh:N)")
+        return Placement("submesh", n)
+    return Placement(spec)

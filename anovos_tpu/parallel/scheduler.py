@@ -80,23 +80,43 @@ Design properties:
   and continues.  A node whose RAW dep has no fingerprint is uncacheable
   (its inputs are unidentifiable), as is any node without a policy.
 
-Caveat: concurrent mode must only run device work against a SINGLE-device
-runtime.  On a multi-device mesh, two concurrently dispatched programs that
-both carry cross-device collectives can enqueue onto the per-device streams
-in different orders and deadlock at their AllReduce rendezvous —
-``workflow.main`` enforces this by degrading to sequential when it sees
-more than one device.
+* **Collective-aware lanes (multi-device meshes).**  Concurrency used to
+  be single-device-only: two concurrently dispatched programs that both
+  carry cross-device collectives can enqueue onto the per-device streams
+  in different orders and deadlock at their AllReduce rendezvous, so
+  ``workflow.main`` degraded to sequential whenever >1 device was
+  present.  Now every registration declares a
+  :class:`~anovos_tpu.parallel.placement.Placement` (``mesh`` /
+  ``submesh:N`` / ``device`` / ``host`` — audited against the body's
+  actual dispatches by graftcheck GC011) and the executor derives lane
+  discipline from it: collective nodes claim the **rendezvous lane**
+  through the runtime's :class:`~anovos_tpu.shared.runtime.
+  DeviceLeaseRegistry` (at most one collective claim covering any chip,
+  so the rendezvous order stays total — sub-mesh nodes with disjoint
+  carves may overlap), while ``device``-placed nodes lease one chip
+  each, run under a :func:`~anovos_tpu.shared.runtime.placement_scope`
+  (their tables re-placed onto the leased chip, uncommitted dispatch
+  pinned via ``jax.default_device``) and fan out freely — single-device
+  programs carry no rendezvous, so any number may overlap each other
+  and the collective in flight.  ``host`` nodes never touch a device
+  and need no lease.  On single-device runtimes (or without a runtime)
+  the lane machinery is inert and behavior is exactly the PR 1
+  scheduler.  Leases are released when a node finishes, degrades, or is
+  abandoned — a hang escalation interrupts the collective attempt
+  without wedging the rendezvous lane (the chaos ``hang-collective``
+  scenario gates this).
 """
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import threading
 import time
-from collections import deque
 from typing import Callable, Dict, Iterable, List, Optional, Union
 
+from anovos_tpu.parallel.placement import Placement, parse_placement
 from anovos_tpu.resilience.policy import ErrorPolicy, parse_policy
 
 logger = logging.getLogger("anovos_tpu.parallel.scheduler")
@@ -109,16 +129,31 @@ class NodeTimeout(RuntimeError):
 
 
 def default_workers() -> int:
-    """Worker-pool width: env override, else a small pool sized to the host.
+    """Worker-pool width: env override, else sized to the host AND mesh.
 
     On a single-core host a wide pool only timeshares compute and inflates
     per-block walls; two workers still overlap device compute with host
     file I/O (both release the GIL) without distorting block timings.
+
+    On a multi-device runtime the pool must cover the rendezvous lane plus
+    one worker per leasable chip — device-placed fan-out nodes are chip-
+    bound, not host-core-bound (XLA releases the GIL), so sizing the pool
+    to host CPUs alone would leave leased chips idle behind the queue.
     """
     env = os.environ.get("ANOVOS_TPU_EXECUTOR_WORKERS", "")
     if env:
         return max(1, int(env))
-    return max(2, min(8, available_cpus()))
+    base = max(2, min(8, available_cpus()))
+    try:
+        from anovos_tpu.shared.runtime import peek_runtime
+
+        rt = peek_runtime()  # never init a backend just to size a pool
+        n_dev = rt.n_devices if rt is not None else 0
+    except Exception:  # pragma: no cover - runtime import failure
+        n_dev = 0
+    if n_dev > 1:
+        return max(base, min(n_dev + 1, 16))
+    return base
 
 
 def available_cpus() -> int:
@@ -136,6 +171,8 @@ class Node:
         "name", "fn", "reads", "writes", "on_error", "deps", "dependents",
         "pending", "state", "start", "end", "ready", "thread", "error",
         "cache", "fingerprint", "cached",
+        # lane state (collective-aware multi-device execution)
+        "placement", "lease", "devices",
         # resilience state (anovos_tpu.resilience)
         "policy", "attempts", "attempt_start", "interrupt",
         "timeout_retried", "failover_retried", "failover_granted",
@@ -143,11 +180,15 @@ class Node:
     )
 
     def __init__(self, name: str, fn: Callable[[], None], reads, writes,
-                 on_error: Union[str, ErrorPolicy]):
+                 on_error: Union[str, ErrorPolicy],
+                 placement: Union[None, str, Placement] = None):
         self.name = name
         self.fn = fn
         self.reads = tuple(reads)
         self.writes = tuple(writes)
+        self.placement = parse_placement(placement)  # raises on unknown kind
+        self.lease = None           # DeviceLease while claimed/running
+        self.devices: List[str] = []  # leased device labels (telemetry)
         self.policy = parse_policy(on_error)   # raises on an unknown mode
         self.on_error = self.policy.describe()
         self.deps: List["Node"] = []
@@ -201,6 +242,13 @@ class DagScheduler:
         # by both executors; read (racily, by design) at dump time.
         self._running: Dict[str, Node] = {}
         self._ready_view = None
+        # chip-lease registry for lane-aware execution (multi-device
+        # runtimes only; None keeps the lane machinery inert) + the
+        # runtime generation it was built against — a mid-run failover
+        # rebuilds the runtime, after which lease devices are resolved
+        # by stable id into the new device set (see _lease_devices)
+        self._lanes = None
+        self._lanes_gen = -1
 
     # -- registration ----------------------------------------------------
     def add(
@@ -211,6 +259,7 @@ class DagScheduler:
         writes: Iterable[str] = (),
         on_error: Union[str, ErrorPolicy] = "raise",
         cache=None,
+        placement: Union[None, str, Placement] = None,
     ) -> Node:
         """Register ``fn`` as node ``name``.
 
@@ -231,10 +280,18 @@ class DagScheduler:
         ``cache`` (a :class:`~anovos_tpu.cache.NodeCachePolicy`) makes the
         node cacheable: its fingerprint is the policy's key material folded
         with the fingerprints of its RAW-edge producers.
+
+        ``placement`` (:class:`~anovos_tpu.parallel.placement.Placement`
+        or ``"mesh"``/``"submesh:N"``/``"device"``/``"host"``) declares
+        where the body's device work runs; on multi-device runtimes the
+        executor derives its lane discipline from it.  ``None`` defaults
+        to ``host`` — a node that dispatches device programs on a multi-
+        device mesh MUST declare itself (graftcheck GC011 audits the
+        workflow's declarations).
         """
         if name in self._by_name:
             raise ValueError(f"duplicate node name {name!r}")
-        node = Node(name, fn, reads, writes, on_error)
+        node = Node(name, fn, reads, writes, on_error, placement=placement)
         node.cache = cache
         deps: "dict[int, Node]" = {}  # id -> Node, insertion-ordered, deduped
         raw_deps: "dict[int, Node]" = {}  # the content-carrying subset
@@ -309,20 +366,78 @@ class DagScheduler:
             self._run_concurrent(workers, node_timeout)
         return self._summary(time.monotonic() - t0, mode, workers)
 
+    # -- lanes (collective-aware multi-device execution) -------------------
+    def _lane_registry(self):
+        """The runtime's chip-lease registry, or None when the lane
+        machinery is inert (no runtime yet, or a single-device one).
+        Never initializes a backend."""
+        try:
+            from anovos_tpu.shared.runtime import peek_runtime, runtime_generation
+        except ImportError:  # pragma: no cover - no jax at all
+            return None
+        rt = peek_runtime()
+        if rt is None or rt.n_devices <= 1:
+            return None
+        self._lanes = rt.lease_registry()
+        self._lanes_gen = runtime_generation()
+        return self._lanes
+
+    def _lease_devices(self, lease) -> tuple:
+        """The lease's devices, re-resolved by stable device id when a
+        mid-run failover rebuilt the runtime underneath the registry (the
+        lease stays valid as a lane token; the actual chips must come
+        from the live device set).  The remap dedupes — a flip onto a
+        narrower device set shrinks a multi-chip carve rather than build
+        a mesh with repeated devices."""
+        from anovos_tpu.shared.runtime import peek_runtime, runtime_generation
+
+        if runtime_generation() == self._lanes_gen or not lease.devices:
+            return lease.devices
+        rt = peek_runtime()
+        if rt is None:
+            return lease.devices
+        devs = list(rt.mesh.devices.flat)
+        return tuple(dict.fromkeys(devs[d.id % len(devs)]
+                                   for d in lease.devices))
+
+    def _node_scope(self, node: Node):
+        """The execution context a node's lease implies: device/submesh
+        leases enter a placement scope over a runtime derived from the
+        leased chips (tables built inside land there) and pin uncommitted
+        single-device dispatch via ``jax.default_device``; mesh/host
+        leases (and unlaned runs) need no scope."""
+        lease = node.lease
+        if lease is None or lease.kind in ("host", "mesh") or not lease.devices:
+            return contextlib.nullcontext()
+        import jax
+
+        from anovos_tpu.shared.runtime import derive_runtime, placement_scope
+
+        devices = self._lease_devices(lease)
+        stack = contextlib.ExitStack()
+        stack.enter_context(placement_scope(derive_runtime(devices)))
+        if lease.kind == "device":
+            stack.enter_context(jax.default_device(devices[0]))
+        return stack
+
     def _execute(self, node: Node) -> None:
         from anovos_tpu.obs import devprof, get_metrics, get_tracer
 
         node.state = "running"
         node.thread = threading.current_thread().name
+        node.devices = node.lease.device_labels() if node.lease else []
         node.start = time.monotonic()
         try:
             with get_tracer().span(
                 node.name, cat="node",
                 deps=[d.name for d in node.deps],
                 queue_wait_s=round(node.queue_wait, 4),
+                lane=node.placement.describe(),
                 scheduler=self.name,
             ), devprof.node_bracket(node.name,
-                                    drain=getattr(self, "_devprof_drain", True)):
+                                    drain=getattr(self, "_devprof_drain", True),
+                                    lane=node.placement.describe(),
+                                    devices=node.devices):
                 if not self._try_restore(node):
                     self._run_attempts(node)
             if not node.abandoned:
@@ -375,8 +490,14 @@ class DagScheduler:
             if node.interrupt.is_set():
                 node.interrupt = threading.Event()  # fresh event per attempt
             try:
-                chaos.chaos_point(f"node:{node.name}", interrupt=node.interrupt)
-                self._run_body(node)
+                # the placement scope is entered PER ATTEMPT, not per node:
+                # a post-failover retry must re-derive its devices from the
+                # rebuilt runtime (a scope held across the flip would pin
+                # the retry to the dead backend's devices)
+                with self._node_scope(node):
+                    chaos.chaos_point(f"node:{node.name}",
+                                      interrupt=node.interrupt)
+                    self._run_body(node)
                 return
             except KeyboardInterrupt:
                 raise
@@ -538,6 +659,7 @@ class DagScheduler:
             now = time.monotonic()
             inflight = []
             for n in list(self._running.values()):
+                lease = n.lease  # racy read by design
                 inflight.append({
                     "node": n.name,
                     "state": n.state,
@@ -546,14 +668,25 @@ class DagScheduler:
                     "elapsed_s": round(now - n.attempt_start, 3)
                     if n.attempt_start else None,
                     "thread": n.thread,
+                    # which lane this node occupies and which chips it
+                    # holds — a rendezvous deadlock postmortem must show
+                    # WHICH collective was in flight on which devices
+                    "lane": (lease.kind if lease is not None
+                             else n.placement.describe()),
+                    "devices": (lease.device_labels() if lease is not None
+                                else list(n.devices)),
                     "deps": [d.name for d in n.deps],
                 })
             try:
                 queue_depth = len(self._ready_view) if self._ready_view is not None else 0
             except Exception:
                 queue_depth = None
+            lanes = self._lanes
             flight.dump(trigger, node=node.name if node is not None else "",
-                        inflight=inflight, queue_depth=queue_depth, extra=extra)
+                        inflight=inflight, queue_depth=queue_depth,
+                        rendezvous_holders=(lanes.collective_holders()
+                                            if lanes is not None else []),
+                        extra=extra)
         except Exception:
             logger.exception("flight-recorder dump (%s) failed", trigger)
 
@@ -652,20 +785,31 @@ class DagScheduler:
                              node.name)
 
     def _run_sequential(self) -> None:
+        # leases are uncontended one-at-a-time, but still taken: placement
+        # (which chip a device-placed node computes on) must be identical
+        # between the executors or their artifacts could diverge
+        lanes = self._lane_registry()
         for node in self._nodes:
             node.ready = time.monotonic()  # no pool: ready == start
+            if lanes is not None:
+                node.lease = lanes.try_lease(node.name, node.placement.kind,
+                                             node.placement.n_devices)
             self._running[node.name] = node
             try:
                 self._execute(node)
             finally:
                 self._running.pop(node.name, None)
+                if lanes is not None:
+                    lanes.release(node.lease)
+                node.lease = None
 
     def _run_concurrent(self, max_workers: int, node_timeout: float) -> None:
         cv = threading.Condition()
-        ready: "deque[Node]" = deque()
+        ready: List[Node] = []
         self._running.clear()
         running: Dict[str, Node] = self._running  # flight-dump live view
         self._ready_view = ready
+        lanes = self._lane_registry()
         state = {"stop": False, "fatal": None, "done": 0, "spawned": 0}
         total = len(self._nodes)
         t_ready0 = time.monotonic()
@@ -675,14 +819,40 @@ class DagScheduler:
                 n.ready = t_ready0
                 ready.append(n)
 
+        def claim_next() -> Optional[Node]:
+            """The first ready node whose lane is available (caller holds
+            ``cv``).  A collective node blocked behind the rendezvous lane
+            does not starve the queue — later single-device/host nodes are
+            still claimable around it."""
+            for i, n in enumerate(ready):
+                if lanes is None:
+                    del ready[i]
+                    return n
+                lease = lanes.try_lease(n.name, n.placement.kind,
+                                        n.placement.n_devices)
+                if lease is not None:
+                    n.lease = lease
+                    del ready[i]
+                    return n
+            return None
+
+        def release_lease(node: Node) -> None:
+            """Caller holds ``cv`` (claim and release both run under it,
+            so the lane bookkeeping has one lock order: cv -> registry)."""
+            lease, node.lease = node.lease, None
+            if lanes is not None and lease is not None:
+                lanes.release(lease)
+
         def finish(node: Node) -> None:
             with cv:
                 if node.abandoned:
-                    # the watchdog already booked this node (degraded) and
-                    # unblocked its dependents; this is the zombie attempt
-                    # finally waking — its result is discarded
+                    # the watchdog already booked this node (degraded),
+                    # released its lease and unblocked its dependents;
+                    # this is the zombie attempt finally waking — its
+                    # result is discarded (node.lease is already None)
                     cv.notify_all()
                     return
+                release_lease(node)
                 running.pop(node.name, None)
                 state["done"] += 1
                 if node.state == "failed" and state["fatal"] is None:
@@ -699,11 +869,14 @@ class DagScheduler:
         def worker() -> None:
             while True:
                 with cv:
-                    while not ready and not state["stop"] and state["done"] < total:
+                    node = None
+                    while not state["stop"] and state["done"] < total:
+                        node = claim_next()
+                        if node is not None:
+                            break
                         cv.wait(0.05)
-                    if state["stop"] or not ready:
+                    if node is None:
                         return
-                    node = ready.popleft()
                     node.state = "claimed"
                     # attempt_start is the watchdog's clock origin; set it
                     # BEFORE dispatch so a node is never observed at 0.0
@@ -729,10 +902,14 @@ class DagScheduler:
 
         def abandon(node: Node, reason: str) -> None:
             """Watchdog verdict on a truly stuck retry+degrade node: book it
-            degraded WITHOUT its (zombie) thread, unblock dependents, and
-            replace the lost worker.  Caller holds ``cv``."""
+            degraded WITHOUT its (zombie) thread, release its lane lease
+            (a stuck collective must not wedge the rendezvous lane — the
+            zombie's possible late dispatches are the documented cost of
+            abandoning, recorded in the postmortem), unblock dependents,
+            and replace the lost worker.  Caller holds ``cv``."""
             from anovos_tpu.resilience import policy as rpolicy
 
+            release_lease(node)
             node.abandoned = True
             node.degraded = True
             node.error = NodeTimeout(reason)
@@ -931,9 +1108,28 @@ class DagScheduler:
             res_stats = dict(self._res_stats)
         from anovos_tpu.resilience import failover as _failover
 
+        # max concurrently in-flight nodes, from the measured spans: the
+        # multi-device acceptance metric (>1 proves the executor really
+        # overlapped nodes; bench surfaces it as e2e_multidev_overlap)
+        events = sorted(
+            ev for n in executed for ev in ((n.start, 1), (n.end, -1)))
+        in_flight = overlap = 0
+        for _, delta in events:
+            in_flight += delta
+            overlap = max(overlap, in_flight)
+        try:
+            from anovos_tpu.shared.runtime import peek_runtime
+
+            rt = peek_runtime()
+            n_devices = rt.n_devices if rt is not None else 1
+        except Exception:  # pragma: no cover - no runtime at all
+            n_devices = 1
+
         return {
             "mode": mode,
             "workers": workers,  # the pool width this run actually used
+            "n_devices": n_devices,
+            "multidev_overlap": overlap,
             "wall_s": round(wall_s, 4),
             "serial_s": round(serial, 4),
             "critical_path_s": round(cp_len, 4),
@@ -958,6 +1154,8 @@ class DagScheduler:
                     "dur_s": round(n.end - n.start, 4) if n.end else None,
                     "queue_wait_s": round(n.queue_wait, 4) if n.end else None,
                     "thread": n.thread,
+                    "lane": n.placement.describe(),
+                    "devices": list(n.devices),
                     "state": n.state,
                     "cached": n.cached,
                     "attempts": n.attempts,

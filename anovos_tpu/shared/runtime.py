@@ -12,13 +12,26 @@ Unlike Spark there is no RPC control plane: all cross-device communication is
 compiler-scheduled XLA collectives over ICI (psum/all_gather/reduce_scatter),
 and multi-host process groups come from ``jax.distributed.initialize`` over
 DCN.
+
+Placement (PR 8): a node's execution context is no longer implicitly "the
+global mesh".  The DAG executor runs each node under a declarative
+:class:`~anovos_tpu.parallel.placement.Placement` — the global mesh, a
+carved sub-mesh, or one pinned chip — by entering :func:`placement_scope`
+with a :func:`derive_runtime`-built Runtime; ``get_runtime()`` and the
+layout-constraint gates resolve through the scope, so every Table and
+kernel built inside the node lands on the node's leased devices.  The
+chips themselves are handed out by :class:`DeviceLeaseRegistry`
+(``Runtime.lease_registry()``), which enforces the rendezvous-lane
+invariant: at most one collective claim covers any device.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Optional, Sequence
+import threading
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -28,6 +41,15 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 _RUNTIME: Optional["Runtime"] = None
+# bumped by every init_runtime (incl. mid-run failover rebuilds): lease
+# registries and derived-runtime caches key their validity on it
+_RUNTIME_GEN = 0
+
+# thread-local placement override: a scheduler worker executing a
+# device-/submesh-placed node sees a derived Runtime instead of the
+# global mesh, so every Table/kernel built inside the node lands on the
+# node's leased devices (see parallel/placement.py)
+_TL_PLACEMENT = threading.local()
 
 
 @dataclasses.dataclass
@@ -49,6 +71,16 @@ class Runtime:
     @property
     def n_devices(self) -> int:
         return self.mesh.size
+
+    def lease_registry(self) -> "DeviceLeaseRegistry":
+        """This runtime's chip-lease registry (created on first use) —
+        the scheduler's lane arbiter on multi-device meshes."""
+        with _DERIVED_LOCK:
+            reg = getattr(self, "_leases", None)
+            if reg is None:
+                reg = DeviceLeaseRegistry(list(self.mesh.devices.flat))
+                self._leases = reg
+        return reg
 
     # -- sharding helpers -------------------------------------------------
     def row_sharding(self) -> NamedSharding:
@@ -149,6 +181,179 @@ def compile_cache_dir() -> str:
     return cache_dir
 
 
+@contextmanager
+def placement_scope(rt: Optional["Runtime"]):
+    """Thread-local runtime override for one scheduler node's execution.
+
+    Inside the scope, :func:`get_runtime` (and the sharding-constraint
+    gates) resolve to ``rt`` — typically a 1-device or carved sub-mesh
+    runtime derived by :func:`derive_runtime` — so tables and kernels
+    built by the node body place onto the node's leased devices instead
+    of the global mesh.  ``None`` is a no-op scope."""
+    prev = getattr(_TL_PLACEMENT, "runtime", None)
+    _TL_PLACEMENT.runtime = rt
+    try:
+        yield rt
+    finally:
+        _TL_PLACEMENT.runtime = prev
+
+
+def active_placement_runtime() -> Optional["Runtime"]:
+    """The thread's placement-override runtime, or None outside a scope."""
+    return getattr(_TL_PLACEMENT, "runtime", None)
+
+
+def _current_runtime() -> Optional["Runtime"]:
+    """Placement override if active on this thread, else the global
+    runtime (or None before init) — the layout-gate resolution rule."""
+    return getattr(_TL_PLACEMENT, "runtime", None) or _RUNTIME
+
+
+def peek_runtime() -> Optional["Runtime"]:
+    """The global runtime WITHOUT initializing one (scheduler lane setup
+    must never be the thing that drags a jax backend up)."""
+    return _RUNTIME
+
+
+def runtime_generation() -> int:
+    """Monotonic counter bumped by every :func:`init_runtime` (including
+    mid-run failover rebuilds) — consumers holding derived state (lease
+    registries, sub-mesh runtimes) use it to notice a stale device set."""
+    return _RUNTIME_GEN
+
+
+_DERIVED: Dict[Tuple[int, Tuple[int, ...]], "Runtime"] = {}
+_DERIVED_LOCK = threading.Lock()
+
+
+def derive_runtime(devices: Sequence[jax.Device]) -> Runtime:
+    """A Runtime over a subset of the global mesh's devices (all on the
+    data axis) — the execution context of a ``device``/``submesh``-placed
+    node.  Cached per (runtime generation, device-id tuple) so repeated
+    node executions reuse one Mesh object (and therefore one jit cache
+    key) instead of recompiling per call."""
+    devs = tuple(devices)
+    key = (_RUNTIME_GEN, tuple(d.id for d in devs))
+    with _DERIVED_LOCK:
+        rt = _DERIVED.get(key)
+        if rt is None:
+            mesh = Mesh(np.array(devs).reshape(len(devs), 1),
+                        (DATA_AXIS, MODEL_AXIS))
+            rt = Runtime(mesh=mesh)
+            _DERIVED[key] = rt
+    return rt
+
+
+@dataclasses.dataclass
+class DeviceLease:
+    """One node's claim on chips.  ``kind`` mirrors the placement kind;
+    ``devices`` is empty for host leases."""
+
+    holder: str
+    kind: str
+    devices: Tuple[jax.Device, ...] = ()
+
+    def device_labels(self) -> List[str]:
+        return [f"{d.platform}:{d.id}" for d in self.devices]
+
+
+class DeviceLeaseRegistry:
+    """Hands out chips to scheduler nodes under the lane discipline.
+
+    Invariants enforced:
+
+    * at most ONE collective claim may cover any given device — the
+      rendezvous lane.  A ``mesh`` claim covers every device, so it is
+      exclusive against all collective claims; two ``submesh`` claims
+      may coexist only on disjoint device sets.
+    * ``device`` claims never block (single-device programs carry no
+      rendezvous, so sharing a chip with anything merely timeshares it).
+      Chip choice is STICKY by holder name — XLA executables are keyed on
+      their device assignment, so a node that hopped chips between runs
+      (or between the sequential and concurrent executors) would recompile
+      its programs per chip; the name-hashed preference keeps every node's
+      programs on one chip across runs and executors, falling back to the
+      least-claimed free chip only under a live collision.
+    * ``host`` claims are bookkeeping only.
+
+    Thread-safe; ``try_*`` never blocks (the scheduler polls under its
+    own condition variable and retries when a release notifies it).
+    """
+
+    def __init__(self, devices: Sequence[jax.Device]):
+        self._devices = tuple(devices)
+        self._lock = threading.Lock()
+        self._collective: Dict[str, Tuple[jax.Device, ...]] = {}
+        self._single_load: Dict[int, int] = {d.id: 0 for d in self._devices}
+
+    @property
+    def n_devices(self) -> int:
+        return len(self._devices)
+
+    def _collective_covered(self) -> set:
+        out = set()
+        for devs in self._collective.values():
+            out.update(d.id for d in devs)
+        return out
+
+    def try_lease(self, holder: str, kind: str, n_devices: int = 0
+                  ) -> Optional[DeviceLease]:
+        """A lease for ``holder`` under placement ``kind``, or None when
+        the lane is busy (collective kinds only — device/host always
+        succeed)."""
+        with self._lock:
+            if kind == "host":
+                return DeviceLease(holder, "host")
+            if kind == "device":
+                import hashlib
+
+                pref = self._devices[
+                    int.from_bytes(
+                        hashlib.sha256(holder.encode()).digest()[:4], "big")
+                    % len(self._devices)]
+                if self._single_load[pref.id] == 0:
+                    dev = pref
+                else:
+                    covered = self._collective_covered()
+                    dev = min(
+                        self._devices,
+                        key=lambda d: (self._single_load[d.id],
+                                       d.id in covered, d.id),
+                    )
+                self._single_load[dev.id] += 1
+                return DeviceLease(holder, "device", (dev,))
+            if kind == "mesh":
+                if self._collective:
+                    return None
+                self._collective[holder] = self._devices
+                return DeviceLease(holder, "mesh", self._devices)
+            if kind == "submesh":
+                covered = self._collective_covered()
+                free = [d for d in self._devices if d.id not in covered]
+                if len(free) < n_devices:
+                    return None
+                devs = tuple(free[:n_devices])
+                self._collective[holder] = devs
+                return DeviceLease(holder, "submesh", devs)
+            raise ValueError(f"unknown lease kind {kind!r}")
+
+    def release(self, lease: Optional[DeviceLease]) -> None:
+        if lease is None:
+            return
+        with self._lock:
+            if lease.kind in ("mesh", "submesh"):
+                self._collective.pop(lease.holder, None)
+            elif lease.kind == "device":
+                for d in lease.devices:
+                    if self._single_load.get(d.id, 0) > 0:
+                        self._single_load[d.id] -= 1
+
+    def collective_holders(self) -> List[str]:
+        """Nodes currently holding the rendezvous lane (postmortems)."""
+        with self._lock:
+            return sorted(self._collective)
+
+
 def init_runtime(
     devices: Optional[Sequence[jax.Device]] = None,
     mesh_shape: Optional[tuple] = None,
@@ -201,11 +406,16 @@ def init_runtime(
         raise ValueError(f"mesh_shape {mesh_shape} != device count {len(devs)}")
     dev_grid = np.array(devs).reshape(n_data, n_model)
     mesh = Mesh(dev_grid, (DATA_AXIS, MODEL_AXIS))
+    global _RUNTIME_GEN
+    _RUNTIME_GEN += 1
     _RUNTIME = Runtime(mesh=mesh)
     return _RUNTIME
 
 
 def get_runtime() -> Runtime:
+    override = getattr(_TL_PLACEMENT, "runtime", None)
+    if override is not None:
+        return override
     global _RUNTIME
     if _RUNTIME is None:
         _RUNTIME = init_runtime()
@@ -231,10 +441,11 @@ def column_parallel(a: jax.Array, cp: bool = True) -> jax.Array:
     is an incompatible-devices error).  No-op when ``cp`` is false, on a
     1-device mesh, or before the runtime exists.
     """
-    if not cp or _RUNTIME is None or _RUNTIME.mesh.size == 1:
+    rt = _current_runtime()
+    if not cp or rt is None or rt.mesh.size == 1:
         return a
     return jax.lax.with_sharding_constraint(
-        a, _RUNTIME.column_parallel_sharding()
+        a, rt.column_parallel_sharding()
     )
 
 
@@ -242,10 +453,11 @@ def replicated(a: jax.Array, cp: bool = True) -> jax.Array:
     """Replicate a small array across the mesh (companion to
     :func:`column_parallel` for the (rows,) id/validity vectors that every
     column-parallel lane needs in full).  Same gating contract."""
-    if not cp or _RUNTIME is None or _RUNTIME.mesh.size == 1:
+    rt = _current_runtime()
+    if not cp or rt is None or rt.mesh.size == 1:
         return a
     return jax.lax.with_sharding_constraint(
-        a, NamedSharding(_RUNTIME.mesh, P(*([None] * a.ndim)))
+        a, NamedSharding(rt.mesh, P(*([None] * a.ndim)))
     )
 
 
@@ -255,9 +467,10 @@ def row_sharded(a: jax.Array, cp: bool = True) -> jax.Array:
     row-length outputs replicated — a persisted replicated column occupies
     every device for the table's lifetime, unbounded by the transient
     replication guard.  Same gating contract as :func:`column_parallel`."""
-    if not cp or _RUNTIME is None or _RUNTIME.mesh.size == 1:
+    rt = _current_runtime()
+    if not cp or rt is None or rt.mesh.size == 1:
         return a
-    return jax.lax.with_sharding_constraint(a, _RUNTIME.row_sharding())
+    return jax.lax.with_sharding_constraint(a, rt.row_sharding())
 
 
 def replicate_gate(*arrays) -> bool:
@@ -287,7 +500,7 @@ def wants_column_parallel(*arrays, replicate=()) -> bool:
     (rows, k) column-parallel re-lay itself does not change total
     footprint and needs no guard.
     """
-    rt = _RUNTIME
+    rt = _current_runtime()
     if rt is None or rt.mesh.size == 1:
         return False
     rep_bytes = sum(int(a.size) * a.dtype.itemsize for a in replicate)

@@ -343,6 +343,60 @@ class Table:
                 M = jax.device_put(M, sh)
         return X, M
 
+    # ------------------------------------------------------------------
+    # placement (multi-device DAG execution — shared/runtime.py PR 8)
+    # ------------------------------------------------------------------
+    def with_runtime(self, rt) -> "Table":
+        """Re-place every column onto ``rt``'s row sharding (same padded
+        shapes, different device layout).  Used by the DAG executor to
+        hand a ``device``/``submesh``-placed node a copy of the mesh-
+        resident df that lives entirely on the node's leased devices, so
+        every program the node dispatches is local to its lane.  A table
+        already on that layout round-trips through ``device_put`` as a
+        cheap no-op; the cross-layout copy is booked as a ``d2d``
+        transfer."""
+        from anovos_tpu.obs import devprof
+
+        def put(a):
+            spec = P(*((rt.data_axis,) + (None,) * (a.ndim - 1)))
+            return jax.device_put(a, NamedSharding(rt.mesh, spec))
+
+        nbytes = sum(
+            c.data.nbytes + c.mask.nbytes
+            + (c.wide_hi.nbytes + c.wide_lo.nbytes if c.wide_hi is not None else 0)
+            for c in self.columns.values()
+        ) + (self.valid_rows.nbytes if self.valid_rows is not None else 0)
+        with devprof.transfer_bracket("d2d", nbytes, label="table.with_runtime"):
+            cols: "OrderedDict[str, Column]" = OrderedDict()
+            for name, c in self.columns.items():
+                cols[name] = Column(
+                    c.kind, put(c.data), put(c.mask), vocab=c.vocab,
+                    dtype_name=c.dtype_name,
+                    wide_hi=put(c.wide_hi) if c.wide_hi is not None else None,
+                    wide_lo=put(c.wide_lo) if c.wide_lo is not None else None,
+                    wide_kind=c.wide_kind,
+                )
+            valid = put(self.valid_rows) if self.valid_rows is not None else None
+        return Table(cols, self.nrows, valid)
+
+    def to_active_placement(self) -> "Table":
+        """Under a scheduler placement scope, the table re-placed onto
+        the scope's runtime; outside any scope (or when the table already
+        lives on exactly the scope's devices), the table itself."""
+        from anovos_tpu.shared.runtime import active_placement_runtime
+
+        rt = active_placement_runtime()
+        if rt is None or not self.columns:
+            return self
+        target = set(rt.mesh.devices.flat)
+        try:
+            current = set(next(iter(self.columns.values())).data.sharding.device_set)
+        except Exception:
+            current = None
+        if current == target:
+            return self
+        return self.with_runtime(rt)
+
     def row_mask(self) -> jax.Array:
         """Validity of the *row* (excludes padding rows).  Multi-host tables
         carry interleaved per-process padding → explicit mask."""

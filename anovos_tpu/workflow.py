@@ -465,12 +465,31 @@ class _PipelineRun:
             if w not in self.artifact_keys:
                 self.artifact_keys.append(w)
 
+    # -- placement ---------------------------------------------------------
+    @staticmethod
+    def _effective_placement(placement: str) -> str:
+        """``ANOVOS_TPU_PLACEMENT=mesh`` forces device-placed fan-out
+        analytics back onto the global mesh — the escape hatch for tables
+        too large for a single chip's replica (registrations keep literal
+        placements so graftcheck GC011 can audit them; the override is
+        applied here, at registration time, for both executors alike)."""
+        if placement == "device" and os.environ.get(
+                "ANOVOS_TPU_PLACEMENT", "") == "mesh":
+            return "mesh"
+        return placement
+
     # -- cache wiring ------------------------------------------------------
-    def _policy(self, name, cache_slice, writes, payload_write=None, on_hit=None):
+    def _policy(self, name, cache_slice, writes, placement="mesh",
+                payload_write=None, on_hit=None):
         if self.cache_base is None or cache_slice is None:
             return None
+        # placement is part of node identity: a device-placed analyzer and
+        # its mesh-placed twin legitimately differ in float artifacts
+        # (different reduction layouts), so they must never share entries
         return NodeCachePolicy(
-            key_material=node_fingerprint(self.cache_base, name, cache_slice, writes),
+            key_material=node_fingerprint(
+                self.cache_base, name,
+                {"placement": placement, "slice": cache_slice}, writes),
             flush=self.writer.wait,
             payload_write=payload_write,
             on_hit=on_hit,
@@ -487,12 +506,16 @@ class _PipelineRun:
 
     # -- node registration -------------------------------------------------
     def spine(self, name, fn, reads=(), writes=(), timed=None, cache_slice=None,
-              on_error=None) -> None:
-        """``fn(df) -> df`` mutates the table: df version N → N+1."""
+              on_error=None, placement="mesh") -> None:
+        """``fn(df) -> df`` mutates the table: df version N → N+1.
+
+        Spine nodes are ``mesh``-placed: their output version is every
+        downstream node's input and must stay on the global mesh layout."""
         v, out_v = self._ver, self._ver + 1
         self._ver = out_v
         self._claim(v)
         reads = tuple(reads)
+        placement = self._effective_placement(placement)
 
         def body():
             self.writer.wait(reads)
@@ -514,21 +537,31 @@ class _PipelineRun:
         self.sched.add(name, body, reads=(f"df:{v}",) + reads,
                        writes=(f"df:{out_v}",) + tuple(writes),
                        on_error=on_error if on_error is not None else self.spine_policy,
+                       placement=placement,
                        cache=self._policy(name, cache_slice, writes,
+                                          placement=placement,
                                           payload_write=lambda d: self._save_df(out_v, d),
                                           on_hit=on_hit))
         self._track(writes)
 
     def fanout(self, name, fn, reads=(), writes=(), timed=None, cache_slice=None,
-               on_error=None) -> None:
-        """``fn(df)`` only reads the table: pinned to the current version."""
+               on_error=None, placement="mesh") -> None:
+        """``fn(df)`` only reads the table: pinned to the current version.
+
+        ``placement="device"`` fans the node out onto one leased chip: the
+        executor's placement scope re-places the pinned df version onto a
+        single-device mesh (``Table.to_active_placement``) before the body
+        sees it, so every program the analyzer dispatches is rendezvous-
+        free and overlaps the collective lane.  ``"host"`` skips the
+        re-place entirely (report rendering reads CSVs, not the table)."""
         v = self._ver
         self._claim(v)
         reads = tuple(reads)
+        placement = self._effective_placement(placement)
 
         def body():
             self.writer.wait(reads)
-            df_in = self._resolve(v)
+            df_in = self._resolve(v).to_active_placement()
             t0 = time.monotonic()
             fn(df_in)
             if timed:
@@ -537,7 +570,9 @@ class _PipelineRun:
 
         self.sched.add(name, body, reads=(f"df:{v}",) + reads, writes=tuple(writes),
                        on_error=on_error if on_error is not None else self.fanout_policy,
+                       placement=placement,
                        cache=self._policy(name, cache_slice, writes,
+                                          placement=placement,
                                           on_hit=lambda _pdir, v=v: self._release(v)))
         self._track(writes)
 
@@ -613,29 +648,14 @@ def main(
     mode = os.environ.get("ANOVOS_TPU_EXECUTOR", "") or (
         "concurrent" if available_cpus() > 1 else "sequential"
     )
-    if mode == "concurrent":
-        # HARD constraint: concurrent block execution needs a single-device
-        # runtime.  On a multi-device mesh most kernels carry cross-device
-        # collectives, and two programs dispatched from different threads
-        # can enqueue onto the per-device streams in different orders —
-        # both then wait forever at their AllReduce rendezvous (observed as
-        # a watchdog-killed IV_calculation/charts pair on the 8-virtual-
-        # device test mesh).  Multi-chip block placement needs disjoint
-        # per-node device subsets — future work, not thread overlap.
-        try:
-            import jax
-
-            n_dev = len(jax.devices())
-        except Exception:  # pragma: no cover - no backend at all
-            n_dev = 1
-        if n_dev > 1:
-            logger.warning(
-                "concurrent executor requires a single-device runtime "
-                "(%d devices present): cross-device collective rendezvous "
-                "from concurrently dispatched programs deadlock; running "
-                "the DAG sequentially", n_dev,
-            )
-            mode = "sequential"
+    # Multi-device meshes no longer degrade concurrent to sequential: every
+    # registration below declares a placement (mesh | device | host —
+    # audited by graftcheck GC011), and the scheduler's lane discipline
+    # keeps at most one collective program set in flight mesh-wide (the
+    # rendezvous lane) while device-placed analyzers fan out on leased
+    # chips.  The old failure mode — two concurrently dispatched collective
+    # programs enqueueing in different per-device stream orders and
+    # deadlocking at the AllReduce rendezvous — is structurally excluded.
     writer = AsyncArtifactWriter(
         workers=int(os.environ.get("ANOVOS_TPU_WRITER_WORKERS", "2")),
         sync=(mode == "sequential"),
@@ -667,6 +687,7 @@ def main(
                     return save(out, write_intermediate, "data_ingest/concatenate_dataset",
                                 reread=True, writer=writer)
                 pipe.spine("concatenate_dataset", _concat, timed="concatenate_dataset",
+                           placement="mesh",
                            cache_slice={"concatenate_dataset": args, "dataset_fps": [
                                dataset_fingerprint(args[k])
                                for k in args if k not in ("method", "method_type")]})
@@ -681,6 +702,7 @@ def main(
                     return save(out, write_intermediate, "data_ingest/join_dataset",
                                 reread=True, writer=writer)
                 pipe.spine("join_dataset", _join, timed="join_dataset",
+                           placement="mesh",
                            cache_slice={"join_dataset": args, "dataset_fps": [
                                dataset_fingerprint(args[k])
                                for k in args if k not in ("join_type", "join_cols")]})
@@ -710,6 +732,7 @@ def main(
                             return df
                     pipe.spine("timeseries_analyzer/auto_detection", _ts_auto,
                                writes=("report:ts_autodetect",), timed="timeseries_analyzer",
+                               placement="mesh",
                                cache_slice={"timeseries_analyzer": opt, "mode": "auto"})
                 if opt.get("inspection", False):
                     def _ts_inspect(df, opt=opt):
@@ -730,6 +753,7 @@ def main(
                                 f"{type(e).__name__}: {e}")
                     pipe.fanout("timeseries_analyzer/inspection", _ts_inspect,
                                 writes=("report:ts_inspection",), timed="timeseries_analyzer",
+                                placement="device",
                                 cache_slice={"timeseries_analyzer": opt, "mode": "inspect"})
                 continue
 
@@ -758,6 +782,7 @@ def main(
                                 "geospatial_controller", f"{type(e).__name__}: {e}")
                     pipe.fanout("geospatial_controller", _geo,
                                 writes=("report:geo",), timed="geospatial_controller",
+                                placement="mesh",
                                 cache_slice={"geospatial_controller": ga})
                 continue
 
@@ -766,6 +791,7 @@ def main(
                     anovos_basic_report(df, **args.get("report_args", {}), run_type=run_type, auth_key=auth_key)
                 pipe.fanout("anovos_basic_report", _basic,
                             writes=("report:basic",), timed="Basic Report",
+                            placement="mesh",
                             cache_slice={"anovos_basic_report": args})
                 continue
 
@@ -787,6 +813,7 @@ def main(
                                  reread=True, writer=writer, key=f"stats:{m}")
                     pipe.fanout(f"stats_generator/{m}", _stat,
                                 writes=(f"stats:{m}",), timed=f"stats_generator, {m}",
+                                placement="device",
                                 cache_slice={"metric": m, "metric_args": args["metric_args"]})
 
             if key == "quality_checker" and args is not None:
@@ -820,6 +847,7 @@ def main(
                     pipe.spine(f"quality_checker/{subkey}", _qc,
                                reads=_stats_deps(all_configs, subkey),
                                writes=(f"stats:{subkey}",), timed=f"quality_checker, {subkey}",
+                               placement="mesh",
                                # the whole block: cross-subkey treatment flags
                                # feed this node's stats_args invalidation
                                cache_slice=_slice_or_none(
@@ -853,6 +881,7 @@ def main(
                     pipe.fanout(f"association_evaluator/{subkey}", _assoc,
                                 reads=_stats_deps(all_configs, subkey),
                                 writes=(f"stats:{subkey}",), timed=f"{key}, {subkey}",
+                                placement="device",
                                 cache_slice=_slice_or_none(assoc_slice, value))
 
             if key == "drift_detector" and args is not None:
@@ -895,6 +924,7 @@ def main(
                         pipe.fanout("drift_detector/drift_statistics", _drift_stats,
                                     writes=("stats:drift_statistics", "drift:model"),
                                     timed=f"{key}, drift_statistics",
+                                    placement="mesh",
                                     # source files are a second input dataset:
                                     # their stat signature joins the slice
                                     cache_slice=_slice_or_none(
@@ -934,6 +964,7 @@ def main(
                                     writes=("stats:stability_index", "stats:stabilityIndex_metrics"),
                                     timed=f"{key}, stability_index",
                                     on_error=stab_retry,
+                                    placement="device",
                                     # the metric paths are cross-RUN state (the
                                     # computation appends to them): their current
                                     # on-disk signature is part of the key, so a
@@ -972,6 +1003,7 @@ def main(
                         pipe.spine(f"transformers/{subkey2}", _tf,
                                    reads=_stats_deps(all_configs, subkey2),
                                    timed=f"{key}, {subkey2}",
+                                   placement="mesh",
                                    cache_slice=_slice_or_none({subkey2: value2}, value2))
 
             if key == "report_preprocessing" and args is not None:
@@ -992,6 +1024,7 @@ def main(
                         pipe.fanout(f"report_preprocessing/{subkey}", _charts,
                                     reads=chart_reads, writes=("charts:objects",),
                                     timed=f"{key}, {subkey}",
+                                    placement="device",
                                     cache_slice={"charts_to_objects": value})
 
             if key == "report_generation" and args is not None:
@@ -1006,6 +1039,7 @@ def main(
                 # never degrade it away
                 pipe.fanout("report_generation", _report, reads=art_reads,
                             timed=f"{key}, full_report",
+                            placement="host",
                             on_error=ErrorPolicy(mode="retry", retries=1,
                                                  on_exhausted="raise",
                                                  timeout_factor=2.0))

@@ -387,6 +387,12 @@ def e2e_cold_warm() -> dict:
             "e2e_critical_path_s": summary.get("critical_path_s"),
             "e2e_parallel_speedup": summary.get("parallel_speedup"),
             "e2e_critical_path": " -> ".join(summary.get("critical_path", [])),
+            # measured max concurrently in-flight nodes + device count:
+            # on a multi-device runtime the collective-aware lanes must
+            # keep this > 1 (the MULTICHIP dryrun's executor pass gates
+            # it; here it simply rides the trajectory)
+            "e2e_multidev_overlap": summary.get("multidev_overlap"),
+            "e2e_devices": summary.get("n_devices"),
         })
         print("bench: " + workflow.DagScheduler.format_summary(summary), file=sys.stderr)
     if os.environ.get("BENCH_CACHE", "1") == "1":

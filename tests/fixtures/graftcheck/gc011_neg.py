@@ -1,0 +1,38 @@
+"""GC011 negative fixture: truthful placement declarations stay quiet."""
+
+import jax
+
+from anovos_tpu.data_analyzer import stats_generator
+
+
+def body_mesh_psum(x):
+    return jax.lax.psum(x * 2.0, "data")
+
+
+def body_host_only():
+    rows = sorted([3, 1, 2])
+    return len(rows) + sum(rows)
+
+
+def body_opaque_dispatch(df):
+    # cross-module call: the body is opaque, so a 'device' declaration is
+    # accepted (the analyzer runs under the node's placement scope) and a
+    # 'mesh' declaration is never flagged stale
+    return stats_generator.global_summary(df)
+
+
+def register(sched, df):
+    # collective node really collects
+    sched.add("mesh_node", body_mesh_psum, placement="mesh")
+    # host node really is host-only
+    sched.add("host_node", body_host_only, placement="host")
+    # device node with an opaque (cross-module) body: unauditable, quiet
+    sched.add("device_node", body_opaque_dispatch, placement="device")
+    # mesh node with an opaque body: absence of collectives is unprovable
+    sched.add("mesh_opaque", body_opaque_dispatch, placement="mesh")
+    # pass-through placement variable: audited at the literal site instead
+    placement = "mesh"
+    sched.add("forwarded", body_mesh_psum, placement=placement)
+    # plain set.add stays out of scope entirely
+    seen = set()
+    seen.add("mesh_node")

@@ -1,0 +1,38 @@
+"""GC011 positive fixture: placement declarations that lie about the body."""
+
+import jax
+
+
+def _collects_via_helper(x):
+    return jax.lax.psum(x, "data")
+
+
+def body_direct_psum(x):
+    return jax.lax.psum(x * 2.0, "data")
+
+
+def body_helper_collects(x):
+    return _collects_via_helper(x + 1.0)
+
+
+def body_shard_cols(table, cols):
+    X, M = table.numeric_block(cols, shard_cols=True)
+    return X, M
+
+
+def body_host_only():
+    rows = sorted([3, 1, 2])
+    return len(rows) + sum(rows)
+
+
+def register(sched, table):
+    # 1. declared single-device, body calls a collective directly
+    sched.add("direct", body_direct_psum, placement="device")
+    # 2. declared host, a same-file helper collects one level down
+    sched.add("via_helper", body_helper_collects, placement="host")
+    # 3. declared device, body builds a model-axis-sharded block
+    sched.add("sharded_block", body_shard_cols, placement="device")
+    # 4. declared collective, fully resolvable body never collects
+    sched.add("stale", body_host_only, placement="mesh")
+    # 5. registration-shaped add with no placement at all
+    sched.add("unclassified", body_host_only, on_error="raise")

@@ -435,11 +435,11 @@ def test_writer_close_failure_does_not_mask_node_error(tmp_path, monkeypatch):
 def _chaos_cli(scenario, workdir, timeout=560):
     """Run tools/chaos_run.py in a FRESH single-device process.
 
-    The pytest process forces 8 virtual CPU devices (conftest XLA_FLAGS),
-    which degrades workflow.main's concurrent executor to sequential —
-    where there is no watchdog for the hang scenario to escalate against.
-    The chaos gate's contract is the production shape: one device,
-    concurrent DAG, watchdog armed — exactly what a fresh process gives."""
+    A fresh process gives the single-device production shape (concurrent
+    DAG, watchdog armed) without inheriting the pytest process's 8-virtual-
+    device XLA_FLAGS; the multi-device variant of the gate — lanes,
+    rendezvous-lane release, the ``hang-collective`` scenario — runs with
+    ``--devices 8`` in tests/test_multidev_executor.py."""
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     for k in ("ANOVOS_TPU_CHAOS", "ANOVOS_TPU_CACHE", "ANOVOS_TPU_EXECUTOR",
               "XLA_FLAGS"):

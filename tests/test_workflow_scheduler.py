@@ -388,11 +388,10 @@ def test_executor_modes_produce_identical_artifacts(tmp_path):
     stats CSVs, chart JSONs, intermediate checkpoints, drift model, final
     parquet, the HTML report — must be byte-identical.
 
-    Each mode runs in a SUBPROCESS on a single-device CPU runtime: the
-    concurrent executor requires a single device (on the 8-virtual-device
-    test mesh, concurrently dispatched collective programs deadlock at the
-    AllReduce rendezvous, so workflow.main degrades to sequential there —
-    which would make an in-process comparison vacuous).  The subprocess
+    Each mode runs in a SUBPROCESS on a single-device CPU runtime — the
+    single-device shape keeps this gate about scheduler ordering alone
+    (no lanes, no placement re-lays); the multi-device parity + overlap
+    gate lives in tests/test_multidev_executor.py.  The subprocess
     watchdog (ANOVOS_TPU_NODE_TIMEOUT) plus the hard timeout turn a
     scheduler deadlock into a fast, named failure instead of eating the
     tier-1 budget."""

@@ -11,4 +11,5 @@ from tools.graftcheck.rules import (  # noqa: F401  (import = registration)
     gc008_cache_key,
     gc009_swallowed_exception,
     gc010_unattributed_dispatch,
+    gc011_collective_placement,
 )

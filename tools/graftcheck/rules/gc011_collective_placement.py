@@ -1,0 +1,194 @@
+"""GC011 — collective placement audit.
+
+The multi-device DAG executor's deadlock freedom rests on one claim per
+node: its declared :class:`~anovos_tpu.parallel.placement.Placement`
+(``mesh`` / ``submesh:N`` / ``device`` / ``host``) matches what the body
+actually dispatches.  A node declared ``device``/``host`` that reaches a
+cross-device collective re-creates exactly the AllReduce-rendezvous
+deadlock the rendezvous lane exists to exclude — and no test catches it,
+because it only bites on some interleavings on a multi-device mesh.  The
+inverse error is cheaper but real: a node declared collective whose
+callees never collect serializes the DAG behind the rendezvous lane for
+nothing (stale placement).  This rule keeps the classification DATA, not
+folklore.
+
+For every scheduler registration (``pipe.spine`` / ``pipe.fanout`` /
+``sched.add`` carrying a registration-shaped keyword set):
+
+1. **missing placement** *(library registrars only — ``anovos_tpu/``)*:
+   the registration passes no ``placement=`` at all.  Unclassified nodes
+   default to ``host``, which is exactly the dangerous direction.
+2. **collective reach from a non-collective placement**: the body (or a
+   same-file helper, one level deep) calls a collective primitive —
+   ``psum``/``pmean``/``pmax``/``pmin``/``all_gather``/``all_to_all``/
+   ``ppermute``, ``shard_map``/``pmap``, ``with_sharding_constraint``,
+   the runtime's ``column_parallel``/``row_sharded``/``replicated``
+   constraint helpers, ``masked_moments_shmap``, or
+   ``numeric_block(..., shard_cols=True)`` — while the registration says
+   ``device`` or ``host``.
+3. **stale collective placement**: the registration says ``mesh``/
+   ``submesh`` but the body is FULLY resolvable (every call lands on a
+   same-file def or a known host-side helper) and nothing in it
+   collects.  Opaque bodies (dynamic ``getattr`` dispatch, cross-module
+   calls) are exempt from this check — absence of collectives cannot be
+   proven statically there, and a false "stale" would push a collective
+   node off the rendezvous lane.
+
+A non-constant ``placement=`` expression is treated as classified but
+unauditable (the workflow's inner ``sched.add(placement=placement)``
+pass-through; the OUTER ``pipe.spine``/``pipe.fanout`` literals carry
+the audit).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional, Set
+
+from tools.graftcheck.jaxmodel import attr_chain, call_chain
+from tools.graftcheck.registry import FileContext, Rule, register
+
+_REGISTRAR_ATTRS = {"spine", "fanout", "add"}
+_REG_KWARGS = {"reads", "writes", "placement", "on_error", "cache", "timed",
+               "cache_slice"}
+
+# call-chain tails that prove a cross-device collective dispatch
+_COLLECTIVE_TAILS = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "shard_map", "pmap", "xmap", "with_sharding_constraint",
+    "column_parallel", "row_sharded", "replicated", "masked_moments_shmap",
+}
+
+# builtins whose calls never dispatch device work (resolvability model for
+# the stale-collective check)
+_HOST_BUILTINS = {
+    "open", "len", "str", "int", "float", "bool", "sorted", "list", "dict",
+    "tuple", "set", "range", "enumerate", "zip", "min", "max", "sum", "abs",
+    "isinstance", "getattr", "round", "repr", "format",
+}
+
+
+def _is_collective_call(node: ast.Call) -> bool:
+    chain = call_chain(node) or ""
+    tail = chain.rsplit(".", 1)[-1]
+    if tail in _COLLECTIVE_TAILS:
+        return True
+    if tail == "numeric_block":
+        for kw in node.keywords:
+            if kw.arg == "shard_cols" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True:
+                return True
+    return False
+
+
+class _BodyScan:
+    """Collective evidence + resolvability of one body (one helper level)."""
+
+    def __init__(self, defs: Dict[str, ast.FunctionDef]):
+        self.defs = defs
+
+    def scan(self, fn: ast.AST, depth: int = 0):
+        """(evidence node | None, fully_resolvable: bool)."""
+        evidence: Optional[ast.AST] = None
+        resolvable = True
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            if _is_collective_call(sub):
+                return sub, True
+            func = sub.func
+            if isinstance(func, ast.Name):
+                if func.id in _HOST_BUILTINS:
+                    continue
+                target = self.defs.get(func.id)
+                if target is not None:
+                    if depth < 1 and target is not fn:
+                        ev, res = self.scan(target, depth + 1)
+                        if ev is not None:
+                            return sub, True  # anchor at the call site
+                        resolvable = resolvable and res
+                    continue
+                resolvable = False  # cross-module name: opaque
+            else:
+                # attribute/dynamic call: opaque unless provably collective
+                # (handled above); logging-ish attrs stay opaque too — the
+                # stale check only fires on FULLY resolvable bodies
+                resolvable = False
+        return evidence, resolvable
+
+
+@register
+class CollectivePlacementRule(Rule):
+    id = "GC011"
+    title = "declared node placement vs the body's actual collective dispatches"
+
+    def check(self, ctx: FileContext) -> Iterable:
+        defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                defs.setdefault(node.name, node)
+        scanner = _BodyScan(defs)
+        audit_missing = (ctx.relpath.startswith("anovos_tpu/")
+                         or "gc011" in ctx.relpath)
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if not (isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _REGISTRAR_ATTRS):
+                continue
+            if len(call.args) < 2:
+                continue
+            kwargs = {kw.arg for kw in call.keywords if kw.arg}
+            if call.func.attr == "add" and not (kwargs & _REG_KWARGS):
+                continue  # not a scheduler registration (e.g. set.add)
+            yield from self._audit(ctx, call, scanner, defs, audit_missing)
+
+    def _audit(self, ctx: FileContext, call: ast.Call, scanner: _BodyScan,
+               defs: Dict[str, ast.FunctionDef], audit_missing: bool):
+        node_name = ""
+        if isinstance(call.args[0], ast.Constant):
+            node_name = str(call.args[0].value)
+        kws = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+        placement_expr = kws.get("placement")
+        if placement_expr is None:
+            if audit_missing:
+                yield ctx.finding(
+                    self.id, call,
+                    f"scheduler registration {node_name or '<dynamic>'!r} "
+                    "declares no placement= — unclassified nodes default to "
+                    "'host', so a body that dispatches collectives would "
+                    "dodge the rendezvous lane; declare mesh/submesh:N/"
+                    "device/host (GC011 audits the declaration)")
+            return
+        if not isinstance(placement_expr, ast.Constant) or not isinstance(
+                placement_expr.value, str):
+            return  # pass-through variable: audited at the literal site
+        placement = placement_expr.value
+        collective = placement == "mesh" or placement.startswith("submesh")
+        fn_ref = call.args[1]
+        if isinstance(fn_ref, ast.Name):
+            fn = defs.get(fn_ref.id)
+        elif isinstance(fn_ref, ast.Lambda):
+            fn = fn_ref
+        else:
+            fn = None
+        if fn is None:
+            return  # unresolvable callee: nothing to audit
+        evidence, resolvable = scanner.scan(fn)
+        if not collective and evidence is not None:
+            yield ctx.finding(
+                self.id, evidence,
+                f"node {node_name or '<dynamic>'!r} is declared "
+                f"placement={placement!r} but its body reaches a cross-"
+                "device collective dispatch — off the rendezvous lane this "
+                "re-creates the AllReduce interleaving deadlock; declare "
+                "the node 'mesh' (or 'submesh:N'), or make the body "
+                "single-device")
+        elif collective and evidence is None and resolvable:
+            yield ctx.finding(
+                self.id, call,
+                f"node {node_name or '<dynamic>'!r} is declared "
+                f"placement={placement!r} but nothing in its (fully "
+                "resolvable) body collects — stale placement serializes "
+                "the DAG behind the rendezvous lane for nothing; declare "
+                "'device' or 'host'")

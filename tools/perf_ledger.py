@@ -74,6 +74,12 @@ TRACKED_FIELDS: Dict[str, Tuple[str, float]] = {
     "e2e_chaos_overhead_s": ("lower", 0.80),
     "e2e_device_time_s": ("lower", 0.60),
     "e2e_dispatch_s": ("lower", 0.60),
+    # multi-device concurrent executor (the MULTICHIP dryrun's executor
+    # pass): measured node overlap on the mesh must not collapse back to
+    # sequential-in-disguise, and the concurrent wall must hold its line
+    "e2e_multidev_overlap": ("higher", 0.40),
+    "e2e_multidev_wall_s": ("lower", 0.60),
+    "e2e_multidev_seq_wall_s": ("lower", 0.60),
 }
 BASELINE_WINDOW = 3
 
