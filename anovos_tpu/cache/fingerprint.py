@@ -48,12 +48,23 @@ __all__ = [
 # ANOVOS_SHAPE_BUCKETS is on it defensively: bucketed-vs-exact parity is
 # tested byte-identical, but the knob exists precisely to flip compiled
 # program shapes, and a false invalidation is cheap while a false hit is
-# not.  graftcheck GC008 audits node bodies against this list.
+# not.  ANOVOS_FUSE_BLOCKS follows the same policy (fused-vs-eager parity
+# is byte-tested, tests/test_fuse_blocks.py, but the knob flips program
+# structure wholesale).  graftcheck GC008 audits node bodies against this
+# list.
 KNOWN_ENV_KNOBS = (
+    # whole-block fusion (ops/fuse.py): =0 restores the eager glue chains
+    "ANOVOS_FUSE_BLOCKS",
     "ANOVOS_MATMUL_PRECISION",
     "ANOVOS_REPLICATE_MAX_BYTES",
     "ANOVOS_REREAD_FROM_DISK",
     "ANOVOS_SHAPE_BUCKETS",
+    # bf16 mixed-precision sweep (ops/mxu.py): routes the MXU-safe
+    # pre-centered matmuls (corr/cov/PCA) through bf16 inputs with f32
+    # accumulation — artifacts change within the tested tolerance bands,
+    # so bf16 and f32 runs must never share cache entries.  Distance
+    # expansions stay f32 unconditionally (the PERF.md corruption class).
+    "ANOVOS_TPU_BF16",
     # the chaos harness can change artifacts (an injected fault that
     # exhausts retries leaves a DEGRADED section with missing stats), so
     # a chaos run must never share cache entries with a clean one.  The

@@ -38,7 +38,8 @@ def _latlon_points(idf: Table, lat_col: str, lon_col: str, max_records: int) -> 
 
 
 def _silhouettes_batched(
-    D_full: np.ndarray, labels_list, sample: int = 2000, seed: int = 1
+    D_full: np.ndarray, labels_list, sample: int = 2000, seed: int = 1,
+    squared: bool = False,
 ) -> list:
     """Sampled silhouettes for MANY labelings of the same points, sharing
     ONE fixed sample and ONE distance→one-hot matmul across all combos.
@@ -55,6 +56,11 @@ def _silhouettes_batched(
     rng = np.random.default_rng(seed)
     pick = rng.choice(n, sample, replace=False) if n > sample else np.arange(n)
     Ds = D_full[np.ix_(pick, pick)]
+    if squared:
+        # sqrt applied AFTER sampling: elementwise, so sqrt(sample(D2)) is
+        # bit-identical to sample(sqrt(D2)) at ~1/64 the work (the full-
+        # matrix sqrt was ~60 ms of the warm geo block)
+        Ds = np.sqrt(np.maximum(Ds, 0.0))
     s = len(pick)
     blocks, metas = [], []
     for li, labels in enumerate(labels_list):
@@ -72,7 +78,8 @@ def _silhouettes_batched(
             # per-combo resample so the score matches the old path instead
             # of flipping to -1.  X's values are unused on the D_full path.
             metas.append(_silhouette(
-                np.empty((n, 0)), labels, sample=sample, D_full=D_full))
+                np.empty((n, 0)), labels, sample=sample, D_full=D_full,
+                squared=squared))
             continue
         k = len(uniq)
         C = np.zeros((s, k))
@@ -93,7 +100,8 @@ def _silhouettes_batched(
 
 
 def _silhouette(
-    X: np.ndarray, labels: np.ndarray, sample: int = 2000, D_full=None
+    X: np.ndarray, labels: np.ndarray, sample: int = 2000, D_full=None,
+    squared: bool = False,
 ) -> float:
     """Mean silhouette on a sample (sklearn metric, computed directly).
 
@@ -114,6 +122,8 @@ def _silhouette(
         sel = vidx
     if D_full is not None:
         D = D_full[np.ix_(sel, sel)]
+        if squared:
+            D = np.sqrt(np.maximum(D, 0.0))
     else:
         D = np.sqrt(
             np.maximum(
@@ -385,7 +395,9 @@ def cluster_analysis(
     """KMeans elbow + DBSCAN grid (reference :390-733).  Returns
     (kmeans_centers_frame, dbscan_grid_frame)."""
     best_k, inertias = kmeans_elbow(pts, max_k=min(max_cluster, max(2, len(pts) // 10 or 2)))
-    centers, labels, _ = kmeans_fit(jnp.asarray(pts, jnp.float32), best_k)
+    # host f32 cast: jnp.asarray compiled a convert program per call; a np
+    # cast rounds identically and rides the jit boundary as a plain transfer
+    centers, labels, _ = kmeans_fit(np.asarray(pts, np.float32), best_k)
     centers = np.asarray(centers)
     counts = np.bincount(np.asarray(labels), minlength=best_k)
     km = pd.DataFrame(
@@ -420,15 +432,23 @@ def cluster_analysis(
     # host.  ANOVOS_DBSCAN_HOST_CC_MAX bounds the host memory (n² f32 +
     # transient edge lists); samples above it — a grid cap RAISED beyond the
     # 4096 default — use the tiled on-device propagation path instead.
+    from anovos_tpu.ops.fuse import fuse_enabled
+
     eps_values = [float(e) for e in np.arange(e0, e1 + 1e-9, estep)]
     D2 = None
     D_full = None
+    sil_squared = False
     if eps_values and len(sub) <= int(os.environ.get("ANOVOS_DBSCAN_HOST_CC_MAX", 6144)):
         Xc = np.asarray(sub, np.float32)
         Xc = Xc - Xc.mean(axis=0, keepdims=True)  # f32 bits follow the spread
         D2 = np.asarray(jax.device_get(pairwise_d2(jnp.asarray(Xc))))
         # distances reused by every combo's silhouette sample
-        D_full = np.sqrt(np.maximum(D2, 0.0))
+        if fuse_enabled():
+            # the silhouette path sqrt's AFTER sampling (bit-identical,
+            # ~1/64 the elementwise work) — hand it the squared matrix
+            D_full, sil_squared = D2, True
+        else:
+            D_full = np.sqrt(np.maximum(D2, 0.0))
         all_labels = dbscan_host_grid_multi(D2, eps_values, ms_eff)
     combos = []  # (eps, min_samples, labels)
     for a, e in enumerate(eps_values):
@@ -441,7 +461,8 @@ def cluster_analysis(
             labels_b = dbscan_grid(sub, float(e), ms_eff, counts=counts)
         combos.extend((e, m, labels) for m, labels in zip(ms_values, labels_b))
     if D_full is not None:
-        scores = _silhouettes_batched(D_full, [lab for _, _, lab in combos])
+        scores = _silhouettes_batched(D_full, [lab for _, _, lab in combos],
+                                      squared=sil_squared)
     else:
         # _silhouette itself returns -1.0 for <2 clusters / <10 valid points
         scores = [_silhouette(sub, lab) for _, _, lab in combos]

@@ -24,6 +24,7 @@ import numpy as np
 import pandas as pd
 
 from anovos_tpu.data_analyzer import stats_generator as sg
+from anovos_tpu.ops.fuse import fuse_enabled
 from anovos_tpu.ops.quantiles import masked_quantiles
 from anovos_tpu.ops.reductions import masked_moments
 from anovos_tpu.ops.segment import row_signature
@@ -33,6 +34,35 @@ from anovos_tpu.shared.utils import parse_cols
 logger = logging.getLogger(__name__)
 
 _R = lambda v: round(float(v), 4)
+
+
+# ---------------------------------------------------------------------------
+# fused glue programs (ops/fuse.py): the eager chains between this module's
+# big kernels — float-bit canonicalization for row hashing, the per-row
+# null-count reduction, invalid-mask combines — each lowered as ONE shared
+# program.  ANOVOS_FUSE_BLOCKS=0 restores the eager chain at the call site.
+# ---------------------------------------------------------------------------
+@jax.jit
+def _float_bits_program(data):
+    """-0.0-canonicalized f32 bit pattern (duplicate-detection hashing)."""
+    return (data + 0.0).view(jnp.int32)
+
+
+@jax.jit
+def _as_int32_program(data):
+    return data.astype(jnp.int32)
+
+
+@jax.jit
+def _null_count_program(M, k_live):
+    """Per-row null count against the LIVE lane count (nullRows)."""
+    return k_live - M.sum(axis=1, dtype=jnp.int32)
+
+
+@jax.jit
+def _mask_and_not_program(mask, inv):
+    """mask & ~inv — the invalid-entry treatment mask combine."""
+    return mask & ~inv
 
 
 def _discrete_cols(idf: Table, list_of_cols, drop_cols) -> List[str]:
@@ -69,6 +99,21 @@ def _outlier_flags(X, M, lo, hi):
     )
 
 
+@jax.jit
+def _outlier_value_replace_program(X, M, lo, hi):
+    """Whole-block value-replacement treatment: per-column clip + null
+    zero-fill in one program (bounds carry ±inf where a detection side is
+    open, so the clip matches the per-column scalar-bound chain)."""
+    return jnp.where(M, jnp.clip(X, lo[None, :], hi[None, :]), 0.0)
+
+
+@jax.jit
+def _outlier_null_replace_program(X, M, flag):
+    """Whole-block null-replacement treatment: (treated data, new masks)."""
+    ok = M & (flag == 0)
+    return jnp.where(ok, X, 0.0), ok
+
+
 def duplicate_detection(
     idf: Table, list_of_cols="all", drop_cols=[], treatment=False, print_impact=False
 ) -> Tuple[Table, pd.DataFrame]:
@@ -78,14 +123,20 @@ def duplicate_detection(
     cols = _discrete_cols(idf, list_of_cols, drop_cols)
     treatment = _check_bool(treatment)
     sub = idf.select(cols)
+    fused = fuse_enabled()
+
     def _hashable(c):
         col = sub.columns[c]
         if col.is_wide:
             return [col.wide_hi, col.wide_lo]  # exact pair, no f32 collisions
         if col.kind == "cat" or col.data.dtype != jnp.float32:
-            return [col.data.astype(jnp.int32)]
+            if col.data.dtype == jnp.int32:
+                return [col.data]  # already the exact bit pattern
+            return [_as_int32_program(col.data) if fused
+                    else col.data.astype(jnp.int32)]
         # +0.0 canonicalizes -0.0 → +0.0 so equal floats hash equally
-        return [(col.data + 0.0).view(jnp.int32)]
+        return [_float_bits_program(col.data) if fused
+                else (col.data + 0.0).view(jnp.int32)]
 
     hash_arrays, hash_masks = [], []
     for c in cols:
@@ -144,9 +195,14 @@ def nullRows_detection(
     from anovos_tpu.shared.table import stack_masks_padded
 
     M = stack_masks_padded([idf.columns[c].mask for c in cols])
-    null_cnt = np.asarray(
-        jnp.asarray(np.int32(len(cols))) - M.sum(axis=1, dtype=jnp.int32)
-    )[: idf.nrows]
+    if fuse_enabled():
+        null_cnt = np.asarray(
+            _null_count_program(M, np.int32(len(cols)))
+        )[: idf.nrows]
+    else:
+        null_cnt = np.asarray(
+            jnp.asarray(np.int32(len(cols))) - M.sum(axis=1, dtype=jnp.int32)
+        )[: idf.nrows]
     if treatment_threshold == 1:
         flagged = null_cnt == len(cols)
     else:
@@ -416,8 +472,16 @@ def outlier_detection(
     # compiled per width (cold-compile census).
     from anovos_tpu.shared.table import pad_lane_params
 
-    lo_d = jnp.asarray(pad_lane_params(lower, X.shape[1]), jnp.float32)
-    hi_d = jnp.asarray(pad_lane_params(upper, X.shape[1]), jnp.float32)
+    fused = fuse_enabled()
+    lo_p = pad_lane_params(lower, X.shape[1]).astype(np.float32)
+    hi_p = pad_lane_params(upper, X.shape[1]).astype(np.float32)
+    if fused:
+        # host f32 bound arrays ride through the jit boundary directly —
+        # the eager jnp.asarray casts compiled one convert program per width
+        lo_d, hi_d = lo_p, hi_p
+    else:
+        lo_d = jnp.asarray(pad_lane_params(lower, X.shape[1]), jnp.float32)
+        hi_d = jnp.asarray(pad_lane_params(upper, X.shape[1]), jnp.float32)
     flag, n_lo_d, n_hi_d, clean_row = _outlier_flags(X, M, lo_d, hi_d)
     n_lo = np.asarray(n_lo_d)[: len(cols)]
     n_hi = np.asarray(n_hi_d)[: len(cols)]
@@ -435,19 +499,41 @@ def outlier_detection(
             from collections import OrderedDict
 
             new_cols = OrderedDict()
-            for i, c in enumerate(cols):
-                col = idf.columns[c]
-                x = col.data.astype(jnp.float32)
+            if fused:
+                # whole-block treatment program: clip/flag-null + zero-fill
+                # fused over (rows, k_pad) instead of a per-column eager
+                # clip/where chain (the non-finite detection-side bounds
+                # fold into the bound arrays as ±inf — same clip values)
+                lo_eff = pad_lane_params(
+                    np.where(np.isfinite(lower), lo_p[: len(cols)], -np.inf),
+                    X.shape[1], fill=-np.inf).astype(np.float32)
+                hi_eff = pad_lane_params(
+                    np.where(np.isfinite(upper), hi_p[: len(cols)], np.inf),
+                    X.shape[1], fill=np.inf).astype(np.float32)
                 if treatment_method == "value_replacement":
-                    clipped = jnp.clip(
-                        x,
-                        lo_d[i] if np.isfinite(lower[i]) else -jnp.inf,
-                        hi_d[i] if np.isfinite(upper[i]) else jnp.inf,
-                    )
-                    new_cols[c] = Column("num", jnp.where(col.mask, clipped, 0.0), col.mask, dtype_name="double")
+                    T = _outlier_value_replace_program(X, M, lo_eff, hi_eff)
+                    for i, c in enumerate(cols):
+                        new_cols[c] = Column("num", T[:, i], idf.columns[c].mask,
+                                             dtype_name="double")
                 else:  # null_replacement
-                    ok = col.mask & (flag[:, i] == 0)
-                    new_cols[c] = Column("num", jnp.where(ok, x, 0.0), ok, dtype_name=col.dtype_name)
+                    T, OK = _outlier_null_replace_program(X, M, flag)
+                    for i, c in enumerate(cols):
+                        new_cols[c] = Column("num", T[:, i], OK[:, i],
+                                             dtype_name=idf.columns[c].dtype_name)
+            else:
+                for i, c in enumerate(cols):
+                    col = idf.columns[c]
+                    x = col.data.astype(jnp.float32)
+                    if treatment_method == "value_replacement":
+                        clipped = jnp.clip(
+                            x,
+                            lo_d[i] if np.isfinite(lower[i]) else -jnp.inf,
+                            hi_d[i] if np.isfinite(upper[i]) else jnp.inf,
+                        )
+                        new_cols[c] = Column("num", jnp.where(col.mask, clipped, 0.0), col.mask, dtype_name="double")
+                    else:  # null_replacement
+                        ok = col.mask & (flag[:, i] == 0)
+                        new_cols[c] = Column("num", jnp.where(ok, x, 0.0), ok, dtype_name=col.dtype_name)
             for name, ncol in new_cols.items():
                 odf = odf.with_column(name if output_mode == "replace" else name + "_outliered", ncol)
     if print_impact:
@@ -783,7 +869,8 @@ def invalidEntries_detection(
             new_cols = OrderedDict()
             for c in target_cols:
                 col = idf.columns[c]
-                ok = col.mask & ~invalid_masks[c]
+                ok = (_mask_and_not_program(col.mask, invalid_masks[c])
+                      if fuse_enabled() else col.mask & ~invalid_masks[c])
                 new_cols[c] = dataclasses.replace(col, mask=ok)
             for name, ncol in new_cols.items():
                 odf = odf.with_column(name if output_mode == "replace" else name + "_invalid", ncol)

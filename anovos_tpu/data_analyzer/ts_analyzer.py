@@ -50,6 +50,8 @@ def daypart_cat(hour: pd.Series) -> pd.Series:
 
 def ts_processed_feats(idf: Table, col: str) -> pd.DataFrame:
     """Per-row calendar features for one ts column (reference :87-158)."""
+    from anovos_tpu.ops.fuse import fuse_enabled
+
     ts = _ts_frame(idf, col)
     out = pd.DataFrame({col: ts})
     out["date"] = ts.dt.date
@@ -58,7 +60,17 @@ def ts_processed_feats(idf: Table, col: str) -> pd.DataFrame:
     out["is_weekend"] = ts.dt.dayofweek >= 5
     out["daypart"] = daypart_cat(ts.dt.hour)
     out["month"] = ts.dt.month
-    out["yyyymmdd_col"] = ts.dt.strftime("%Y-%m-%d")
+    if fuse_enabled():
+        # vectorized day formatting: datetime64[D] → str is the same
+        # ISO "%Y-%m-%d" rendering as strftime at ~10× the speed; NaT rows
+        # render differently ('NaT' vs NaN) but every consumer drops them
+        # via dropna(subset=[col]) first, so the frames agree where read
+        days = ts.to_numpy().astype("datetime64[D]")
+        ymd = days.astype(str).astype(object)
+        ymd[pd.isna(ts).to_numpy()] = np.nan
+        out["yyyymmdd_col"] = ymd
+    else:
+        out["yyyymmdd_col"] = ts.dt.strftime("%Y-%m-%d")
     return out
 
 
@@ -106,17 +118,11 @@ def _grain_buckets(tcol, grain: str):
     return _grain_ids(tcol.data, grain), (_DAYPART_NAMES if grain == "hourly" else _DOW_NAMES)
 
 
-def _num_viz_small_grain(idf: Table, ts_col: str, num_cols: List[str], grain: str) -> pd.DataFrame:
-    """min/max/mean/median of every numeric column per daypart / weekday —
-    one device segment program (reference ts_viz_data :259-406 hourly/weekly)."""
-    from anovos_tpu.data_transformer.datetime import _segment_aggregate
-
-    tcol = idf.columns[ts_col]
-    ids, labels = _grain_buckets(tcol, grain)
-    V, Mv = idf.numeric_block(num_cols)
-    cnt, sm, _, mn, mx, med = jax.device_get(
-        _segment_aggregate(ids, tcol.mask, V, Mv, len(labels))
-    )
+def _small_grain_frame(agg, num_cols: List[str], labels: List[str]) -> pd.DataFrame:
+    """Host frame from one grain's (cnt, sm, sq, mn, mx, med) aggregate —
+    the ONE copy of the formatting shared by the per-grain and fused-pair
+    paths."""
+    cnt, sm, _, mn, mx, med = agg
     rows = []
     for j, c in enumerate(num_cols):
         for b, lbl in enumerate(labels):
@@ -135,6 +141,79 @@ def _num_viz_small_grain(idf: Table, ts_col: str, num_cols: List[str], grain: st
     return pd.DataFrame(rows, columns=["bucket", "attribute", "count", "min", "max", "mean", "median"])
 
 
+def _num_viz_small_grain(idf: Table, ts_col: str, num_cols: List[str], grain: str) -> pd.DataFrame:
+    """min/max/mean/median of every numeric column per daypart / weekday —
+    one device segment program (reference ts_viz_data :259-406 hourly/weekly)."""
+    from anovos_tpu.data_transformer.datetime import _segment_aggregate
+
+    tcol = idf.columns[ts_col]
+    ids, labels = _grain_buckets(tcol, grain)
+    V, Mv = idf.numeric_block(num_cols)
+    agg = jax.device_get(_segment_aggregate(ids, tcol.mask, V, Mv, len(labels)))
+    return _small_grain_frame(agg, num_cols, labels)
+
+
+@functools.partial(jax.jit, static_argnames=("nseg_d", "nseg_h", "nseg_w", "cp"))
+def _ts_num_viz_program(day_ids, day_lo, tdata, valid, V, Mv,
+                        nseg_d: int, nseg_h: int, nseg_w: int, cp: bool):
+    """ALL THREE numeric viz grains — daily (offset day buckets), daypart
+    and weekday ids, and the three segment aggregates — in ONE compiled
+    program: the per-grain path dispatched three id programs and three
+    aggregate programs with blocking fetches between them."""
+    from anovos_tpu.data_transformer.datetime import (
+        _segment_aggregate_jit, _segment_aggregate_jit_off,
+    )
+
+    ids_h = _grain_ids(tdata, "hourly")
+    ids_w = _grain_ids(tdata, "weekly")
+    return (
+        _segment_aggregate_jit_off(day_ids, day_lo, valid, V, Mv, nseg_d, cp=cp),
+        _segment_aggregate_jit(ids_h, valid, V, Mv, nseg_h, cp=cp),
+        _segment_aggregate_jit(ids_w, valid, V, Mv, nseg_w, cp=cp),
+    )
+
+
+_TS_NUM_AGGS = ["count", "min", "max", "mean", "median"]
+
+
+def _ts_num_viz_all(idf: Table, ts_col: str, num_cols: List[str]):
+    """(daily frame, hourly frame, weekly frame) from ONE device dispatch
+    + ONE fetch.  Daily formatting goes through the aggregator's shared
+    ``format_segment_aggregate`` so the frames match the per-grain path
+    byte-for-byte.  Returns None on the aggregator's fallback conditions
+    (all-null span, degenerate span) — the caller then takes the
+    per-grain path."""
+    from anovos_tpu.data_transformer.datetime import (
+        _bucket_ids_minmax, format_segment_aggregate,
+    )
+    from anovos_tpu.shared.runtime import wants_column_parallel
+
+    tcol = idf.columns[ts_col]
+    day_ids, lo_d, hi_d = _bucket_ids_minmax(tcol.data, tcol.mask, "day")
+    lo, hi = int(lo_d), int(hi_d)
+    if lo > hi or (hi - lo + 1) > 4_000_000:
+        return None
+    nseg_d, nseg_h, nseg_w = hi - lo + 1, len(_DAYPART_NAMES), len(_DOW_NAMES)
+    if os.environ.get("ANOVOS_SHAPE_BUCKETS", "1") != "0":
+        # same segment-class bucketing as _segment_aggregate's wrapper, so
+        # the fused and per-grain programs reduce over identical widths
+        from anovos_tpu.ops.segment import bucket_segments_pow2
+
+        nseg_d = bucket_segments_pow2(nseg_d)
+        nseg_h, nseg_w = bucket_segments_pow2(nseg_h), bucket_segments_pow2(nseg_w)
+    V, Mv = idf.numeric_block(num_cols)
+    cp = wants_column_parallel(day_ids, tcol.mask, V, Mv,
+                               replicate=(day_ids, tcol.mask))
+    agg_d, agg_h, agg_w = jax.device_get(_ts_num_viz_program(
+        day_ids, np.int32(lo), tcol.data, tcol.mask, V, Mv,
+        nseg_d, nseg_h, nseg_w, cp))
+    dv = format_segment_aggregate(agg_d, num_cols, _TS_NUM_AGGS, ts_col,
+                                  "%Y-%m-%d", lo, "day")
+    return (dv,
+            _small_grain_frame(agg_h, num_cols, _DAYPART_NAMES),
+            _small_grain_frame(agg_w, num_cols, _DOW_NAMES))
+
+
 def _cat_viz(idf: Table, ts_col: str, cat_cols: List[str], n_cat: int = 10) -> pd.DataFrame:
     """Top-N + Others category counts per day per categorical column
     (reference's string branch of ts_viz_data).
@@ -143,11 +222,19 @@ def _cat_viz(idf: Table, ts_col: str, cat_cols: List[str], n_cat: int = 10) -> p
     and ONE stacked day×category combo program — two device dispatches
     total instead of two per column (remote dispatch is the dominant cost
     on the tunnel backend, PERF.md)."""
-    from anovos_tpu.data_transformer.datetime import _bucket_ids, _bucket_start_secs, _col_min_max
+    from anovos_tpu.data_transformer.datetime import (
+        _bucket_ids, _bucket_ids_minmax, _bucket_start_secs, _col_min_max,
+    )
+    from anovos_tpu.ops.fuse import fuse_enabled
 
+    fused = fuse_enabled()
     tcol = idf.columns[ts_col]
-    day_ids = _bucket_ids(tcol.data, "day")
-    lo, hi = _col_min_max(day_ids, tcol.mask)
+    if fused:
+        day_ids, lo_d, hi_d = _bucket_ids_minmax(tcol.data, tcol.mask, "day")
+        lo, hi = int(lo_d), int(hi_d)
+    else:
+        day_ids = _bucket_ids(tcol.data, "day")
+        lo, hi = _col_min_max(day_ids, tcol.mask)
     if lo > hi or not cat_cols:
         return pd.DataFrame(columns=["date", "attribute", "category", "count"])
     ndays = hi - lo + 1
@@ -159,9 +246,17 @@ def _cat_viz(idf: Table, ts_col: str, cat_cols: List[str], n_cat: int = 10) -> p
     nv = max(max(len(idf.columns[c].vocab) for c in cat_cols), 1)
     nv_b = max(8, 1 << (nv - 1).bit_length())
     ndays_b = max(8, 1 << (int(ndays) - 1).bit_length())
-    C = jnp.stack([idf.columns[c].data for c in cat_cols], axis=1)
-    Mc = jnp.stack([idf.columns[c].mask for c in cat_cols], axis=1)
-    cnts = np.asarray(jax.device_get(_all_code_counts(C, Mc, nv_b)))  # (k, nv_b)
+    if fused:
+        # stacks fold INTO the jitted programs (tuple args): the eager
+        # jnp.stack pair compiled broadcast+concat programs per arity
+        datas = tuple(idf.columns[c].data for c in cat_cols)
+        masks = tuple(idf.columns[c].mask for c in cat_cols)
+        cnts = np.asarray(jax.device_get(
+            _all_code_counts_cols(datas, masks, nv_b)))  # (k, nv_b)
+    else:
+        C = jnp.stack([idf.columns[c].data for c in cat_cols], axis=1)
+        Mc = jnp.stack([idf.columns[c].mask for c in cat_cols], axis=1)
+        cnts = np.asarray(jax.device_get(_all_code_counts(C, Mc, nv_b)))  # (k, nv_b)
     # top-N per column (codes beyond a column's own vocab count zero)
     lut = np.full((k, nv_b), n_cat, np.int32)  # → Others
     tops = []
@@ -170,9 +265,14 @@ def _cat_viz(idf: Table, ts_col: str, cat_cols: List[str], n_cat: int = 10) -> p
         top = np.argsort(-cnts[j, :v])[:n_cat]
         lut[j, top] = np.arange(len(top), dtype=np.int32)
         tops.append(top)
-    combo = np.asarray(jax.device_get(_combo_counts_all(
-        C, Mc & tcol.mask[:, None], jnp.asarray(lut), day_ids - lo, ndays_b, n_cat + 1
-    ))).reshape(k, ndays_b, n_cat + 1)[:, :ndays, :]
+    if fused:
+        combo = np.asarray(jax.device_get(_combo_counts_all_cols(
+            datas, masks, tcol.mask, lut, day_ids, np.int32(lo), ndays_b, n_cat + 1
+        ))).reshape(k, ndays_b, n_cat + 1)[:, :ndays, :]
+    else:
+        combo = np.asarray(jax.device_get(_combo_counts_all(
+            C, Mc & tcol.mask[:, None], jnp.asarray(lut), day_ids - lo, ndays_b, n_cat + 1
+        ))).reshape(k, ndays_b, n_cat + 1)[:, :ndays, :]
     rows = []
     for j, c in enumerate(cat_cols):
         labels = [str(idf.columns[c].vocab[t]) for t in tops[j]] + ["Others"]
@@ -196,6 +296,22 @@ def _all_code_counts(C, M, nv: int):
     )[: k * nv].reshape(k, nv)
 
 
+@functools.partial(jax.jit, static_argnames=("nv",))
+def _all_code_counts_cols(datas, masks, nv: int):
+    """Column-tuple variant: the stack happens inside the program."""
+    return _all_code_counts(jnp.stack(datas, axis=1), jnp.stack(masks, axis=1), nv)
+
+
+@functools.partial(jax.jit, static_argnames=("ndays", "ncat"))
+def _combo_counts_all_cols(datas, masks, tmask, lut, day_ids, day_lo,
+                           ndays: int, ncat: int):
+    """Column-tuple variant of _combo_counts_all: stack + ts-mask combine
+    + day-offset subtraction + LUT upload fold into the one program."""
+    C = jnp.stack(datas, axis=1)
+    Mc = jnp.stack(masks, axis=1) & tmask[:, None]
+    return _combo_counts_all(C, Mc, lut, day_ids - day_lo, ndays, ncat)
+
+
 @functools.partial(jax.jit, static_argnames=("ndays", "ncat"))
 def _combo_counts_all(C, M, lut, day0, ndays: int, ncat: int):
     """Stacked day×category counts for every column in one segment_sum:
@@ -214,7 +330,8 @@ def _combo_counts_all(C, M, lut, day0, ndays: int, ncat: int):
 
 
 def ts_viz_data(
-    idf: Table, col: str, output_path: str, output_type: str = "daily"
+    idf: Table, col: str, output_path: str, output_type: str = "daily",
+    _feats: Optional[pd.DataFrame] = None,
 ) -> None:
     """Per-column visualization data at THREE grains (reference :259-406):
     daily (date buckets), hourly (dayparts), weekly (weekdays) — numeric
@@ -229,15 +346,25 @@ def ts_viz_data(
     num_cols = [c for c in num_all][:20]
     cat_cols = [c for c in cat_all][:10]
 
-    feats = ts_processed_feats(idf, col)
+    feats = _feats if _feats is not None else ts_processed_feats(idf, col)
     feats = feats.dropna(subset=[col])
     daily = feats.groupby("yyyymmdd_col").size().reset_index(name="count")
     daily.to_csv(out + f"ts_daily_{col}.csv", index=False)
 
-    # numeric viz: daily via the device groupby-aggregator, small grains via
-    # one segment program each
+    # numeric viz: all three grains in ONE fused dispatch under
+    # ANOVOS_FUSE_BLOCKS (_ts_num_viz_all); the per-grain path — daily via
+    # the device groupby-aggregator, small grains via one segment program
+    # each — is the fallback and the parity baseline
     if num_cols:
-        dv = aggregator(idf, num_cols, ["count", "min", "max", "mean", "median"], col, "%Y-%m-%d")
+        from anovos_tpu.ops.fuse import fuse_enabled
+
+        viz = _ts_num_viz_all(idf, col, num_cols) if fuse_enabled() else None
+        if viz is not None:
+            dv, hourly_df, weekly_df = viz
+        else:
+            dv = aggregator(idf, num_cols, _TS_NUM_AGGS, col, "%Y-%m-%d")
+            hourly_df = _num_viz_small_grain(idf, col, num_cols, "hourly")
+            weekly_df = _num_viz_small_grain(idf, col, num_cols, "weekly")
         long_rows = []
         for c in num_cols:
             sub = pd.DataFrame(
@@ -253,12 +380,8 @@ def ts_viz_data(
             )
             long_rows.append(sub[sub["count"] > 0])
         pd.concat(long_rows, ignore_index=True).to_csv(out + f"ts_num_daily_{col}.csv", index=False)
-        _num_viz_small_grain(idf, col, num_cols, "hourly").to_csv(
-            out + f"ts_num_hourly_{col}.csv", index=False
-        )
-        _num_viz_small_grain(idf, col, num_cols, "weekly").to_csv(
-            out + f"ts_num_weekly_{col}.csv", index=False
-        )
+        hourly_df.to_csv(out + f"ts_num_hourly_{col}.csv", index=False)
+        weekly_df.to_csv(out + f"ts_num_weekly_{col}.csv", index=False)
     if cat_cols:
         _cat_viz(idf, col, cat_cols).to_csv(out + f"ts_cat_daily_{col}.csv", index=False)
 
@@ -385,12 +508,14 @@ def kpss_test(series: np.ndarray, regression: str = "c"):
     return {"kpss_stat": round(stat, 4), **{f"kpss_stationary_{k}": int(stat < v) for k, v in crit.items()}}
 
 
-def ts_landscape(idf: Table, ts_cols: List[str], id_col: Optional[str], output_path: str) -> None:
+def ts_landscape(idf: Table, ts_cols: List[str], id_col: Optional[str], output_path: str,
+                 _feats_map: Optional[dict] = None) -> None:
     """Per-ts-column landscape summary (reference ts_landscape :2636-2733):
     span, distinct days, records/day, weekend share, top daypart."""
     rows = []
     for c in ts_cols:
-        feats = ts_processed_feats(idf, c).dropna(subset=[c])
+        feats = (_feats_map[c] if _feats_map and c in _feats_map
+                 else ts_processed_feats(idf, c)).dropna(subset=[c])
         if not len(feats):
             continue
         daily = feats.groupby("yyyymmdd_col").size()
@@ -425,17 +550,27 @@ def ts_analyzer(
     """Entry (reference :408-550): run eligibility + viz dumps for every
     timestamp column; write ``ts_stats.csv`` summary."""
     Path(output_path).mkdir(parents=True, exist_ok=True)
+    from anovos_tpu.ops.fuse import fuse_enabled
+
     ts_cols = [c for c in idf.col_names if idf.columns[c].kind == "ts"]
     rows = []
     eligible = []
+    feats_map: dict = {}
+    share = fuse_enabled()
     for c in ts_cols:
         stats = ts_eligiblity_check(idf, c, id_col, max_days)
         rows.append(stats)
         if stats.get("eligible"):
             eligible.append(c)
-            ts_viz_data(idf, c, output_path, output_type)
+            if share:
+                # calendar feats computed ONCE per column — the viz dump
+                # and the landscape sweep used to pay the pandas pass twice
+                feats_map[c] = ts_processed_feats(idf, c)
+            ts_viz_data(idf, c, output_path, output_type,
+                        _feats=feats_map.get(c))
     if eligible:
-        ts_landscape(idf, eligible, id_col, output_path)
+        ts_landscape(idf, eligible, id_col, output_path,
+                     _feats_map=feats_map if share else None)
     # always emit the same headered schema — a headerless empty CSV breaks
     # readers and per-run schema drift breaks downstream joins
     pd.DataFrame(rows).reindex(columns=TS_STATS_COLUMNS).to_csv(

@@ -70,15 +70,36 @@ def ll_gh_cols(idf: Table, max_records: int = 100000) -> Tuple[List[str], List[s
     ]
     stats = {}
     if num_cols:
-        num_out, _ = table_describe(idf, num_cols, [])
-        for i, c in enumerate(num_cols):
-            stats[c] = {
-                "max": float(num_out["max"][i]),
-                "min": float(num_out["min"][i]),
-                "mean": float(num_out["mean"][i]),
-                "std": float(num_out["stddev"][i]),
-                "nunique": int(num_out["nunique"][i]),
-            }
+        from anovos_tpu.ops.fuse import fuse_enabled
+
+        if fuse_enabled():
+            # the gates below read only range/spread stats: one sort-free
+            # masked-moments pass instead of the full fused describe (whose
+            # device sort for percentiles/nunique was ~3/4 of the geo
+            # block's detection cost; the describe's nunique was computed
+            # here and never read)
+            from anovos_tpu.ops.reductions import masked_moments
+
+            X, M = idf.numeric_block(num_cols)
+            mom = {k: np.asarray(v)[: len(num_cols)]
+                   for k, v in masked_moments(X, M).items()}
+            for i, c in enumerate(num_cols):
+                stats[c] = {
+                    "max": float(mom["max"][i]),
+                    "min": float(mom["min"][i]),
+                    "mean": float(mom["mean"][i]),
+                    "std": float(mom["stddev"][i]),
+                }
+        else:
+            num_out, _ = table_describe(idf, num_cols, [])
+            for i, c in enumerate(num_cols):
+                stats[c] = {
+                    "max": float(num_out["max"][i]),
+                    "min": float(num_out["min"][i]),
+                    "mean": float(num_out["mean"][i]),
+                    "std": float(num_out["stddev"][i]),
+                    "nunique": int(num_out["nunique"][i]),
+                }
     for c in num_cols:
         s = stats[c]
         if not np.isfinite(s["max"]):

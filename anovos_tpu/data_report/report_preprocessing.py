@@ -381,16 +381,18 @@ def charts_to_objects(
                 cut_map[c] = row
         X, M = idf.numeric_block(num_cols)
         # cutoff rows padded to the block's bucketed lane count (dead-lane
-        # histogram rows are all-masked zeros, never indexed below)
-        cutoffs = pad_lane_params(np.stack([cut_map[c] for c in num_cols]), X.shape[1])
-        counts = np.asarray(binned_histograms(X, M, jnp.asarray(cutoffs, jnp.float32), bin_size))
+        # histogram rows are all-masked zeros, never indexed below); cast
+        # f32 on HOST — the eager jnp.asarray cast compiled one convert
+        # program per width, and a host np cast rounds identically
+        cutoffs = pad_lane_params(
+            np.stack([cut_map[c] for c in num_cols]), X.shape[1]
+        ).astype(np.float32)
+        counts = np.asarray(binned_histograms(X, M, cutoffs, bin_size))
         ev_counts = None
         if y is not None:
             # one fused program: the eager digitize → mask-combine →
             # two-bincount chain compiled ~5 programs per width here
-            tot_d, evs_d = _binned_label_counts(
-                X, M, jnp.asarray(cutoffs, jnp.float32), ym, y, bin_size
-            )
+            tot_d, evs_d = _binned_label_counts(X, M, cutoffs, ym, y, bin_size)
             ev_counts = (np.asarray(tot_d), np.asarray(evs_d))
         for i, c in enumerate(num_cols):
             labels = [f"{j + 1}" for j in range(bin_size)]
@@ -428,11 +430,24 @@ def charts_to_objects(
         vals = [float(cnts[j]) for j in order if cnts[j] > 0]
         _emit(_bar_fig(cats, vals, c), ends_with(master_path) + "freqDist_" + c)
         if y is not None:
+            from anovos_tpu.ops.fuse import fuse_enabled
             from anovos_tpu.ops.segment import code_label_counts
 
-            m_eff = col.mask & ym
-            tot = np.asarray(code_label_counts(col.data, m_eff, jnp.ones_like(y), vsize))[:vsize]
-            evs = np.asarray(code_label_counts(col.data, m_eff, y, vsize))[:vsize]
+            if fuse_enabled():
+                # one fused program per column (shared with the IV/IG group
+                # sweep): mask combine + both label segment-sums — the
+                # eager chain dispatched ~5 programs per chart column
+                from anovos_tpu.data_analyzer.association_evaluator import (
+                    _label_group_counts_fused,
+                )
+
+                tot, evs, _, _ = _label_group_counts_fused(
+                    col.data, col.mask, y, ym, idf.nrows, vsize)
+                tot, evs = tot[:vsize], evs[:vsize]
+            else:
+                m_eff = col.mask & ym
+                tot = np.asarray(code_label_counts(col.data, m_eff, jnp.ones_like(y), vsize))[:vsize]
+                evs = np.asarray(code_label_counts(col.data, m_eff, y, vsize))[:vsize]
             with np.errstate(invalid="ignore", divide="ignore"):
                 rate = np.where(tot > 0, evs / np.maximum(tot, 1), 0.0)
             _emit(

@@ -614,7 +614,18 @@ def _bucket_start_secs(ids: np.ndarray, grain: str) -> np.ndarray:
     return ids * mult
 
 
-def _segment_aggregate(ids0: jax.Array, valid: jax.Array, V: jax.Array, Mv: jax.Array, nseg: int):
+@_functools.partial(jax.jit, static_argnames=("grain",))
+def _bucket_ids_minmax(secs: jax.Array, mask: jax.Array, grain: str):
+    """Bucket ids + masked span in one program (the aggregator's fused
+    preamble — ids and min/max used to dispatch separately)."""
+    ids = _bucket_ids(secs, grain)
+    lo = jnp.where(mask, ids, _I32_BIG).min()
+    hi = jnp.where(mask, ids, -_I32_BIG).max()
+    return ids, lo, hi
+
+
+def _segment_aggregate(ids0: jax.Array, valid: jax.Array, V: jax.Array, Mv: jax.Array, nseg: int,
+                       off: "int | None" = None):
     """Per-bucket count/sum/sumsq/min/max/median for every value column.
 
     ids0: (rows,) int32 bucket ids already offset to [0, nseg); valid:
@@ -643,10 +654,20 @@ def _segment_aggregate(ids0: jax.Array, valid: jax.Array, V: jax.Array, Mv: jax.
         from anovos_tpu.ops.segment import bucket_segments_pow2
 
         nseg = bucket_segments_pow2(nseg)
-    return _segment_aggregate_jit(
-        ids0, valid, V, Mv, nseg,
-        cp=wants_column_parallel(ids0, valid, V, Mv, replicate=(ids0, valid)),
-    )
+    cp = wants_column_parallel(ids0, valid, V, Mv, replicate=(ids0, valid))
+    if off is not None:
+        # lo-offset subtraction fused into the aggregate program (the
+        # eager ``ids - lo`` spelled one subtract program + dispatch)
+        return _segment_aggregate_jit_off(
+            ids0, np.int32(off), valid, V, Mv, nseg, cp=cp)
+    return _segment_aggregate_jit(ids0, valid, V, Mv, nseg, cp=cp)
+
+
+@_functools.partial(jax.jit, static_argnames=("nseg", "cp"))
+def _segment_aggregate_jit_off(ids: jax.Array, off: jax.Array, valid: jax.Array,
+                               V: jax.Array, Mv: jax.Array, nseg: int,
+                               cp: bool = False):
+    return _segment_aggregate_jit(ids - off, valid, V, Mv, nseg, cp=cp)
 
 
 @_functools.partial(jax.jit, static_argnames=("nseg", "cp"))
@@ -705,17 +726,43 @@ def aggregator(
         )
         return _aggregator_host(idf, cols, aggs, time_col, granularity_format)
 
-    ids = _bucket_ids(tcol.data, grain)
-    lo, hi = _col_min_max(ids, tcol.mask)
+    from anovos_tpu.ops.fuse import fuse_enabled
+
+    fused = fuse_enabled()
+    if fused:
+        # bucket ids + span min/max in ONE dispatch (the id program and
+        # the min/max program used to round-trip separately), and the
+        # lo-offset subtraction folds into the aggregate program below
+        ids, lo_d, hi_d = _bucket_ids_minmax(tcol.data, tcol.mask, grain)
+        lo, hi = int(lo_d), int(hi_d)
+    else:
+        ids = _bucket_ids(tcol.data, grain)
+        lo, hi = _col_min_max(ids, tcol.mask)
     if lo > hi:  # all-null time column: empty result
         return pd.DataFrame(columns=[time_col] + [f"{c}_{a}" for c in cols for a in aggs])
     nseg = hi - lo + 1
     if nseg > 4_000_000:  # degenerate span: seconds-grain over decades
         return _aggregator_host(idf, cols, aggs, time_col, granularity_format)
     V, Mv = idf.numeric_block(cols)
-    cnt, sm, sq, mn, mx, med = jax.device_get(
-        _segment_aggregate(ids - lo, tcol.mask, V, Mv, int(nseg))
-    )
+    if fused:
+        cnt, sm, sq, mn, mx, med = jax.device_get(
+            _segment_aggregate(ids, tcol.mask, V, Mv, int(nseg), off=lo)
+        )
+    else:
+        cnt, sm, sq, mn, mx, med = jax.device_get(
+            _segment_aggregate(ids - lo, tcol.mask, V, Mv, int(nseg))
+        )
+    return format_segment_aggregate(
+        (cnt, sm, sq, mn, mx, med), cols, aggs, time_col, granularity_format,
+        lo, grain)
+
+
+def format_segment_aggregate(agg, cols, aggs, time_col, granularity_format,
+                             lo: int, grain: str) -> pd.DataFrame:
+    """Host frame from one grain's (cnt, sm, sq, mn, mx, med) aggregate —
+    the ONE copy of the aggregator's bucket formatting, shared with the
+    ts-analyzer's fused three-grain dispatch."""
+    cnt, sm, sq, mn, mx, med = agg
     present = cnt.max(axis=0) > 0  # buckets with any data
     idx = np.nonzero(present)[0]
     keys = pd.Series(

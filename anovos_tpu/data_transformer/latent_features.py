@@ -25,6 +25,8 @@ import pandas as pd
 
 from anovos_tpu.data_transformer.model_io import load_model_df, save_model_df
 from anovos_tpu.models.autoencoder import AutoEncoder
+from anovos_tpu.ops.fuse import fuse_enabled
+from anovos_tpu.ops.mxu import bf16_sweep, mm
 from anovos_tpu.ops.reductions import masked_moments
 from anovos_tpu.shared.runtime import get_runtime
 from anovos_tpu.shared.table import Column, Table
@@ -129,6 +131,36 @@ def autoencoder_latentFeatures(
     return odf
 
 
+@jax.jit
+def _pca_center(X, nrows):
+    """Row-masked centering alone (the pre_existing_model scoring path —
+    no spectrum needed)."""
+    rowmask = (jnp.arange(X.shape[0]) < nrows)[:, None]
+    return jnp.where(rowmask, X - X.mean(axis=0, where=rowmask), 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("bf16",))
+def _pca_cov_eig(X, nrows, bf16: bool = False):
+    """Fused PCA spectrum: row-masked centering + covariance + eigh +
+    descending reorder in ONE program (the eager chain compiled ~14
+    single-primitive programs per run — cold-compile census).  The
+    covariance matmul is pre-centered, so it qualifies for the guarded
+    bf16 sweep (ops/mxu.py); eigh itself always runs f32."""
+    rowmask = (jnp.arange(X.shape[0]) < nrows)[:, None]
+    Xc = jnp.where(rowmask, X - X.mean(axis=0, where=rowmask), 0.0)
+    cov = mm(Xc.T, Xc, bf16) / jnp.maximum(nrows - 1, 1)
+    eigval, eigvec = jnp.linalg.eigh(cov)
+    order = jnp.argsort(eigval)[::-1]
+    return Xc, eigval[order], eigvec[:, order]
+
+
+@functools.partial(jax.jit, static_argnames=("bf16",))
+def _pca_project(Xc, V, nrows, bf16: bool = False):
+    """Fused projection + row-validity iota (one program per component
+    count instead of a matmul + per-column slice/iota chain)."""
+    return mm(Xc, V, bf16), jnp.arange(Xc.shape[0]) < nrows
+
+
 def PCA_latentFeatures(
     idf: Table,
     list_of_cols="all",
@@ -154,8 +186,23 @@ def PCA_latentFeatures(
         warnings.warn("No PCA Computation - need ≥2 numerical columns")
         return idf
     X, mean, std = _prep_block(idf, cols, standardization, imputation=True)
-    rowmask = (jnp.arange(idf.padded_rows) < idf.nrows)[:, None]
-    Xc = jnp.where(rowmask, X - X.mean(axis=0, where=rowmask), 0.0)
+    fused = fuse_enabled()
+    if fused:
+        if pre_existing_model:
+            # scoring path: the spectrum comes from the saved model — run
+            # the centering-only program, not the cov+eigh it would discard
+            Xc = _pca_center(X, np.int32(idf.nrows))
+        else:
+            # whole-chain program (ops/fuse.py): centering + covariance +
+            # eigh + descending reorder lowered as ONE compiled program —
+            # the eager chain here compiled ~14 single-primitive programs
+            # per run (cold-compile census).  Xc stays a device handle for
+            # projection.
+            Xc, eig_d, vec_d = _pca_cov_eig(
+                X, np.int32(idf.nrows), bf16=bf16_sweep())
+    else:
+        rowmask = (jnp.arange(idf.padded_rows) < idf.nrows)[:, None]
+        Xc = jnp.where(rowmask, X - X.mean(axis=0, where=rowmask), 0.0)
 
     if pre_existing_model:
         dfm = load_model_df(model_path, "PCA_latentFeatures")
@@ -164,12 +211,18 @@ def PCA_latentFeatures(
         k = comp.shape[0]
         V = jnp.asarray(comp.T)
     else:
-        cov = (Xc.T @ Xc) / jnp.maximum(idf.nrows - 1, 1)
-        eigval, eigvec = jnp.linalg.eigh(cov)
-        order = jnp.argsort(eigval)[::-1]
-        eigval = eigval[order]
-        eigvec = eigvec[:, order]
-        ratio = np.cumsum(np.asarray(eigval)) / max(float(jnp.sum(eigval)), 1e-30)
+        if fused:
+            eigval, eigvec = eig_d, vec_d
+        else:
+            cov = (Xc.T @ Xc) / jnp.maximum(idf.nrows - 1, 1)
+            eigval, eigvec = jnp.linalg.eigh(cov)
+            order = jnp.argsort(eigval)[::-1]
+            eigval = eigval[order]
+            eigvec = eigvec[:, order]
+        # k selection on host from the (k,)-small spectrum — identical
+        # arithmetic in both modes so the chosen k can never differ
+        ev_h = np.asarray(eigval)
+        ratio = np.cumsum(ev_h) / max(float(ev_h.sum()), 1e-30)
         k = int(np.searchsorted(ratio, explained_variance_cutoff) + 1)
         k = max(1, min(k, len(cols)))
         V = eigvec[:, :k]
@@ -184,9 +237,16 @@ def PCA_latentFeatures(
                 model_path,
                 "PCA_latentFeatures",
             )
-    Z = Xc @ V  # (padded_rows, k)
+    if fused:
+        # one projection program; matmul columns are independent, so
+        # projecting against the k-sliced V matches slicing the full
+        # projection column-for-column bit-exactly
+        Z, in_range = _pca_project(Xc, V, np.int32(idf.nrows),
+                                   bf16=bf16_sweep())
+    else:
+        Z = Xc @ V  # (padded_rows, k)
+        in_range = jnp.arange(idf.padded_rows) < idf.nrows
     odf = idf
-    in_range = jnp.arange(idf.padded_rows) < idf.nrows
     for i in range(int(Z.shape[1])):
         odf = odf.with_column(
             f"latent_{i}", Column("num", Z[:, i].astype(jnp.float32), in_range, dtype_name="float")

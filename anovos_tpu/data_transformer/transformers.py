@@ -25,6 +25,7 @@ import numpy as np
 import pandas as pd
 
 from anovos_tpu.data_transformer.model_io import load_model_df, save_model_df
+from anovos_tpu.ops.fuse import fuse_enabled
 from anovos_tpu.ops.histogram import digitize, masked_bincount
 from anovos_tpu.ops.quantiles import masked_quantiles
 from anovos_tpu.ops.reductions import masked_moments
@@ -34,6 +35,83 @@ from anovos_tpu.shared.table import Column, Table, pad_lane_params
 from anovos_tpu.shared.utils import parse_cols
 
 logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# fused apply programs (ops/fuse.py): each transformer's eager glue chain —
+# digitize/cast, affine scale, elementwise math + finite-mask, per-column
+# impute fills — lowered as ONE program over the padded (rows, k_pad)
+# block.  ANOVOS_FUSE_BLOCKS=0 restores the eager chain at every call site;
+# the two paths are byte-identical (tests/test_fuse_blocks.py).
+# ---------------------------------------------------------------------------
+@jax.jit
+def _bin_apply_program(X, edges):
+    """digitize + the 1-based int cast in one program: (bins0, bins1)."""
+    bins0 = digitize(X, edges)
+    return bins0, (bins0 + 1).astype(jnp.int32)
+
+
+@jax.jit
+def _affine_scale_program(X, center, scale):
+    """(X − center) / scale over the padded block (IQR/z-scaling apply)."""
+    return (X - center[None, :]) / scale[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("method", "n"))
+def _mathop_apply_program(X, M, method: str, n=None):
+    """fn(X) + finite-mask + zero-fill in one program (feature_transformation)."""
+    fn = _MATH_OPS[method] if n is None else (lambda x: _MATH_OPS_N[method](x, n))
+    Y = fn(X)
+    ok = M & jnp.isfinite(Y)
+    return jnp.where(ok, Y, 0.0).astype(jnp.float32), ok
+
+
+@jax.jit
+def _impute_num_program(data, mask, fill):
+    """where(mask, x, fill) as f32 — the numeric MMM fill."""
+    return jnp.where(mask, data.astype(jnp.float32), fill)
+
+
+@jax.jit
+def _impute_num_int_program(data, mask, fill):
+    """Integer-column MMM fill with an integral value: the int cast stays
+    INSIDE the program (an eager astype after the fused fill re-added the
+    per-column convert dispatch this layer exists to remove)."""
+    return jnp.where(mask, data.astype(jnp.float32), fill).astype(jnp.int32)
+
+
+@jax.jit
+def _row_valid_program(mask, nrows):
+    """(padded,) bool row-validity iota — one shared program instead of a
+    per-call eager ones/iota/and chain."""
+    return jnp.arange(mask.shape[0]) < nrows
+
+
+@jax.jit
+def _impute_cat_program(data, mask, code, nrows):
+    """(filled codes, full-validity mask) for the categorical MMM fill."""
+    valid = mask & (data >= 0)
+    rv = jnp.arange(data.shape[0]) < nrows
+    return jnp.where(valid, data, code).astype(jnp.int32), rv
+
+
+@jax.jit
+def _label_encode_program(lut, data, mask):
+    """vocab-LUT gather + null fold + validity in one program
+    (cat_to_num_unsupervised label encoding)."""
+    idx = jnp.where(data >= 0, lut[jnp.clip(data, 0, lut.shape[0] - 1)], -1)
+    valid = mask & (idx >= 0)
+    return jnp.where(valid, idx, 0).astype(jnp.int32), valid
+
+
+@jax.jit
+def _event_vector_cat_program(data, code):
+    return (data == code).astype(jnp.float32)
+
+
+@jax.jit
+def _event_vector_num_program(data, value):
+    return (data.astype(jnp.float32) == value).astype(jnp.float32)
 
 __all__ = [
     "attribute_binning",
@@ -170,10 +248,17 @@ def attribute_binning(
     edges = np.concatenate(
         [np.full((len(cols), 1), -np.inf), cutoffs, np.full((len(cols), 1), np.inf)], axis=1
     )
-    bins0 = digitize(X, jnp.asarray(pad_lane_params(edges, X.shape[1]), jnp.float32))  # 0-indexed
+    edges_p = pad_lane_params(edges, X.shape[1]).astype(np.float32)
+    if fuse_enabled():
+        # digitize + 1-based cast in one program; the host edge array rides
+        # in through the jit boundary (no eager convert program)
+        bins0, bins1 = _bin_apply_program(X, edges_p)
+    else:
+        bins0 = digitize(X, jnp.asarray(edges_p))  # 0-indexed
+        bins1 = None
     new_cols: "OrderedDict[str, Column]" = OrderedDict()
     if bin_dtype == "numerical":
-        data = (bins0 + 1).astype(jnp.int32)
+        data = bins1 if bins1 is not None else (bins0 + 1).astype(jnp.int32)
         for i, c in enumerate(cols):
             new_cols[c] = Column("num", data[:, i], idf.columns[c].mask, dtype_name="int")
     else:
@@ -266,9 +351,15 @@ def _event_vector(idf: Table, label_col: str, event_label):
     if col.kind == "cat":
         hits = np.nonzero(col.vocab == str(event_label))[0]
         code = int(hits[0]) if len(hits) else -2
-        y = (col.data == code).astype(jnp.float32)
+        if fuse_enabled():
+            y = _event_vector_cat_program(col.data, np.int32(code))
+        else:
+            y = (col.data == code).astype(jnp.float32)
     else:
-        y = (col.data.astype(jnp.float32) == float(event_label)).astype(jnp.float32)
+        if fuse_enabled():
+            y = _event_vector_num_program(col.data, np.float32(float(event_label)))
+        else:
+            y = (col.data.astype(jnp.float32) == float(event_label)).astype(jnp.float32)
     return y, col.mask
 
 
@@ -362,8 +453,20 @@ def cat_to_num_unsupervised(
         for j, v in enumerate(col.vocab):
             if str(v) in mp:
                 code_map[j] = mp[str(v)]
-        from anovos_tpu.ops.segment import vocab_lookup
+        from anovos_tpu.ops.segment import _bucket_segments, vocab_lookup
 
+        if fuse_enabled() and method_type == "label_encoding":
+            # LUT gather + null fold + validity in one program (the eager
+            # chain dispatched three programs per encoded column); the LUT
+            # is padded to its 2^k class so every vocab size shares one
+            # compiled program per row shape (vocab_lookup discipline)
+            p = _bucket_segments(len(code_map))
+            lut = np.concatenate(
+                [code_map, np.zeros(p - len(code_map), code_map.dtype)]
+            ) if p > len(code_map) else code_map
+            data, valid = _label_encode_program(jnp.asarray(lut), col.data, col.mask)
+            new_cols[c] = Column("num", data, valid, dtype_name="int")
+            continue
         idx = jnp.where(col.data >= 0, vocab_lookup(code_map, col.data), -1)
         valid = col.mask & (idx >= 0)
         if method_type == "label_encoding":
@@ -539,7 +642,11 @@ def IQR_standardization(
     X, M = idf.numeric_block(cols)
     med_p = pad_lane_params(med, X.shape[1])
     iqr_p = pad_lane_params(iqr, X.shape[1], fill=1.0)
-    Z = (X - jnp.asarray(med_p)[None, :]) / jnp.asarray(iqr_p)[None, :]
+    if fuse_enabled():
+        # one affine program; host params ride through the jit boundary
+        Z = _affine_scale_program(X, med_p.astype(np.float32), iqr_p.astype(np.float32))
+    else:
+        Z = (X - jnp.asarray(med_p)[None, :]) / jnp.asarray(iqr_p)[None, :]
     new_cols = OrderedDict(
         (c, Column("num", Z[:, i].astype(jnp.float32), idf.columns[c].mask, dtype_name="double"))
         for i, c in enumerate(cols)
@@ -676,6 +783,7 @@ def imputation_MMM(
                 "imputation_MMM",
             )
 
+    fused = fuse_enabled()
     new_cols: "OrderedDict[str, Column]" = OrderedDict()
     for c in cols:
         if c not in fills:
@@ -686,10 +794,21 @@ def imputation_MMM(
             fv = float(v)
             if np.isnan(fv):
                 continue
-            data = jnp.where(col.mask, col.data.astype(jnp.float32), fv)
-            if col.data.dtype == jnp.int32 and float(fv).is_integer():
-                data = data.astype(jnp.int32)
-            new_cols[c] = Column("num", data, jnp.ones_like(col.mask) & (jnp.arange(col.padded_len) < idf.nrows), dtype_name=col.dtype_name)
+            if fused:
+                # fill + cast in one shared program per (shape, dtype)
+                if col.data.dtype == jnp.int32 and float(fv).is_integer():
+                    data = _impute_num_int_program(col.data, col.mask,
+                                                   np.float32(fv))
+                else:
+                    data = _impute_num_program(col.data, col.mask,
+                                               np.float32(fv))
+                rv = _row_valid_program(col.mask, np.int32(idf.nrows))
+                new_cols[c] = Column("num", data, rv, dtype_name=col.dtype_name)
+            else:
+                data = jnp.where(col.mask, col.data.astype(jnp.float32), fv)
+                if col.data.dtype == jnp.int32 and float(fv).is_integer():
+                    data = data.astype(jnp.int32)
+                new_cols[c] = Column("num", data, jnp.ones_like(col.mask) & (jnp.arange(col.padded_len) < idf.nrows), dtype_name=col.dtype_name)
         else:
             if v is None:
                 continue
@@ -699,11 +818,18 @@ def imputation_MMM(
                 code = len(vocab) - 1
             else:
                 vocab, code = col.vocab, int(hits[0])
-            valid = col.mask & (col.data >= 0)
-            data = jnp.where(valid, col.data, code).astype(jnp.int32)
-            new_cols[c] = Column(
-                "cat", data, jnp.arange(col.padded_len) < idf.nrows, vocab=vocab, dtype_name="string"
-            )
+            if fused:
+                data, rv = _impute_cat_program(col.data, col.mask,
+                                               np.int32(code),
+                                               np.int32(idf.nrows))
+                new_cols[c] = Column("cat", data, rv, vocab=vocab,
+                                     dtype_name="string")
+            else:
+                valid = col.mask & (col.data >= 0)
+                data = jnp.where(valid, col.data, code).astype(jnp.int32)
+                new_cols[c] = Column(
+                    "cat", data, jnp.arange(col.padded_len) < idf.nrows, vocab=vocab, dtype_name="string"
+                )
     odf = _emit(idf, new_cols, output_mode, "_imputed")
     if print_impact:
         logger.info(f"imputed ({method_type}): {list(new_cols)}")
@@ -772,12 +898,21 @@ def feature_transformation(
     else:
         raise TypeError("Invalid input for method_type")
     X, M = idf.numeric_block(cols)
-    Y = fn(X)
-    ok = M & jnp.isfinite(Y)
-    new_cols = OrderedDict(
-        (c, Column("num", jnp.where(ok[:, i], Y[:, i], 0.0).astype(jnp.float32), ok[:, i], dtype_name="double"))
-        for i, c in enumerate(cols)
-    )
+    if fuse_enabled():
+        # math op + finite-mask + zero-fill in one program over the block
+        Yc, ok = _mathop_apply_program(
+            X, M, method_type, n=N if method_type in _MATH_OPS_N else None)
+        new_cols = OrderedDict(
+            (c, Column("num", Yc[:, i], ok[:, i], dtype_name="double"))
+            for i, c in enumerate(cols)
+        )
+    else:
+        Y = fn(X)
+        ok = M & jnp.isfinite(Y)
+        new_cols = OrderedDict(
+            (c, Column("num", jnp.where(ok[:, i], Y[:, i], 0.0).astype(jnp.float32), ok[:, i], dtype_name="double"))
+            for i, c in enumerate(cols)
+        )
     odf = idf
     for name, col in new_cols.items():
         odf = odf.with_column(name if output_mode == "replace" else name + postfix, col)

@@ -41,7 +41,7 @@ __all__ = ["install", "mark", "census", "COMPILE_EVENT"]
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 _LOCK = threading.Lock()
-_EVENTS: List[Tuple[str, str, float]] = []  # (name, fingerprint, seconds)
+_EVENTS: List[Tuple[str, str, float, Optional[str]]] = []  # (name, fingerprint, seconds, node)
 _INSTALLED = False
 
 
@@ -70,10 +70,19 @@ def _listener(event: str, duration_secs: float, **_kw) -> None:
         return
     try:
         name, fp = _sniff_program()
+        # node attribution for fused programs: the devprof node bracket of
+        # the DISPATCHING thread (compiles happen synchronously inside the
+        # node body's dispatch) — None outside any node / devprof off
+        try:
+            from anovos_tpu.obs import devprof
+
+            node = devprof.current_node()
+        except Exception:
+            node = None
         with _LOCK:
             if fp is None:
                 fp = f"<event-{len(_EVENTS)}>"  # degrade: every compile distinct
-            _EVENTS.append((name, fp, float(duration_secs)))
+            _EVENTS.append((name, fp, float(duration_secs), node))
         reg = get_metrics()
         reg.counter("xla_compiles_total",
                     "XLA backend compiles observed this process").inc()
@@ -116,11 +125,14 @@ def census(since: int = 0, top: int = 20) -> dict:
         events = list(_EVENTS[since:])
     by_name: dict = {}
     fps = set()
-    for name, fp, secs in events:
+    for name, fp, secs, node in events:
         fps.add(fp)
-        row = by_name.setdefault(name, {"program": name, "count": 0, "seconds": 0.0})
+        row = by_name.setdefault(name, {"program": name, "count": 0, "seconds": 0.0,
+                                        "nodes": set()})
         row["count"] += 1
         row["seconds"] += secs
+        if node:
+            row["nodes"].add(node)
     programs = sorted(by_name.values(), key=lambda r: (-r["seconds"], r["program"]))
     if top:
         programs = programs[:top]
@@ -128,9 +140,10 @@ def census(since: int = 0, top: int = 20) -> dict:
         "compiles_total": len(events),
         "distinct_programs": len(fps),
         "distinct_kernels": len(by_name),
-        "compile_seconds_total": round(sum(s for _, _, s in events), 3),
+        "compile_seconds_total": round(sum(e[2] for e in events), 3),
         "programs": [
-            {"program": r["program"], "count": r["count"], "seconds": round(r["seconds"], 3)}
+            {"program": r["program"], "count": r["count"], "seconds": round(r["seconds"], 3),
+             "nodes": sorted(r["nodes"])}
             for r in programs
         ],
     }

@@ -73,6 +73,7 @@ logger = logging.getLogger("anovos_tpu.obs.devprof")
 __all__ = [
     "enabled",
     "reset",
+    "current_node",
     "node_bracket",
     "dispatch_bracket",
     "transfer_bracket",
@@ -417,6 +418,15 @@ def transfer_bracket(direction: str, nbytes: int, label: str = ""):
                             time.perf_counter() - t0, label)
         except Exception:
             logger.exception("devprof transfer record failed")
+
+
+def current_node() -> "Optional[str]":
+    """Name of the scheduler node executing on THIS thread (None outside a
+    node bracket, or when devprof is disabled).  The compile census stamps
+    each backend-compile event with it, so a fused block's programs are
+    attributable to the node that compiled them."""
+    fr = getattr(_TL, "frame", None)
+    return fr.name if fr is not None else None
 
 
 def results() -> Dict[str, dict]:

@@ -111,6 +111,9 @@ def _kmeans_inertia_sweep(X: jax.Array, max_k: int, iters: int = 50, seed: int =
     # lax.map (not vmap): candidates run sequentially inside one compiled
     # program, so peak memory stays one candidate's working set instead of
     # max_k× — the (max_k, n, max_k) batched tensors would OOM at scale
+    # (a vmapped variant was measured here and reverted: batching the
+    # candidate axis LOST ~50% on CPU — every candidate then pays the max
+    # iteration count instead of its own convergence)
     return jax.lax.map(one_candidate, jnp.arange(1, max_k + 1))
 
 
@@ -162,10 +165,21 @@ def _neighbor_counts_tile(Xq: jax.Array, Xs: jax.Array, eps2: jax.Array) -> jax.
 def neighbor_counts(X: np.ndarray, eps: float, tile: int = 4096) -> np.ndarray:
     """Within-eps neighbor count per point (incl. self) — the count pass
     dbscan_fit uses; public so a hyperparameter grid can compute it once per
-    eps and share it across every min_samples."""
+    eps and share it across every min_samples.
+
+    ``ANOVOS_USE_PALLAS=1`` (TPU-only, EXPERIMENTAL — ops/pallas_kernels)
+    swaps in the hand-scheduled kernel that streams the query rows through
+    VMEM with the (tile, n) distance block kept on-chip; the XLA tile loop
+    below materializes each block in HBM.  The backend choice happens
+    OUTSIDE jit so the env var is honored per call."""
+    from anovos_tpu.ops.pallas_kernels import neighbor_counts_pallas, use_pallas
+
     X = np.asarray(X, np.float32)
     Xd = jnp.asarray(X - X.mean(axis=0, keepdims=True), jnp.float32)  # magnitude → spread
     eps2 = jnp.asarray(eps * eps, jnp.float32)
+    if use_pallas():
+        # early-return branch: nothing dispatches after this materialization
+        return np.asarray(neighbor_counts_pallas(Xd, eps2))  # graftcheck: disable=GC001
     # dispatch every tile before fetching any: the per-tile programs queue
     # asynchronously on the device stream and the transfers drain afterwards
     # (a fetch inside the dispatch loop serialized tile k+1 behind tile k's
@@ -377,7 +391,42 @@ def dbscan_host_grid_multi(
     # (measured: distance-sorting the edges to make each eps a prefix slice
     # LOSES — the shuffled edge order is cache-hostile for the per-combo
     # bincount/remap gathers; the row-major order from nonzero wins)
+    from anovos_tpu.ops.fuse import fuse_enabled
+
+    fused = fuse_enabled()
     out = np.full((len(eps_list), len(min_samples_list), n), -1, np.int64)
+    # T-nearest border-adoption prefix, built ONCE for the WHOLE grid over
+    # the union border set (non-core at the smallest eps and largest ms ⊇
+    # every combo's border set, since neighbor counts are monotone in eps):
+    # each (eps, ms) then adopts via a (rows, T) core-gather + argmax
+    # instead of re-gathering a (rows, n) distance block — the per-combo
+    # gather/where/argmin was ~2/3 of the grid's host wall.  The prefix is
+    # the T nearest neighbors by RAW distance, sorted by (d², index), so
+    # the first in-eps core in a row's prefix IS the exact argmin-with-
+    # lowest-index owner whenever its distance beats the prefix max (ties
+    # at the boundary, or a truncated prefix, fall back to the full row).
+    nn_part = nn_d2 = nn_pmax = bi_pos = None
+    if fused and len(min_samples_list):
+        emin = min(eps_list)
+        wmin = d2e <= emin * emin
+        cmin = (np.bincount(ei[wmin], minlength=n)
+                + np.bincount(ej[wmin], minlength=n) + 1)
+        UBI = np.nonzero(cmin < max(min_samples_list))[0]
+        if len(UBI):
+            Du = D2[UBI]
+            T = min(64, n)
+            nn_part = np.argpartition(Du, T - 1, axis=1)[:, :T] if T < n else (
+                np.broadcast_to(np.arange(n), (len(UBI), n)).copy())
+            nn_d2 = np.take_along_axis(Du, nn_part, axis=1)
+            o1 = np.argsort(nn_part, axis=1)
+            nn_part = np.take_along_axis(nn_part, o1, axis=1)
+            nn_d2 = np.take_along_axis(nn_d2, o1, axis=1)
+            o2 = np.argsort(nn_d2, axis=1, kind="stable")
+            nn_part = np.take_along_axis(nn_part, o2, axis=1)
+            nn_d2 = np.take_along_axis(nn_d2, o2, axis=1)
+            nn_pmax = nn_d2[:, -1]
+            bi_pos = np.full(n, -1, np.int64)
+            bi_pos[UBI] = np.arange(len(UBI))
     for a, eps in enumerate(eps_list):
         within = d2e <= eps * eps
         eia, eja = ei[within], ej[within]
@@ -418,7 +467,33 @@ def dbscan_host_grid_multi(
                 _, comp = connected_components(g, directed=True, connection="weak")
             out[a, b, ci] = comp
             bi = np.nonzero(~core)[0]
-            if len(bi):
+            if len(bi) and nn_part is not None:
+                rows_u = bi_pos[bi]  # positions in the union border set
+                pref = nn_part[rows_u]  # (m, T) candidate indices
+                cand = core[pref] & (nn_d2[rows_u] <= eps * eps)
+                has = cand.any(axis=1)
+                first = cand.argmax(axis=1)
+                r = np.arange(len(bi))
+                d_first = nn_d2[rows_u, first]
+                pm = nn_pmax[rows_u]
+                # prefix is conclusive when the chosen core beats the raw
+                # prefix max (every candidate ≤ d_first is then inside the
+                # prefix), or when the prefix already spans past eps (all
+                # within-eps neighbors are present)
+                ok = has & (d_first < pm)
+                owner = pref[r, first]
+                out[a, b, bi[ok]] = comp[remap[owner[ok]]]
+                # inconclusive rows (boundary tie, or a prefix truncated
+                # inside the eps ball): exact full-row adoption
+                fb = ~ok & (pm <= eps * eps)
+                if fb.any():
+                    bif = bi[fb]
+                    D2b = D2[bif]
+                    Db = np.where(core[None, :] & (D2b <= eps * eps), D2b, np.inf)
+                    j = np.argmin(Db, axis=1)
+                    hit = np.isfinite(Db[np.arange(len(bif)), j])
+                    out[a, b, bif[hit]] = comp[remap[j[hit]]]
+            elif len(bi):
                 # contiguous ROW gather + column mask beats the (bi, ci)
                 # double-fancy gather ~5×; ci is ascending so the argmin
                 # tie-winner is identical

@@ -9,33 +9,47 @@ inputs psum-merge the partial products.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
+from anovos_tpu.obs import timed
+from anovos_tpu.ops.mxu import bf16_sweep, mm
 from anovos_tpu.ops.reductions import masked_mean
 
 
-@jax.jit
+@timed("ops.masked_corr")
 def masked_corr(X: jax.Array, M: jax.Array) -> jax.Array:
     """Pairwise-complete Pearson correlation matrix.
 
     X: (rows, k); M: (rows, k) bool.  Returns (k, k).
     For each pair (a,b) all sums run over rows where BOTH are valid — five
-    matmuls total, all MXU-shaped.
+    matmuls total, all MXU-shaped.  The matmuls are pre-centered, so they
+    qualify for the guarded bf16 sweep (``ANOVOS_TPU_BF16=1``, ops/mxu.py
+    — read here, outside jit, per call); default is true-f32.
     """
+    return _masked_corr(X, M, bf16=bf16_sweep())
+
+
+@functools.partial(jax.jit, static_argnames=("bf16",))
+def _masked_corr(X: jax.Array, M: jax.Array, bf16: bool = False) -> jax.Array:
     dt = jnp.float32
     Mf = M.astype(dt)
     Xf = X.astype(dt)
     # pre-center each column by its global masked mean: pairwise-complete
     # Pearson r is exactly translation-invariant, and without the shift the
     # n·Sxy − Sx·Sy cancellation loses most f32 bits for large-offset
-    # low-spread columns (a year column came back with r off by 0.06)
+    # low-spread columns (a year column came back with r off by 0.06).
+    # The centering is also what makes the bf16 route SAFE: post-shift
+    # magnitudes are spread-scale, so bf16 input rounding is a bounded
+    # relative perturbation instead of a cancellation amplifier.
     Xm = jnp.where(M, Xf - masked_mean(Xf, M)[None, :], 0.0)
     X2m = Xm * Xm
-    n = Mf.T @ Mf                       # pairwise counts
-    Sx = Xm.T @ Mf                      # Sx[a,b] = Σ x_a over both-valid rows
-    Sxx = X2m.T @ Mf
-    Sxy = Xm.T @ Xm
+    n = mm(Mf.T, Mf, bf16)              # pairwise counts
+    Sx = mm(Xm.T, Mf, bf16)             # Sx[a,b] = Σ x_a over both-valid rows
+    Sxx = mm(X2m.T, Mf, bf16)
+    Sxy = mm(Xm.T, Xm, bf16)
     Sy = Sx.T
     Syy = Sxx.T
     cov_n = n * Sxy - Sx * Sy
@@ -47,18 +61,45 @@ def masked_corr(X: jax.Array, M: jax.Array) -> jax.Array:
     return jnp.where(jnp.eye(k, dtype=bool), 1.0, corr)
 
 
-@jax.jit
+@timed("ops.masked_cov")
 def masked_cov(X: jax.Array, M: jax.Array) -> jax.Array:
     """Pairwise-complete sample covariance matrix (n-1 normalization),
-    matching RowMatrix.computeCovariance on complete data."""
+    matching RowMatrix.computeCovariance on complete data.  Pre-centered →
+    eligible for the guarded bf16 sweep (ops/mxu.py), like masked_corr."""
+    return _masked_cov(X, M, bf16=bf16_sweep())
+
+
+@functools.partial(jax.jit, static_argnames=("bf16",))
+def _masked_cov(X: jax.Array, M: jax.Array, bf16: bool = False) -> jax.Array:
     dt = jnp.float32
     Mf = M.astype(dt)
     Xf = X.astype(dt)
     # same pre-centering as masked_corr: covariance is translation-invariant
     # and the Sxy − SxSy/n cancellation is catastrophic at raw magnitudes
     Xm = jnp.where(M, Xf - masked_mean(Xf, M)[None, :], 0.0)
-    n = Mf.T @ Mf
-    Sx = Xm.T @ Mf
-    Sxy = Xm.T @ Xm
+    n = mm(Mf.T, Mf, bf16)
+    Sx = mm(Xm.T, Mf, bf16)
+    Sxy = mm(Xm.T, Xm, bf16)
     mean_prod = Sx * Sx.T / jnp.maximum(n, 1.0)
     return jnp.where(n > 1, (Sxy - mean_prod) / jnp.maximum(n - 1.0, 1.0), jnp.nan)
+
+
+@timed("ops.masked_corr_cc")
+def masked_corr_cc(X: jax.Array, M: jax.Array, k_live: int) -> jax.Array:
+    """Complete-case Pearson correlation over the LIVE lanes of a
+    column-bucketed block, fused: the per-call eager chain at the
+    association_evaluator call site (live-lane row count, complete-case
+    scalar compare, mask combine) compiled three single-primitive programs
+    per run — here it folds into the correlation program itself.  The live
+    count rides in as a device scalar so the program stays keyed on the
+    bucketed shape."""
+    import numpy as np
+
+    return _masked_corr_cc(X, M, np.int32(k_live), bf16=bf16_sweep())
+
+
+@functools.partial(jax.jit, static_argnames=("bf16",))
+def _masked_corr_cc(X: jax.Array, M: jax.Array, k_live: jax.Array,
+                    bf16: bool = False) -> jax.Array:
+    row_ok = (M.sum(axis=1) == k_live)[:, None]
+    return _masked_corr(X, M & row_ok, bf16=bf16)

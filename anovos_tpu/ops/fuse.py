@@ -1,0 +1,40 @@
+"""Whole-block fusion switch (``ANOVOS_FUSE_BLOCKS``).
+
+PR 4's compile census put a number on what the per-op ``@jit`` discipline
+missed: of the 152 programs a cold ``configs_full`` run compiles, ~half are
+single-primitive programs (``convert_element_type``, ``broadcast_in_dim``,
+``dynamic_slice``, ``bitwise_and`` …) emitted by EAGER glue between the
+fused kernels — per-column mask combines, parameter broadcasts, treated-
+block slices, centering chains.  Each one costs a compile on the cold path
+and a dispatch round-trip on every warm call.
+
+The fusion layer collapses those chains: each hot scheduler block routes
+its glue through one (or a small fixed number of) jitted programs over the
+padded ``(rows, k_pad)`` block — HPAT's thesis (PAPERS.md) that scripting-
+level analytics blocks can lower as whole compiled programs rather than
+dozens of kernel dispatches.
+
+``ANOVOS_FUSE_BLOCKS=0`` restores the eager chains at every gated site.
+The two paths are BYTE-identical by contract — the fused programs re-
+express the same ops in the same order, never a different algorithm —
+and ``tests/test_fuse_blocks.py`` pins fused-vs-unfused artifact-tree
+equality in fresh subprocesses per hot block.  The knob is registered in
+``fingerprint.KNOWN_ENV_KNOBS`` defensively (same policy as
+``ANOVOS_SHAPE_BUCKETS``): parity is tested, but the knob exists to flip
+compiled program structure, and a false cache invalidation is cheap.
+
+The knob is read per call, OUTSIDE any jit (the ``use_pallas`` discipline,
+ops/drift_kernels.py), so it is honored per call instead of baked into a
+trace cache.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["fuse_enabled"]
+
+
+def fuse_enabled() -> bool:
+    """True (default) = route gated glue chains through fused programs."""
+    return os.environ.get("ANOVOS_FUSE_BLOCKS", "1") != "0"

@@ -1,0 +1,61 @@
+"""Guarded bf16 mixed-precision matmul routing (``ANOVOS_TPU_BF16``).
+
+The TPU MXU natively consumes bf16 inputs; true-f32 matmuls cost ~4-6
+passes through the systolic array.  PERF.md's on-chip sweep found the
+corruption class that makes a blanket bf16 default unusable for a stats
+framework: **quadratic expansion** kernels (pairwise distances,
+raw-moment covariance) subtract same-magnitude products, so bf16's 8-bit
+mantissa on the INPUTS turns into relative error amplified by the
+cancellation — within-eps adjacency was off by orders of magnitude at
+lat/lon-scale coordinates.  Those kernels pin
+``jax.lax.Precision.HIGHEST`` unconditionally (ops/cluster.py ``_HI``)
+and are NOT routed here.
+
+What IS safe: matmuls whose inputs are **pre-centered** (magnitude ~
+spread, so no catastrophic cancellation is left for bf16 to amplify) and
+whose accumulation stays f32 (``preferred_element_type``) — the
+correlation/covariance kernels (pre-centered since the round-5 fix) and
+the PCA covariance + projection products.  There the bf16 rounding is a
+bounded relative perturbation of an already-approximate statistic, and
+``tests/test_mxu_bf16.py`` pins the tolerance bands.
+
+``ANOVOS_TPU_BF16=1`` opts in (default off: byte-stable f32 artifacts).
+The knob is read per call OUTSIDE jit and passed down as a static arg, so
+it is honored per call instead of baked into a trace cache; it is
+registered in ``fingerprint.KNOWN_ENV_KNOBS`` so bf16 and f32 runs never
+share cache entries.  On CPU the routing still changes artifacts (the
+cast is real) but wins nothing — the claim is the MXU's.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["bf16_sweep", "mm"]
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def bf16_sweep() -> bool:
+    """True when ``ANOVOS_TPU_BF16=1``: route the guarded matmul sites
+    through bf16 inputs + f32 accumulation."""
+    return os.environ.get("ANOVOS_TPU_BF16", "0") == "1"
+
+
+def mm(a: jax.Array, b: jax.Array, bf16: bool) -> jax.Array:
+    """One guarded matmul site: bf16 inputs + f32 accumulation when the
+    sweep is on, true-f32 (HIGHEST) otherwise.
+
+    ``bf16`` must be the caller's trace-time static (read via
+    :func:`bf16_sweep` outside jit) — never read the env here, inside a
+    traced function, where it would be baked stale into the jit cache.
+    """
+    if bf16:
+        return jnp.matmul(
+            a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+    return jnp.matmul(a, b, precision=_HI)

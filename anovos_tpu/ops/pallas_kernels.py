@@ -171,6 +171,72 @@ def moments_pallas(X: jax.Array, M: jax.Array, interpret: bool = False) -> jax.A
     )(X.astype(jnp.float32), M)
 
 
+def _neighbor_count_kernel(xq_ref, xs_ref, eps2_ref, out_ref):
+    """One query tile vs the FULL point set: the (TILE, n) squared-distance
+    block never leaves VMEM — quadratic expansion on the MXU, compare +
+    lane-reduce on the VPU, only the (TILE,) counts are written back.
+
+    Distances stay f32 end-to-end: the MXU's bf16-input default is exactly
+    the corruption class PERF.md documents for quadratic expansions, so the
+    matmul pins HIGHEST precision like the XLA twin (_neighbor_counts_tile).
+    """
+    xq = xq_ref[:]  # (TILE, d)
+    xs = xs_ref[:]  # (n_pad, d)
+    eps2 = eps2_ref[0]
+    d2 = (
+        (xq * xq).sum(axis=1, keepdims=True)
+        - 2.0 * jax.lax.dot_general(
+            xq, xs, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        + (xs * xs).sum(axis=1)[None, :]
+    )  # (TILE, n_pad)
+    # padding rows of the SOURCE set sit at 1e9 per lane — squared distance
+    # ≥ 1e18 ≫ any real eps², so they can never count as neighbors
+    out_ref[:] = (d2 <= eps2).sum(axis=1).astype(jnp.int32)
+
+
+_NC_TILE = 1024
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def neighbor_counts_pallas(X: jax.Array, eps2: jax.Array, interpret: bool = False) -> jax.Array:
+    """Fused DBSCAN neighbor-count pass: X (n, d) centered points →
+    (n,) int32 within-eps neighbor counts (incl. self).
+
+    The XLA path (ops/cluster.neighbor_counts) dispatches one tiled
+    distance program per 4096-row block and materializes each (tile, n)
+    distance matrix in HBM; here the row dimension streams through VMEM in
+    tiles (grid) with the distance block kept on-chip — the second of the
+    two profiled non-XLA-friendly loops (ROADMAP item 5; the many-bucket
+    histogram was the first).  Parity-verified in interpret mode
+    (tests/test_pallas_kernels.py); compiled Mosaic execution needs the
+    TPU tunnel (PERF.md "Pallas status")."""
+    if not _PALLAS_OK:  # pragma: no cover
+        raise RuntimeError("pallas unavailable")
+    n, d = X.shape
+    pad = (-n) % _NC_TILE
+    Xq = X.astype(jnp.float32)
+    if pad:
+        # query padding at 1e9: the padded rows' counts are discarded by the
+        # caller's [:n] slice; as SOURCE rows they are masked by distance
+        Xq = jnp.concatenate([Xq, jnp.full((pad, d), 1e9, jnp.float32)])
+    grid = (Xq.shape[0] // _NC_TILE,)
+    out = pl.pallas_call(
+        _neighbor_count_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_NC_TILE, d), lambda i: (i, 0)),
+            pl.BlockSpec((Xq.shape[0], d), lambda i: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((_NC_TILE,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((Xq.shape[0],), jnp.int32),
+        interpret=interpret,
+    )(Xq, Xq, jnp.asarray(eps2, jnp.float32).reshape(1))
+    return out[:n]
+
+
 _WARNED = False
 
 

@@ -71,6 +71,15 @@ def bucket_segments_pow2(n: int) -> int:
     return max(8, 1 << (max(n, 1) - 1).bit_length())
 
 
+@jax.jit
+def cat_valid_mask(codes: jax.Array, M: jax.Array) -> jax.Array:
+    """mask & (code >= 0) — THE categorical null rule as one shared
+    program.  The eager per-column compare/and chain spelled one
+    greater_equal + one bitwise_and program at every stacking call site
+    (stats mask prep, varclus, large-cat describe) — cold-compile census."""
+    return M & (codes >= 0)
+
+
 @functools.partial(jax.jit, static_argnames=("vocab_size",))
 def _code_counts_p(codes: jax.Array, M: jax.Array, vocab_size: int) -> jax.Array:
     valid = M & (codes >= 0)

@@ -277,6 +277,36 @@ def measure() -> None:
 E2E_CONFIG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "config", "configs_full.yaml")
 
+HOT_BLOCK_BUDGET_CSV = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "tests", "golden", "e2e_hot_block_budget.csv")
+
+
+def hot_block_budget_check(blocks: dict, budget_csv: str = None) -> dict:
+    """Round-9 hot-block budget gate: compare the warm per-block walls
+    against the committed single-CPU budgets for the fused hot blocks
+    (geospatial_controller ≤ 0.8 s, timeseries_analyzer ≤ 0.6 s — the
+    targets ROADMAP item 5 set for the whole-block fusion layer).
+    Returns the loud JSON fields; never raises (the gate must not sink
+    the headline)."""
+    try:
+        hot = pd.read_csv(budget_csv or HOT_BLOCK_BUDGET_CSV
+                          ).set_index("block")["budget_warm_s"]
+        over = {b: {"warm_s": round(blocks[b], 3), "budget_s": float(hot[b])}
+                for b in hot.index if b in blocks and blocks[b] > hot[b]}
+        out = {
+            "e2e_hot_block_budget_ok": not over,
+            "e2e_hot_blocks": {
+                b: {"warm_s": round(blocks[b], 3) if b in blocks else None,
+                    "budget_s": float(hot[b])}
+                for b in hot.index},
+        }
+        if over:
+            out["e2e_hot_block_over"] = over
+        return out
+    except Exception as e:
+        return {"e2e_hot_block_budget_error": str(e)[-200:]}
+
 
 def _e2e_rows() -> int:
     """Row count of the e2e config's input dataset, derived from the run's
@@ -351,6 +381,14 @@ def e2e_cold_warm() -> dict:
         # tests/golden/e2e_block_budget.csv)
         "e2e_warm_blocks": {k: round(v, 2) for k, v in top_blocks.items()},
     }
+    # round-9 hot-block budget gate (tests/golden/e2e_hot_block_budget.csv):
+    # the two blocks the whole-block fusion layer was built to flatten must
+    # HOLD their warm single-CPU budgets — recorded loudly in the round
+    # output so a regression is a red field in the JSON, not a quiet drift
+    result.update(hot_block_budget_check(blocks))
+    if not result.get("e2e_hot_block_budget_ok", True):
+        print(f"bench: HOT-BLOCK BUDGET EXCEEDED: "
+              f"{result.get('e2e_hot_block_over')}", file=sys.stderr)
     if census.get("cold"):
         # cold-run compile census (obs.compile_census via the manifest):
         # total XLA backend compiles, distinct program signatures, and the

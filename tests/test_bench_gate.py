@@ -244,3 +244,26 @@ def test_cache_gate_flags_zero_hits():
     assert inc == {"e2e_incremental_wall_s": 1.0, "e2e_incremental_misses": 1}
     # populate pass contributes no fields
     assert bench._cache_fields("populate", {"misses": 14}, 3.6) == {}
+
+
+def test_hot_block_budget_gate():
+    """Round-9 hot-block gate: the committed budgets trip loudly when the
+    fused blocks exceed them, pass when under, and tolerate an absent
+    block (a renamed block must not crash the headline — the per-block
+    regression test owns name drift)."""
+    import bench
+
+    ok = bench.hot_block_budget_check(
+        {"geospatial_controller": 0.7, "timeseries_analyzer": 0.55})
+    assert ok["e2e_hot_block_budget_ok"] is True
+    assert ok["e2e_hot_blocks"]["geospatial_controller"]["budget_s"] == 0.8
+    assert "e2e_hot_block_over" not in ok
+
+    bad = bench.hot_block_budget_check(
+        {"geospatial_controller": 1.4, "timeseries_analyzer": 0.55})
+    assert bad["e2e_hot_block_budget_ok"] is False
+    assert "geospatial_controller" in bad["e2e_hot_block_over"]
+
+    missing = bench.hot_block_budget_check({"timeseries_analyzer": 0.5})
+    assert missing["e2e_hot_block_budget_ok"] is True
+    assert missing["e2e_hot_blocks"]["geospatial_controller"]["warm_s"] is None

@@ -1,0 +1,221 @@
+"""Whole-block fusion parity (ops/fuse.py, ``ANOVOS_FUSE_BLOCKS``).
+
+The fusion layer re-expresses each hot block's eager glue as compiled
+programs — never a different algorithm — so artifacts must be
+BYTE-identical with the knob on vs off.  The harness mirrors
+tests/test_shape_buckets.py: one fresh subprocess per mode (jit caches
+cannot leak between them) runs a workflow whose node set covers every
+fused block — stats fan-out, quality spine (duplicate/nullRows/invalid/
+outlier/nullColumns), associations (corr/IV/IG/varclus), drift,
+transformers (binning/mathops/IQR/encoding/MMM/PCA), the ts analyzer
+(three-grain viz + cat viz), the geospatial controller (elbow/kmeans/
+DBSCAN grid/silhouettes), and chart prep — then the artifact trees are
+hash-compared (obs/ telemetry excluded).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+_CHILD = r"""
+import hashlib, json, os, pathlib, sys, tempfile
+import numpy as np, pandas as pd, yaml
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["ANOVOS_TPU_EXECUTOR"] = "sequential"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import logging
+logging.basicConfig(level=logging.ERROR)
+
+data_dir = sys.argv[1]
+workdir = sys.argv[2]
+
+cfg = {
+    "input_dataset": {"read_dataset": {"file_path": data_dir, "file_type": "parquet"}},
+    "timeseries_analyzer": {"auto_detection": True, "id_col": "ifa",
+                            "tz_offset": "local", "inspection": True,
+                            "analysis_level": "daily", "max_days": 3600},
+    "geospatial_controller": {"geospatial_analyzer": {
+        "auto_detection_analyzer": True, "id_col": "ifa",
+        "max_analysis_records": 100000, "top_geo_records": 50,
+        "max_cluster": 8, "eps": "0.3,0.4,0.05", "min_samples": "60,120,30"}},
+    "anovos_basic_report": {"basic_report": False},
+    "stats_generator": {
+        "metric": ["global_summary", "measures_of_counts", "measures_of_centralTendency",
+                   "measures_of_cardinality", "measures_of_percentiles",
+                   "measures_of_dispersion", "measures_of_shape"],
+        "metric_args": {"list_of_cols": "all", "drop_cols": ["ifa"]}},
+    "quality_checker": {
+        "duplicate_detection": {"list_of_cols": "all", "drop_cols": ["ifa"], "treatment": True},
+        "nullRows_detection": {"list_of_cols": "all", "drop_cols": [], "treatment": True,
+                               "treatment_threshold": 0.75},
+        "invalidEntries_detection": {"list_of_cols": "all", "drop_cols": ["ifa"],
+                                     "treatment": True, "output_mode": "replace"},
+        "outlier_detection": {"list_of_cols": "all", "drop_cols": ["ifa", "income"],
+                              "detection_side": "upper",
+                              "detection_configs": {"pctile_lower": 0.05, "pctile_upper": 0.9,
+                                                    "stdev_upper": 3.0, "IQR_upper": 1.5,
+                                                    "min_validation": 2},
+                              "treatment": True, "treatment_method": "value_replacement",
+                              "output_mode": "replace"},
+        "nullColumns_detection": {"list_of_cols": "all", "drop_cols": ["ifa", "income"],
+                                  "treatment": True, "treatment_method": "MMM",
+                                  "treatment_configs": {"method_type": "median",
+                                                        "output_mode": "replace"}},
+    },
+    "association_evaluator": {
+        "correlation_matrix": {"list_of_cols": "all", "drop_cols": ["ifa"]},
+        "IV_calculation": {"list_of_cols": "all", "drop_cols": "ifa", "label_col": "income",
+                           "event_label": ">50K",
+                           "encoding_configs": {"bin_method": "equal_frequency",
+                                                "bin_size": 10, "monotonicity_check": 0}},
+        "IG_calculation": {"list_of_cols": "all", "drop_cols": "ifa", "label_col": "income",
+                           "event_label": ">50K",
+                           "encoding_configs": {"bin_method": "equal_frequency",
+                                                "bin_size": 10, "monotonicity_check": 0}},
+        "variable_clustering": {"list_of_cols": "all", "drop_cols": "ifa|income"},
+    },
+    "drift_detector": {"drift_statistics": {
+        "configs": {"list_of_cols": "all", "drop_cols": ["ifa", "income"],
+                    "method_type": "all", "threshold": 0.1, "bin_method": "equal_range",
+                    "bin_size": 10},
+        "source_dataset": {"read_dataset": {"file_path": data_dir, "file_type": "parquet"}}}},
+    "report_preprocessing": {
+        "master_path": "report_stats",
+        "charts_to_objects": {"list_of_cols": "all", "drop_cols": "ifa",
+                              "label_col": "income", "event_label": ">50K",
+                              "bin_method": "equal_frequency", "bin_size": 10,
+                              "drift_detector": True, "outlier_charts": False}},
+    "transformers": {
+        "numerical_mathops": {"feature_transformation": {"list_of_cols": "all",
+                                                         "drop_cols": [], "method_type": "sqrt"}},
+        "numerical_binning": {"attribute_binning": {"list_of_cols": "all", "drop_cols": [],
+                                                    "method_type": "equal_frequency",
+                                                    "bin_size": 10, "bin_dtype": "numerical"}},
+        "categorical_encoding": {"cat_to_num_supervised": {"list_of_cols": "all",
+                                                           "drop_cols": ["ifa"],
+                                                           "label_col": "income",
+                                                           "event_label": ">50K"}},
+        "numerical_rescaling": {"IQR_standardization": {"list_of_cols": "all"}},
+        "numerical_latentFeatures": {"PCA_latentFeatures": {"list_of_cols": "all",
+                                                            "explained_variance_cutoff": 0.95,
+                                                            "standardization": False,
+                                                            "imputation": True}},
+    },
+    "write_intermediate": {"file_path": "intermediate_data", "file_type": "csv",
+                           "file_configs": {"mode": "overwrite", "header": True,
+                                            "delimiter": ",", "inferSchema": True}},
+    "write_main": {"file_path": "output", "file_type": "parquet",
+                   "file_configs": {"mode": "overwrite"}},
+    "write_stats": {"file_path": "stats", "file_type": "parquet",
+                    "file_configs": {"mode": "overwrite"}},
+}
+os.makedirs(workdir, exist_ok=True)
+cfg_path = os.path.join(workdir, "cfg.yaml")
+with open(cfg_path, "w") as f:
+    yaml.safe_dump(cfg, f, sort_keys=False)
+from anovos_tpu import workflow  # import before chdir ('' on sys.path)
+os.chdir(workdir)
+workflow.run(cfg_path, "local")
+
+h = hashlib.sha256()
+root = pathlib.Path(workdir)
+for p in sorted(root.rglob("*")):
+    if p.is_file() and "obs" not in p.parts and p.name != "cfg.yaml":
+        h.update(str(p.relative_to(root)).encode())
+        h.update(p.read_bytes())
+print("TREE=" + h.hexdigest())
+"""
+
+
+def _dataset(tmp_path):
+    """Synthetic table engaging EVERY fused block: numerics with nulls and
+    zero-inflation, categoricals, a name-matched lat/lon pair with cluster
+    structure, a parseable timestamp column, and a binary label."""
+    n = 4000
+    g = np.random.default_rng(17)
+    centers = g.uniform([-20, -40], [40, 50], size=(4, 2))
+    which = g.integers(0, 4, n)
+    ts = (np.datetime64("2022-01-01T00:00:00")
+          + g.integers(0, 200 * 24 * 3600, n).astype("timedelta64[s]"))
+    df = pd.DataFrame({
+        "ifa": [f"id{i:06d}" for i in range(n)],
+        "age": g.normal(40, 12, n).round(0).clip(17, 90),
+        "fnlwgt": g.normal(1.9e5, 9e4, n).round(0).clip(1e4, 9e5),
+        "hours": g.normal(40, 10, n).round(0).clip(1, 99),
+        "gain": np.where(g.random(n) < 0.9, 0.0, g.exponential(9000, n).round(0)),
+        "latitude": (centers[which, 0] + g.normal(0, 0.3, n)).round(5),
+        "longitude": (centers[which, 1] + g.normal(0, 0.3, n)).round(5),
+        "workclass": g.choice(["Private", "Gov", "Self"], n),
+        "education": g.choice(["HS", "College", "Masters", "PhD"], n),
+        "dt_1": pd.Series(ts).dt.strftime("%Y-%m-%d %H:%M:%S"),
+        "income": g.choice(["<=50K", ">50K"], n, p=[0.75, 0.25]),
+    })
+    for c in ("age", "hours", "workclass"):
+        df.loc[g.random(n) < 0.03, c] = np.nan
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    df.to_parquet(data_dir / "part-00000.parquet", index=False)
+    return str(data_dir)
+
+
+def test_fused_vs_unfused_byte_parity(tmp_path):
+    """Artifact trees identical with ANOVOS_FUSE_BLOCKS=1 vs =0, fresh
+    subprocess per mode (obs/ excluded — telemetry legitimately differs:
+    the whole point is a different program structure)."""
+    data_dir = _dataset(tmp_path)
+    hashes = {}
+    for mode in ("1", "0"):
+        env = {**os.environ, "ANOVOS_FUSE_BLOCKS": mode, "JAX_PLATFORMS": "cpu"}
+        env.pop("XLA_FLAGS", None)  # single-device child (parity must not
+        # depend on the 8-virtual-device test mesh)
+        env.pop("ANOVOS_TPU_CACHE", None)  # parity runs uncached
+        workdir = tmp_path / f"run_{mode}"
+        r = subprocess.run(
+            [sys.executable, "-c", _CHILD, data_dir, str(workdir)],
+            capture_output=True, text=True, env=env, timeout=780,
+        )
+        assert r.returncode == 0, r.stderr[-4000:]
+        lines = [ln for ln in r.stdout.splitlines() if ln.startswith("TREE=")]
+        assert lines, r.stdout[-2000:]
+        hashes[mode] = lines[-1]
+    assert hashes["1"] == hashes["0"], (
+        "whole-block fusion changed artifact bytes (ANOVOS_FUSE_BLOCKS=1 vs 0)")
+
+
+def test_fuse_knob_default_and_registration():
+    from anovos_tpu.cache.fingerprint import KNOWN_ENV_KNOBS
+    from anovos_tpu.ops.fuse import fuse_enabled
+
+    assert "ANOVOS_FUSE_BLOCKS" in KNOWN_ENV_KNOBS
+    assert fuse_enabled() in (True, False)  # never raises
+
+
+def test_dbscan_grid_parity_inline(monkeypatch):
+    """Unit-level fused-vs-eager parity for the DBSCAN grid's T-nearest
+    border adoption (the least obviously-equivalent fusion): exact label
+    equality across eps/min_samples regimes incl. heavy-noise uniforms."""
+    import jax
+    import jax.numpy as jnp
+
+    from anovos_tpu.ops.cluster import dbscan_host_grid_multi, pairwise_d2
+
+    g = np.random.default_rng(23)
+    pts = np.concatenate([
+        g.normal((0, 0), 0.2, (700, 2)),
+        g.normal((3, 3), 0.25, (700, 2)),
+        g.uniform(-6, 6, (600, 2)),
+    ]).astype(np.float32)
+    Xc = pts - pts.mean(axis=0, keepdims=True)
+    D2 = np.asarray(jax.device_get(pairwise_d2(jnp.asarray(Xc))))
+    for eps_l, ms_l in [([0.3, 0.4, 0.5], [5, 15, 40]), ([0.05], [2, 3]),
+                        ([1.5], [300, 900])]:
+        monkeypatch.setenv("ANOVOS_FUSE_BLOCKS", "0")
+        ref = dbscan_host_grid_multi(D2, eps_l, ms_l)
+        monkeypatch.setenv("ANOVOS_FUSE_BLOCKS", "1")
+        out = dbscan_host_grid_multi(D2, eps_l, ms_l)
+        np.testing.assert_array_equal(out, ref)

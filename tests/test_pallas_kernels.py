@@ -23,6 +23,29 @@ def test_pallas_histogram_parity():
     assert out.sum() == np.asarray(M).sum()
 
 
+def test_pallas_neighbor_counts_parity():
+    """DBSCAN neighbor-count kernel == the XLA tiled pass, in interpret
+    mode, across non-tile-multiple row counts and eps scales (incl. the
+    all-isolated and the everything-connected regimes)."""
+    from anovos_tpu.ops.cluster import neighbor_counts
+    from anovos_tpu.ops.pallas_kernels import _PALLAS_OK, neighbor_counts_pallas
+
+    if not _PALLAS_OK:
+        pytest.skip("pallas unavailable")
+    import jax
+
+    g = np.random.default_rng(3)
+    centers = g.uniform(-40, 40, size=(4, 2))
+    for n, eps in [(3000, 0.4), (1024, 0.05), (1500, 50.0), (257, 0.3)]:
+        X = (centers[g.integers(0, 4, n)] + g.normal(0, 0.3, (n, 2))).astype(np.float32)
+        Xc = X - X.mean(axis=0, keepdims=True)
+        ref = neighbor_counts(X, eps)
+        out = np.asarray(neighbor_counts_pallas(
+            jnp.asarray(Xc), jnp.asarray(eps * eps, jnp.float32), interpret=True))
+        np.testing.assert_array_equal(out, ref)
+        assert out.min() >= 1  # every point neighbors itself
+
+
 def test_moments_pallas_matches_xla_interpret():
     """Single-pass Chan-merge moments kernel == two-pass XLA kernel,
     including a large-mean column that would cancel under raw power sums."""

@@ -45,7 +45,15 @@ def format_census(census: dict, top: int = 15) -> str:
         f"{'seconds':>9}  {'count':>5}  program",
     ]
     for row in census.get("programs", [])[: top or None]:
-        lines.append(f"{row['seconds']:9.3f}  {row['count']:5d}  {row['program']}")
+        # node attribution (census events are stamped with the devprof node
+        # bracket active at compile time — fused-block programs then name
+        # the scheduler node that owns them; absent on older manifests)
+        nodes = row.get("nodes") or []
+        node_s = ""
+        if nodes:
+            shown = ", ".join(nodes[:3]) + (f", +{len(nodes) - 3}" if len(nodes) > 3 else "")
+            node_s = f"  [{shown}]"
+        lines.append(f"{row['seconds']:9.3f}  {row['count']:5d}  {row['program']}{node_s}")
     return "\n".join(lines)
 
 
