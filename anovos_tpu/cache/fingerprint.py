@@ -55,10 +55,28 @@ __all__ = [
 KNOWN_ENV_KNOBS = (
     # whole-block fusion (ops/fuse.py): =0 restores the eager glue chains
     "ANOVOS_FUSE_BLOCKS",
+    # hardened-ingest policy knobs (data_ingest/guard.py): what happens to
+    # a corrupt part (quarantine drops its rows vs raise), a schema-
+    # drifted part (reconcile null-fills/widens vs strict crash) and a
+    # hostile value (mask vs clip vs keep) all change the DATA a run
+    # computes over, so runs under different policies must never share
+    # cache entries.  ANOVOS_INGEST_RETRIES stays off the list — a
+    # successful re-read is byte-identical (same policy as
+    # ANOVOS_TPU_RETRIES).
+    "ANOVOS_INGEST_ON_CORRUPT",
+    "ANOVOS_INGEST_SANITIZE",
+    "ANOVOS_INGEST_SCHEMA_DRIFT",
     "ANOVOS_MATMUL_PRECISION",
     "ANOVOS_REPLICATE_MAX_BYTES",
     "ANOVOS_REREAD_FROM_DISK",
     "ANOVOS_SHAPE_BUCKETS",
+    # streaming backpressure depth (ops/streaming.py).  Drain order is
+    # FIFO at any window so committed artifacts do not change — but the
+    # knob is read inside the node-reachable streaming path, and the
+    # env-read audit (GC008/GC012) wants every such knob on the audited
+    # list; a false invalidation on a knob nobody flips mid-project is
+    # cheap, an unauditable env read is not.
+    "ANOVOS_STREAM_INFLIGHT",
     # bf16 mixed-precision sweep (ops/mxu.py): routes the MXU-safe
     # pre-centered matmuls (corr/cov/PCA) through bf16 inputs with f32
     # accumulation — artifacts change within the tested tolerance bands,

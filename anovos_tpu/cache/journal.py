@@ -11,6 +11,20 @@ aborting), ``node_degraded`` (retries exhausted; the section is marked,
 the run continues), and ``backend_failover`` (mid-run flip to CPU — the
 committed frontier above this line is exactly what the failover run
 kept).
+The hardened data plane (round 10) adds the streaming/ingest events:
+``chunk_begin`` / ``chunk_commit`` (one resumable-streaming chunk's
+partial statistics about to compute / durably committed — written by
+``ops.streaming.StreamCheckpoint`` into its own ``stream_journal.jsonl``
+through this class, with ``stream``/``phase``/``chunk`` fields),
+``chunks_invalidated`` (a part's readability changed between runs —
+same bytes, transient fault — so the committed chunks from
+``from_chunk`` on covered shifted rows and were dropped to recompute;
+with ``phase: 2`` the histogram bucket bounds drifted and every pass-2
+partial was dropped),
+and ``part_quarantined`` (the ingest guard set a part aside — ``file``,
+``error_class``, ``stage``, ``rows_lost``; the crash-safe
+``obs/quarantine_manifest.json`` is the durable record, this line the
+WAL trail next to node_retry/node_degraded).
 The journal is append-only ACROSS runs in the same output directory, so
 a killed run's committed frontier is still on disk when ``--resume``
 re-runs the config: resumed nodes hit the cache store (the store commit,

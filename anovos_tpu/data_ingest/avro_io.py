@@ -19,6 +19,8 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from anovos_tpu.data_ingest.guard import raw_reader
+
 _MAGIC = b"Obj\x01"
 
 
@@ -128,12 +130,17 @@ def _decode_value(buf, ftype):
     raise ValueError(f"unsupported avro type: {ftype}")
 
 
+@raw_reader
 def read_avro(path: str) -> Dict[str, np.ndarray]:
     """Read one .avro container file → dict of host column arrays.
 
     Decodes through the native C++ library when available (two-phase
     columnar decode, anovos_native.cpp); falls back to the pure-Python
     record loop for exotic schemas or when no toolchain exists.
+
+    RAW reader (graftcheck GC012): invoke through
+    ``guard.guarded_part_read`` from node-reachable code — the
+    data_ingest callers do.
     """
     with open(path, "rb") as f:
         raw = f.read()

@@ -16,6 +16,7 @@ import gzip
 import logging
 import os
 import shutil
+import threading
 import warnings
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Union
@@ -28,6 +29,7 @@ import pyarrow as pa
 import pyarrow.csv as pacsv
 
 from anovos_tpu.data_ingest import avro_io
+from anovos_tpu.data_ingest import guard
 from anovos_tpu.shared.runtime import get_runtime
 from anovos_tpu.shared.table import Column, Table, _host_to_column, _pad_to
 from anovos_tpu.shared.utils import ends_with, pairwise_reduce, parse_cols
@@ -35,8 +37,23 @@ from anovos_tpu.shared.utils import ends_with, pairwise_reduce, parse_cols
 logger = logging.getLogger(__name__)
 
 # one-shot notice when the pyarrow CSV checkpoint writer falls back to
-# pandas (mixed-format directories must be observable, not silent)
+# pandas (mixed-format directories must be observable, not silent).
+# Lock-guarded: concurrent async-writer threads checkpointing CSVs race
+# this flag, and an unsynchronized check-then-set could log the notice
+# N times or (worse, on sufficiently relaxed memory models) tear — the
+# round-10 satellite replaces the bare module global with a lock.
+_PANDAS_CSV_FALLBACK_LOCK = threading.Lock()
 _PANDAS_CSV_FALLBACK_LOGGED = False
+
+
+def _csv_fallback_first_notice() -> bool:
+    """True exactly once per process (thread-safe one-shot)."""
+    global _PANDAS_CSV_FALLBACK_LOGGED
+    with _PANDAS_CSV_FALLBACK_LOCK:
+        if _PANDAS_CSV_FALLBACK_LOGGED:
+            return False
+        _PANDAS_CSV_FALLBACK_LOGGED = True
+        return True
 
 _EXTENSIONS = {
     "csv": (".csv",),
@@ -132,15 +149,32 @@ def read_dataset(file_path: str, file_type: str, file_configs: Optional[dict] = 
                 # native-friendly path: per-file decode straight to Tables
                 # (string columns stay dictionary codes), row-union via
                 # concatenate_dataset's vocab-union remap.  Falls through to
-                # pandas only on decode failure.
+                # pandas only on a SCHEMA this codec can't express (empty
+                # decode); an unreadable part is quarantined by the guard —
+                # re-attempting it through pandas would just fail (and
+                # quarantine) again.
+                pol = guard.policy_from_env()
                 tables = []
+                bad = set()
                 for f in files:
-                    decoded = avro_io.read_avro(f)
+                    decoded = guard.guarded_part_read(
+                        f, lambda f=f: avro_io.read_avro(f),
+                        file_type="avro", policy=pol)
+                    if decoded is None:
+                        bad.add(f)
+                        continue
                     if not decoded:
                         tables = None
                         break
                     n = len(next(iter(decoded.values())))
                     tables.append(Table.from_numpy(_coerce_numeric_strings(decoded), nrows=n))
+                if tables is not None and not tables:
+                    raise guard.IngestError(
+                        f"every avro part under {file_path} was quarantined "
+                        f"({len(bad)} part(s)) — no schema left to build a Table")
+                # empty-decode fallback: don't re-attempt (and re-quarantine)
+                # the parts the guard already set aside
+                files = [f for f in files if f not in bad]
                 if tables:
                     out = tables[0] if len(tables) == 1 else concatenate_dataset(
                         *tables, method_type="name")
@@ -152,38 +186,77 @@ def read_dataset(file_path: str, file_type: str, file_configs: Optional[dict] = 
     return out
 
 
+@guard.raw_reader
+def _read_one_part(f: str, file_type: str, cfg: dict) -> pd.DataFrame:
+    """RAW single-part decode — the guard layer's designated reader.
+
+    Only :func:`guarded part reads <anovos_tpu.data_ingest.guard.guarded_part_read>`
+    may call this (graftcheck GC012 keeps it that way): a decode failure
+    here is exactly the fault class the guard retries and quarantines."""
+    if file_type == "csv":
+        delim = str(cfg.get("delimiter", cfg.get("sep", ",")))
+        header = cfg.get("header", True)
+        header = str(header).lower() in ("true", "1")
+        ropts = pacsv.ReadOptions(autogenerate_column_names=not header)
+        popts = pacsv.ParseOptions(delimiter=delim)
+        tbl = pacsv.read_csv(f, read_options=ropts, parse_options=popts)
+        # pyarrow does NOT fail on undecodable UTF-8 — it silently types the
+        # column binary, and those bytes objects would poison every cat
+        # vocab downstream.  Surface it as the decode failure it is (with
+        # the exact byte offset from the first offending value) so the
+        # guard quarantines the part instead.
+        import pyarrow.types as pat
+
+        bad = [fld.name for fld in tbl.schema
+               if pat.is_binary(fld.type) or pat.is_large_binary(fld.type)]
+        if bad:
+            for chunk in tbl.column(bad[0]).chunks:
+                for v in chunk:
+                    b = v.as_py()
+                    if b is not None:
+                        b.decode("utf-8")  # raises UnicodeDecodeError w/ offset
+            raise ValueError(f"CSV part {f}: columns {bad} are not valid UTF-8")
+        return tbl.to_pandas()
+    if file_type == "parquet":
+        return pd.read_parquet(f)
+    if file_type == "avro":
+        from anovos_tpu.shared.native import NativeEncodedStrings
+
+        dec = avro_io.read_avro(f)
+        dec = {
+            k: (v.to_object_array() if isinstance(v, NativeEncodedStrings) else v)
+            for k, v in dec.items()
+        }
+        return pd.DataFrame(dec)
+    if file_type == "json":
+        opener = gzip.open if f.endswith(".gz") else open
+        with opener(f, "rt") as fh:
+            return pd.read_json(fh, lines=True)
+    raise ValueError(f"unsupported file_type: {file_type}")
+
+
 def read_host_frame(files: List[str], file_type: str, cfg: dict) -> pd.DataFrame:
     """Host pandas frame from part files (shared by the single-process and
-    multi-host loaders)."""
-    frames = []
+    multi-host loaders) — GUARDED: each part decodes under the quarantine/
+    retry policy, schemas reconcile across parts, and hostile values are
+    sanitized at this boundary (anovos_tpu.data_ingest.guard)."""
+    if file_type not in ("csv", "parquet", "avro", "json"):
+        raise ValueError(f"unsupported file_type: {file_type}")
+    pol = guard.policy_from_env()
+    frames: List = []
     for f in files:
-        if file_type == "csv":
-            import pyarrow.csv as pacsv
-
-            delim = str(cfg.get("delimiter", cfg.get("sep", ",")))
-            header = cfg.get("header", True)
-            header = str(header).lower() in ("true", "1")
-            ropts = pacsv.ReadOptions(autogenerate_column_names=not header)
-            popts = pacsv.ParseOptions(delimiter=delim)
-            frames.append(pacsv.read_csv(f, read_options=ropts, parse_options=popts).to_pandas())
-        elif file_type == "parquet":
-            frames.append(pd.read_parquet(f))
-        elif file_type == "avro":
-            from anovos_tpu.shared.native import NativeEncodedStrings
-
-            dec = avro_io.read_avro(f)
-            dec = {
-                k: (v.to_object_array() if isinstance(v, NativeEncodedStrings) else v)
-                for k, v in dec.items()
-            }
-            frames.append(pd.DataFrame(dec))
-        elif file_type == "json":
-            opener = gzip.open if f.endswith(".gz") else open
-            with opener(f, "rt") as fh:
-                frames.append(pd.read_json(fh, lines=True))
-        else:
-            raise ValueError(f"unsupported file_type: {file_type}")
-    df = frames[0] if len(frames) == 1 else pd.concat(frames, ignore_index=True)
+        df = guard.guarded_part_read(
+            f, lambda f=f: _read_one_part(f, file_type, cfg),
+            file_type=file_type, policy=pol)
+        if df is not None:
+            frames.append((f, df))
+    if not frames:
+        raise guard.IngestError(
+            f"every {file_type} part was quarantined ({len(files)} file(s), "
+            f"first: {files[0] if files else '<none>'}) — no schema left to "
+            "build a frame")
+    aligned = guard.reconcile_frames(frames, pol)
+    df = aligned[0] if len(aligned) == 1 else pd.concat(aligned, ignore_index=True)
     if str(cfg.get("inferSchema", True)).lower() in ("true", "1", "none"):
         # whole-dataset schema inference (Spark inferSchema parity): per-part
         # readers can disagree (an all-null part decodes as string/null), so
@@ -204,7 +277,9 @@ def read_host_frame(files: List[str], file_type: str, cfg: dict) -> pd.DataFrame
                 else:
                     # all-null column → numeric NaN column
                     df[c] = pd.to_numeric(df[c], errors="coerce")
-    return df
+    # hostile-value sanitization LAST (after inferSchema may have produced
+    # new float columns): downstream device kernels never see inf/overflow
+    return guard.sanitize_frame(df, pol)
 
 
 def write_dataset(
@@ -274,10 +349,21 @@ def write_dataset(
                 # pandas handles those.  The except stays broad so the
                 # fallback is total, but it logs ONCE with the cause so a
                 # mixed-format checkpoint directory is observable, not
-                # silent (round-4 advisor).
-                global _PANDAS_CSV_FALLBACK_LOGGED
-                if not _PANDAS_CSV_FALLBACK_LOGGED:
-                    _PANDAS_CSV_FALLBACK_LOGGED = True
+                # silent (round-4 advisor); the one-shot is lock-guarded
+                # (async-writer threads race it) and metered so the
+                # manifest shows every occurrence even after the log
+                # went quiet.
+                try:
+                    from anovos_tpu.obs import get_metrics as _gm
+
+                    _gm().counter(
+                        "csv_pandas_fallback_total",
+                        "checkpoint CSV parts written by the pandas fallback "
+                        "writer (mixed-format directory risk)",
+                    ).inc()
+                except Exception:
+                    pass  # telemetry must not break the fallback it counts
+                if _csv_fallback_first_notice():
                     logging.getLogger(__name__).info(
                         "pyarrow CSV writer fell back to pandas for %s "
                         "(%s: %s); later parts may mix formats "

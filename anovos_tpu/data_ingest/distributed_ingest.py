@@ -32,6 +32,7 @@ import numpy as np
 import pandas as pd
 
 from anovos_tpu.data_ingest.data_ingest import _resolve_files, read_host_frame
+from anovos_tpu.data_ingest.guard import IngestError, policy_from_env
 from anovos_tpu.shared.table import Column, Table, wide_int_parts
 from anovos_tpu.shared.runtime import DATA_AXIS, get_runtime
 
@@ -68,6 +69,22 @@ def _global_sharded(local: np.ndarray, fill) -> "jax.Array":
     return jax.make_array_from_process_local_data(sharding, local)
 
 
+def _empty_with_schema(files: List[str], file_type: str, cfg: dict) -> pd.DataFrame:
+    """A zero-row frame with the dataset's schema, from the first part
+    whose decode succeeds (guarded: a corrupt head part quarantines and
+    the next one is asked).  Every process must end up with the SAME
+    column set here or the schema allgather raises — which is correct:
+    if no part anywhere is readable the dataset is gone."""
+    for f in files:
+        try:
+            return read_host_frame([f], file_type, cfg).iloc[:0]
+        except IngestError:
+            continue
+    raise IngestError(
+        f"no readable {file_type} part among {len(files)} file(s) — cannot "
+        "even recover the schema")
+
+
 def read_dataset_distributed(
     file_path: str, file_type: str, file_configs: Optional[dict] = None
 ) -> Table:
@@ -80,10 +97,24 @@ def read_dataset_distributed(
     pid, nproc = jax.process_index(), jax.process_count()
     local_files = files[pid::nproc]
     if local_files:
-        df = read_host_frame(local_files, file_type, cfg)
+        try:
+            df = read_host_frame(local_files, file_type, cfg)
+        except IngestError:
+            if policy_from_env().on_corrupt == "raise":
+                # fail-fast policy: the guard raised on the FIRST bad part
+                # without quarantining anything — degrading to an empty
+                # slice here would silently drop this host's readable
+                # parts with no loss accounting anywhere
+                raise
+            # EVERY part in this host's slice was quarantined: degrade to
+            # an empty slice with a schema read from some still-readable
+            # part so the schema allgather below converges — the other
+            # hosts' rows survive, this host contributes none (its
+            # quarantine records carry the loss accounting)
+            df = _empty_with_schema(files, file_type, cfg)
     else:
         # more hosts than files: empty slice with the schema of file 0
-        df = read_host_frame(files[:1], file_type, cfg).iloc[:0]
+        df = _empty_with_schema(files, file_type, cfg)
 
     # ---- schema agreement -------------------------------------------------
     def _col_kind(s: pd.Series) -> str:

@@ -1432,11 +1432,39 @@ def anovos_report(
     except Exception:  # the report must render even if resilience is absent
         logger.exception("degradation registry unavailable; rendering without placeholders")
         degraded = {}
-    if degraded:
+    try:  # quarantined ingest parts (data-plane degradation): exact rows
+        from anovos_tpu.data_ingest import guard as _ingest_guard
+
+        quarantine = _ingest_guard.records()
+    except Exception:
+        logger.exception("quarantine registry unavailable; rendering without it")
+        quarantine = []
+    if degraded or quarantine:
         items = "".join(
             f"<li><b>{escape(node)}</b> — {escape(reason)}</li>"
             for node, reason in sorted(degraded.items())
         )
+        qrows = ""
+        if quarantine:
+            body = "".join(
+                "<tr><td>{f}</td><td>{ec}</td><td>{rows}</td></tr>".format(
+                    f=escape(os.path.basename(r.file)),
+                    ec=escape(r.error_class),
+                    rows=("unknown" if r.rows_lost is None
+                          else f"{r.rows_lost}{' (est.)' if r.rows_estimated else ''}"),
+                )
+                for r in sorted(quarantine, key=lambda r: r.file)
+            )
+            lost = sum(r.rows_lost or 0 for r in quarantine)
+            qrows = (
+                f"<p><b>{len(quarantine)} input part(s) QUARANTINED</b> "
+                f"({lost} row(s) lost where measurable): every statistic "
+                "below was computed WITHOUT these rows — see "
+                "<code>obs/quarantine_manifest.json</code>.</p>"
+                "<table class='anv-degraded-q'><tr><th>part</th>"
+                "<th>error</th><th>rows lost</th></tr>"
+                f"{body}</table>"
+            )
         tabs.append((
             "Degraded Sections",
             "<div class='anv-degraded'><p><b>"
@@ -1446,7 +1474,7 @@ def anovos_report(
             "manifest's <code>resilience</code> section and "
             "<code>obs/run_journal.jsonl</code> for the failure record). "
             "Their statistics are missing from the tabs that follow.</p>"
-            f"<ul>{items}</ul></div>",
+            f"<ul>{items}</ul>{qrows}</div>",
         ))
 
     tabs.append(

@@ -26,9 +26,11 @@ Four cooperating, stdlib-only pieces:
 from anovos_tpu.resilience import chaos, failover, policy
 from anovos_tpu.resilience.chaos import (
     BackendWedge,
+    ChaosCorrupt,
     ChaosError,
     ChaosHang,
     ChaosPlan,
+    ChaosTruncate,
     chaos_point,
 )
 from anovos_tpu.resilience.failover import (
@@ -50,9 +52,11 @@ __all__ = [
     "failover",
     "policy",
     "BackendWedge",
+    "ChaosCorrupt",
     "ChaosError",
     "ChaosHang",
     "ChaosPlan",
+    "ChaosTruncate",
     "chaos_point",
     "backend_healthy",
     "failover_to_cpu",

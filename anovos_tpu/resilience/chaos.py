@@ -23,6 +23,20 @@ Each directive is ``kind@site[:opt=val]*``:
   (exercises mid-run failover: the health probe sees the wedge, flips
   the runtime to CPU, and the node re-executes).
 
+I/O fault kinds fire at the ingest guard's per-part read sites
+(``io:<absolute file path>`` — ``anovos_tpu.data_ingest.guard``), the
+data-plane analogue of the node faults above:
+
+* ``corrupt`` — raise :class:`ChaosCorrupt` as if the part's contents
+  failed to decode (bad magic / mangled pages; the guard must retry,
+  then quarantine);
+* ``truncate`` — raise :class:`ChaosTruncate` as if the part were cut
+  short (the truncated-parquet-footer class; same recovery path, a
+  distinct error class in the quarantine manifest);
+* ``slowread`` — sleep ``secs`` (default 5) before the read proceeds (a
+  degraded NFS/object store; exercises that slow I/O merely slows the
+  run instead of tripping any failure path).
+
 Sites are strings like ``node:<scheduler node name>``; the spec side is
 an ``fnmatch`` glob, so one directive can target a family of nodes
 (first match fires).  ``n=<count>`` bounds how many visits fire (default
@@ -56,6 +70,8 @@ logger = logging.getLogger("anovos_tpu.resilience.chaos")
 __all__ = [
     "ChaosError",
     "ChaosHang",
+    "ChaosCorrupt",
+    "ChaosTruncate",
     "BackendWedge",
     "ChaosPlan",
     "chaos_point",
@@ -70,7 +86,7 @@ __all__ = [
 
 ENV_KNOB = "ANOVOS_TPU_CHAOS"
 
-_KINDS = ("exc", "hang", "wedge")
+_KINDS = ("exc", "hang", "wedge", "corrupt", "truncate", "slowread")
 
 
 class ChaosError(RuntimeError):
@@ -84,6 +100,17 @@ class ChaosHang(ChaosError):
 class BackendWedge(ChaosError):
     """An injected backend wedge: dispatch 'failed' and the simulated
     accelerator stays unresponsive until a failover clears it."""
+
+
+class ChaosCorrupt(ChaosError):
+    """An injected unreadable-part failure (bad magic / mangled pages):
+    the ingest guard must retry it, then quarantine the part."""
+
+
+class ChaosTruncate(ChaosError):
+    """An injected truncated-part failure (cut-short footer/rows): same
+    recovery path as ``corrupt``, distinct error class in the
+    quarantine manifest."""
 
 
 class _Directive:
@@ -136,6 +163,8 @@ class ChaosPlan:
                  else site_parts).append(part)
             site = ":".join(site_parts)
             d = _Directive(kind, site)
+            if kind == "slowread":
+                d.secs = 5.0  # a slow read, not a 600s hang (secs= overrides)
             for part in opt_parts:
                 k, _, v = part.partition("=")
                 if k == "n":
@@ -257,6 +286,18 @@ def chaos_point(site: str, interrupt: Optional[threading.Event] = None) -> None:
             logger.warning("chaos: injecting %s at %s", d.kind, site)
             if d.kind == "exc":
                 raise ChaosError(f"chaos-injected exception at {site}")
+            if d.kind == "corrupt":
+                raise ChaosCorrupt(
+                    f"chaos-injected corrupt part at {site} (simulated "
+                    "bad magic / mangled pages; the ingest guard must "
+                    "retry, then quarantine)")
+            if d.kind == "truncate":
+                raise ChaosTruncate(
+                    f"chaos-injected truncated part at {site} (simulated "
+                    "cut-short footer; retry, then quarantine)")
+            if d.kind == "slowread":
+                time.sleep(d.secs)
+                continue  # the read proceeds normally, just late
             if d.kind == "wedge":
                 set_wedged()
                 raise BackendWedge(

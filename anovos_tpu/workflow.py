@@ -45,6 +45,7 @@ import pandas as pd
 import yaml
 
 from anovos_tpu.data_ingest import data_ingest
+from anovos_tpu.data_ingest import guard as ingest_guard
 from anovos_tpu.data_ingest.ts_auto_detection import ts_preprocess
 from anovos_tpu.data_analyzer import association_evaluator, quality_checker, stats_generator
 from anovos_tpu.data_report.basic_report_generation import (
@@ -604,6 +605,10 @@ def main(
     chaos.install_from_env()
     res_policy.reset_degraded()
     res_failover.reset()
+    # the ingest guard's quarantine registry is per-run too; its manifest
+    # destination is configured once the obs/ subtree is known below —
+    # parts quarantined during the ETL read buffer until then
+    ingest_guard.reset()
     auth_key = _auth_key(auth_key_val)
     with get_tracer().span("input_dataset/ETL", cat="node"):
         df = ETL(all_configs.get("input_dataset"))
@@ -1062,6 +1067,9 @@ def main(
         # out; a clean run writes no dump either way)
         devprof.reset()
         flight.configure(os.path.join(obs_dir, "obs"))
+        # quarantine manifest lands in the same obs/ subtree (flushes any
+        # parts the ETL read already set aside); clean runs write nothing
+        ingest_guard.configure(os.path.join(obs_dir, "obs"))
 
         journal = None
         resumed_from = 0
@@ -1081,6 +1089,10 @@ def main(
                            cache_root=cache_store.root, resume=bool(resume),
                            executor=mode)
             sched.journal = journal
+            # parts quarantined from here on also land in the WAL as
+            # part_quarantined events (the ETL read already ran; its
+            # quarantines are in the manifest + registry regardless)
+            ingest_guard.set_journal(journal)
 
         run_err = None
         try:
@@ -1109,6 +1121,10 @@ def main(
                 resilience={
                     **summary.get("resilience", {}),
                     "degraded_sections": res_policy.degraded_sections(),
+                    # quarantined ingest parts with exact row counts (the
+                    # data-plane degradation record; obs/quarantine_manifest
+                    # .json is the crash-safe on-disk copy)
+                    "quarantine": ingest_guard.summary(),
                     "chaos": chaos_plan.summary() if chaos_plan else None,
                     # postmortems written this run (empty on a clean run);
                     # each names the trigger + node in its own JSON

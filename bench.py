@@ -443,6 +443,10 @@ def e2e_cold_warm() -> dict:
             result.update(e2e_chaos_recovery())
         except Exception as e:  # recovery section must never sink the headline
             result["e2e_chaos_error"] = str(e)[-200:]
+        try:
+            result.update(e2e_corrupt_ingest())
+        except Exception as e:
+            result["e2e_quarantine_error"] = str(e)[-200:]
     return result
 
 
@@ -484,6 +488,39 @@ def e2e_chaos_recovery() -> dict:
     if not rec.get("ok"):
         out["e2e_chaos_error"] = rec.get("error", "chaos scenario gate failed")
         print("bench: " + out["e2e_chaos_error"], file=sys.stderr)
+    return out
+
+
+def e2e_corrupt_ingest() -> dict:
+    """Data-plane recovery trajectory (hardened ingest, round 10): run the
+    tools/chaos_run.py ``corrupt-ingest`` scenario — one corrupt part,
+    one truncated part, one slow read — in a fresh process and record the
+    quarantine outcome (exact part and row counts) next to the node-level
+    chaos fields.  A failed gate lands as ``e2e_quarantine_error``."""
+    env = {**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS") or "cpu"}
+    for k in ("ANOVOS_TPU_CHAOS", "ANOVOS_TPU_CACHE", "ANOVOS_TPU_EXECUTOR",
+              "XLA_FLAGS"):
+        env.pop(k, None)
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.chaos_run", "--scenario", "corrupt-ingest",
+         "--json"],
+        capture_output=True, text=True, env=env, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    out: dict = {}
+    try:
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        out["e2e_quarantine_error"] = (
+            f"chaos_run corrupt-ingest produced no result (rc={p.returncode}): "
+            + (p.stderr or p.stdout)[-160:])
+        return out
+    out["e2e_quarantined_parts"] = rec.get("quarantined_parts")
+    out["e2e_quarantine_rows"] = rec.get("quarantine_rows")
+    out["e2e_quarantine_wall_s"] = rec.get("chaos_wall_s")
+    if not rec.get("ok"):
+        out["e2e_quarantine_error"] = rec.get("error", "corrupt-ingest gate failed")
+        print("bench: " + out["e2e_quarantine_error"], file=sys.stderr)
     return out
 
 

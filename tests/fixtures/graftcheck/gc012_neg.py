@@ -1,0 +1,33 @@
+"""GC012 negative fixture: host I/O that IS allowed in node-reachable
+ingest code — guarded reads, designated raw decoders, write-mode opens."""
+
+import pandas as pd
+
+from anovos_tpu.data_ingest.guard import guarded_part_read, raw_reader
+
+
+@raw_reader
+def _decode_part(path):
+    # the designated raw decoder the guard wraps: exempt by decorator
+    return pd.read_parquet(path)
+
+
+def load_part(path):
+    # THE guarded idiom: the raw read rides a lambda handed straight to
+    # guarded_part_read, which owns retry/quarantine for it
+    return guarded_part_read(
+        path, lambda: pd.read_parquet(path), file_type="parquet")
+
+
+def load_part_via_helper(path):
+    return guarded_part_read(
+        path, lambda: _decode_part(path), file_type="parquet")
+
+
+def write_marker(path):
+    open(path, "w").close()  # write mode: the capture hook owns writes
+
+
+def append_log(path, line):
+    with open(path, mode="a") as f:  # append mode: same
+        f.write(line)

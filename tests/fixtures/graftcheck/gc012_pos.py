@@ -1,0 +1,20 @@
+"""GC012 positive fixture: unguarded host reads in node-reachable ingest
+code — each one is a run-killer the quarantine layer never sees."""
+
+import gzip
+
+import pandas as pd
+import pyarrow.csv as pacsv
+
+HEAD = open("schema.json").read()  # module-level read at import time
+
+
+def load_part(path):
+    return pd.read_parquet(path)  # raw decode, no guard
+
+
+def load_csv(path):
+    tbl = pacsv.read_csv(path)  # raw decode, no guard
+    with gzip.open(path, "rt") as fh:  # read-mode open, no guard
+        fh.read()
+    return tbl

@@ -2,6 +2,8 @@
 src/test/anovos/data_ingest/test_data_ingest_integration.py — read all
 formats, write round-trips, combination ops on small frames)."""
 
+import os
+
 import numpy as np
 import pandas as pd
 import pytest
@@ -205,3 +207,89 @@ def test_data_sample_balanced():
     )
     out = s.to_pandas()["g"].value_counts()
     assert abs(out["a"] - out["b"]) < 0.25 * max(out["a"], out["b"])
+
+
+# ----------------------------------------------------------------------
+# mixed-format checkpoint directories + the pandas-CSV-fallback one-shot
+# (round-10 satellite: the module-global flag is now lock-guarded)
+# ----------------------------------------------------------------------
+def test_csv_fallback_notice_is_thread_safe_one_shot():
+    import threading
+
+    from anovos_tpu.data_ingest import data_ingest as di
+
+    with di._PANDAS_CSV_FALLBACK_LOCK:
+        di._PANDAS_CSV_FALLBACK_LOGGED = False
+    hits, barrier = [], threading.Barrier(8)
+
+    def racer():
+        barrier.wait()
+        if di._csv_fallback_first_notice():
+            hits.append(1)
+
+    threads = [threading.Thread(target=racer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(hits) == 1  # exactly one thread wins the one-shot
+
+
+def test_mixed_format_csv_directory_reads_consistently(tmp_path):
+    """Regression: a checkpoint directory holding BOTH pyarrow-written and
+    pandas-written CSV parts (the fallback scenario the one-shot notice
+    warns about) must read back as one consistent frame — the guard's
+    schema reconciliation absorbs the dtype wobble between the writers."""
+    from anovos_tpu.data_ingest import data_ingest as di
+
+    d = tmp_path / "ckpt"
+    d.mkdir()
+    part = pd.DataFrame({"x": [1.0, 2.0, 3.0], "flag": [True, False, True],
+                         "s": ["a", "b", "c"]})
+    # part 0 through the pyarrow writer (write_dataset's fast path:
+    # lowercase booleans, pre-formatted whole floats)
+    write_dataset(Table.from_pandas(part), str(d / "_tmp0"), "csv",
+                  {"mode": "overwrite"})
+    os.replace(str(d / "_tmp0" / "part-00000.csv"), str(d / "part-00000.csv"))
+    # part 1 via the pandas fallback writer's format (True/False casing)
+    part2 = pd.DataFrame({"x": [4.0, 5.0], "flag": [False, True], "s": ["d", "e"]})
+    part2.to_csv(d / "part-00001.csv", index=False)
+
+    t = read_dataset(str(d), "csv")
+    df = t.to_pandas()
+    assert t.nrows == 5
+    assert sorted(df["x"].tolist()) == [1.0, 2.0, 3.0, 4.0, 5.0]
+    # both writers' rows decode; boolean-ish strings survive as values
+    assert df["s"].tolist() == ["a", "b", "c", "d", "e"]
+    from anovos_tpu.data_ingest import guard
+
+    assert guard.records() == []  # format wobble is NOT corruption
+
+
+def test_pandas_fallback_writer_books_metric(tmp_path, monkeypatch):
+    """A part the pyarrow CSV writer cannot convert falls back to pandas,
+    books csv_pandas_fallback_total, and still round-trips.  The arrow
+    failure is simulated (the conversion limits that trigger it — exotic
+    object columns, duplicate names — cannot flow through a Table)."""
+    from anovos_tpu.data_ingest import data_ingest as di
+    from anovos_tpu.obs import get_metrics
+
+    get_metrics().reset()
+    with di._PANDAS_CSV_FALLBACK_LOCK:
+        di._PANDAS_CSV_FALLBACK_LOGGED = False
+
+    def arrow_limit(*a, **k):
+        raise ValueError("simulated arrow conversion limit")
+
+    monkeypatch.setattr(di.pacsv, "write_csv", arrow_limit)
+    df = pd.DataFrame({"v": [1.0, 2.0, 3.0], "s": ["a", "b", "c"]})
+    out = tmp_path / "fb"
+    write_dataset(Table.from_pandas(df), str(out), "csv",
+                  {"mode": "overwrite", "repartition": 2})
+    # one fallback per part, counted per occurrence; notice logged once
+    assert get_metrics().counter("csv_pandas_fallback_total").value() == 2
+    assert di._PANDAS_CSV_FALLBACK_LOGGED
+    monkeypatch.undo()
+    t = read_dataset(str(out), "csv")
+    assert t.nrows == 3
+    assert sorted(t.to_pandas()["s"].tolist()) == ["a", "b", "c"]

@@ -202,3 +202,115 @@ def test_two_process_drift_parity(tmp_path):
         assert abs(float(got.loc[c, "PSI"]) - float(exp.loc[c, "PSI"])) < 1e-3, c
         assert int(got.loc[c, "flagged"]) == int(exp.loc[c, "flagged"]), c
     assert int(exp.loc["x", "flagged"]) == 1  # the drift is real
+
+
+_FAILURE_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    corrupt_dir = sys.argv[3]; single_dir = sys.argv[4]; out = sys.argv[5]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["ANOVOS_INGEST_RETRIES"] = "0"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    sys.path.insert(0, "/root/repo")
+    from anovos_tpu.shared.runtime import init_runtime
+    init_runtime()
+
+    from anovos_tpu.data_ingest import guard
+    from anovos_tpu.data_ingest.distributed_ingest import read_dataset_distributed
+    import numpy as np
+
+    # case 1: process 1's entire file slice (part-00001) is corrupt — its
+    # frame degrades to empty-with-schema, the schema allgather still
+    # converges, and the other shards' rows survive
+    t = read_dataset_distributed(corrupt_dir, "parquet")
+    from anovos_tpu.ops.describe import table_describe
+    num_cols = [c for c in t.col_names if t.columns[c].kind == "num"]
+    stats, _ = table_describe(t, num_cols, [])
+    # each host quarantines ITS slice's parts: gather the union so the
+    # asserting host sees the record made on the holder host
+    from anovos_tpu.data_ingest.distributed_ingest import _allgather_obj
+    local_q = [r.file.rsplit("/", 1)[-1] for r in guard.records()]
+    quarantined = sorted({f for host in _allgather_obj(local_q) for f in host})
+
+    # case 2: more processes than files — process 1 holds ZERO files and
+    # must still converge through the schema allgather
+    t2 = read_dataset_distributed(single_dir, "parquet")
+
+    # case 3: host materialization of a multi-process table must raise
+    # (non-addressable shards), not silently return a partial frame
+    to_pandas_raised = ""
+    try:
+        t2.to_pandas()
+    except Exception as e:
+        to_pandas_raised = type(e).__name__
+    if pid == 0:
+        json.dump(
+            {
+                "nrows": t.nrows,
+                "count": np.asarray(stats["count"]).tolist(),
+                "quarantined": quarantined,
+                "nrows_single": t2.nrows,
+                "to_pandas_raised": to_pandas_raised,
+            },
+            open(out, "w"),
+        )
+    else:
+        assert to_pandas_raised, "to_pandas must raise on process 1 too"
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_failure_paths(tmp_path):
+    """The hardened-ingest satellite matrix for read_dataset_distributed:
+    a process whose whole slice is quarantined, a process holding zero
+    files, and the multi-process to_pandas raise — every case must
+    CONVERGE (the schema allgather runs on all hosts) instead of hanging
+    the cluster or dying."""
+    rng = np.random.default_rng(9)
+    n_part = 400
+    corrupt_dir = tmp_path / "corrupt"
+    corrupt_dir.mkdir()
+    for i in range(3):
+        pd.DataFrame({
+            "a": rng.normal(size=n_part),
+            "cat": rng.choice(["u", "v"], n_part),
+        }).to_parquet(corrupt_dir / f"part-{i:05d}.parquet", index=False)
+    # files[1::2] == [part-00001] is process 1's whole slice: corrupt it
+    bad = corrupt_dir / "part-00001.parquet"
+    raw = bad.read_bytes()
+    bad.write_bytes(raw[: len(raw) - 96])
+
+    single_dir = tmp_path / "single"
+    single_dir.mkdir()
+    pd.DataFrame({"a": rng.normal(size=n_part)}).to_parquet(
+        single_dir / "part-00000.parquet", index=False)
+
+    worker = tmp_path / "failure_worker.py"
+    worker.write_text(_FAILURE_WORKER)
+    out = tmp_path / "failure.json"
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "29523", str(corrupt_dir),
+             str(single_dir), str(out)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    logs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"failure-path worker died:\n{log[-3000:]}"
+    got = json.loads(out.read_text())
+
+    assert got["nrows"] == 2 * n_part          # part-00001's rows are gone
+    assert got["count"] == [2 * n_part]        # stats converge over survivors
+    assert got["quarantined"] == ["part-00001.parquet"]  # on the holder host
+    assert got["nrows_single"] == n_part       # zero-file host converged
+    assert got["to_pandas_raised"]             # multi-process materialization raises

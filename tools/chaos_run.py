@@ -70,6 +70,25 @@ SCENARIOS = {
     # lease, and the run must complete degraded within the watchdog bound
     # — no AllReduce deadlock, no wedged lane (run with --devices 8)
     "hang-collective": "seed=7;hang@node:drift_detector/*:secs=600:n=99",
+    # the DATA-PLANE scenario: two of the four input part files fail to
+    # decode on every attempt (one 'corrupt', one 'truncate' — distinct
+    # error classes in the quarantine manifest) plus a slow read on a
+    # third.  The ingest guard must retry, quarantine EXACTLY those two
+    # parts with exact row counts, and the run must complete degraded
+    # over the surviving rows; the clean leg must quarantine nothing.
+    "corrupt-ingest": ("seed=7;corrupt@io:*part-00001.parquet:n=99;"
+                       "truncate@io:*part-00002.parquet:n=99;"
+                       "slowread@io:*part-00003.parquet:secs=0.2"),
+}
+
+# how many synthetic input part files a scenario's dataset is split into
+SCENARIO_PARTS = {"corrupt-ingest": 4}
+
+# exact quarantine manifest contents (basename -> rows_lost) a scenario
+# must produce; the clean leg must always quarantine nothing (asserted
+# for every scenario)
+EXPECT_QUARANTINE = {
+    "corrupt-ingest": {"part-00001.parquet": 375, "part-00002.parquet": 375},
 }
 
 # which manifest resilience counters must be > 0 per scenario
@@ -79,6 +98,8 @@ EXPECT = {
     "wedge": ("failovers",),
     "full": ("retries", "timeout_escalations", "timeout_retries", "failovers"),
     "hang-collective": ("timeout_escalations", "timeout_retries"),
+    "corrupt-ingest": (),  # recovery happens below the scheduler: the
+                           # quarantine gate (EXPECT_QUARANTINE) is the check
 }
 
 # scenarios whose faults are DESIGNED to exhaust recovery: the named
@@ -88,6 +109,8 @@ EXPECT = {
 # of the clean wall — the "no wedged rendezvous lane" assertion
 EXPECT_DEGRADED = {
     "hang-collective": ("drift_detector/drift_statistics",),
+    # data-plane degradation: the two quarantined parts, named exactly
+    "corrupt-ingest": ("ingest/part-00001.parquet", "ingest/part-00002.parquet"),
 }
 
 # scenarios that only make sense on a multi-device mesh (the lane
@@ -107,6 +130,7 @@ EXPECT_FLIGHT = {
              ("backend_failover", "drift_detector/*")),
     "hang-collective": (("timeout_escalation", "drift_detector/*"),
                         ("node_abandoned", "drift_detector/*")),
+    "corrupt-ingest": (),  # a quarantined part is degradation, not a postmortem
 }
 
 
@@ -138,22 +162,30 @@ def tree_hash(root) -> str:
     return h.hexdigest()
 
 
-def synthetic_config(workdir: str) -> dict:
+def synthetic_config(workdir: str, parts: int = 1) -> dict:
     """A small self-contained config whose node set covers every scenario
-    site (stats fan-out, quality spine, drift)."""
+    site (stats fan-out, quality spine, drift).  ``parts`` splits the
+    same 1500 rows into N part files (the corrupt-ingest scenario needs
+    real part-file granularity to quarantine)."""
     import numpy as np
     import pandas as pd
 
-    data = os.path.join(workdir, "data")
+    data = os.path.join(workdir, "data" if parts == 1 else f"data{parts}")
     if not os.path.isdir(data):
         os.makedirs(data)
         rng = np.random.default_rng(7)
-        pd.DataFrame({
+        df = pd.DataFrame({
             "age": rng.normal(40, 9, 1500).round(1),
             "fnlwgt": rng.normal(2e5, 4e4, 1500).round(0),
             "workclass": rng.choice(["private", "gov", "self"], 1500),
             "income": rng.choice(["<=50K", ">50K"], 1500),
-        }).to_parquet(os.path.join(data, "part-0.parquet"), index=False)
+        })
+        if parts == 1:
+            df.to_parquet(os.path.join(data, "part-0.parquet"), index=False)
+        else:
+            for i, idx in enumerate(np.array_split(np.arange(len(df)), parts)):
+                df.iloc[idx].to_parquet(
+                    os.path.join(data, f"part-{i:05d}.parquet"), index=False)
     return {
         "input_dataset": {"read_dataset": {"file_path": data,
                                            "file_type": "parquet"}},
@@ -218,7 +250,8 @@ def run_scenario(scenario: str, workdir: str, config: dict = None,
                  spec: str = None, node_timeout: str = "5") -> dict:
     """Clean + chaos run, parity + counter checks.  Returns the result
     record (``ok`` plus per-check fields) without exiting."""
-    cfg = config if config is not None else synthetic_config(workdir)
+    cfg = config if config is not None else synthetic_config(
+        workdir, parts=SCENARIO_PARTS.get(scenario, 1))
     chaos_spec = spec if spec is not None else SCENARIOS[scenario]
     result = {"scenario": scenario, "spec": chaos_spec}
     if scenario in REQUIRE_MULTIDEV:
@@ -239,8 +272,11 @@ def run_scenario(scenario: str, workdir: str, config: dict = None,
     # legitimately slow node on a loaded box escalates, writes a flight
     # dump, and fails the clean_flightrec==0 assertion spuriously
     clean_timeout = str(max(float(node_timeout), 600.0))
-    _run_once(cfg, os.path.join(workdir, "clean"), "", clean_timeout)
+    clean_manifest = _run_once(cfg, os.path.join(workdir, "clean"), "", clean_timeout)
     result["clean_wall_s"] = round(time.monotonic() - t0, 3)
+    result["clean_quarantined_parts"] = (
+        ((clean_manifest.get("resilience") or {}).get("quarantine") or {})
+        .get("parts", 0))
     golden = tree_hash(os.path.join(workdir, "clean"))
     clean_dumps = flight_dumps(os.path.join(workdir, "clean"))
     result["clean_flightrec"] = len(clean_dumps)
@@ -265,8 +301,41 @@ def run_scenario(scenario: str, workdir: str, config: dict = None,
     result["parity"] = True if expected_degraded else chaos_hash == golden
     missing = [k for k in EXPECT.get(scenario, ()) if not res.get(k)]
     result["missing_counters"] = missing
-    result["degraded"] = res.get("degraded", [])
-    degraded_ok = (sorted(result["degraded"]) == expected_degraded)
+    # scheduler-degraded nodes UNION data-plane degradations (quarantined
+    # parts, best-effort fallbacks) — the registry names both
+    result["degraded"] = sorted(
+        set(res.get("degraded") or [])
+        | set((res.get("degraded_sections") or {}).keys()))
+    degraded_ok = (result["degraded"] == expected_degraded)
+    # the data-plane gate: exact quarantine manifest contents (both the
+    # manifest's resilience section and the crash-safe on-disk copy), and
+    # zero quarantines on the clean leg
+    quar = res.get("quarantine") or {}
+    result["quarantined_parts"] = quar.get("parts", 0)
+    result["quarantine_rows"] = quar.get("rows_lost", 0)
+    quarantine_ok = result["clean_quarantined_parts"] == 0
+    expected_q = EXPECT_QUARANTINE.get(scenario)
+    if expected_q is not None:
+        got = {os.path.basename(r["file"]): r["rows_lost"]
+               for r in quar.get("records", [])}
+        result["quarantine_records"] = got
+        if got != expected_q:
+            quarantine_ok = False
+        import glob as _glob
+
+        on_disk = _glob.glob(os.path.join(
+            workdir, "chaos", "**", "quarantine_manifest.json"), recursive=True)
+        if not on_disk:
+            quarantine_ok = False
+            result["quarantine_manifest_missing"] = True
+        else:
+            with open(on_disk[0]) as f:
+                disk_doc = json.load(f)
+            disk_got = {os.path.basename(r["file"]): r["rows_lost"]
+                        for r in disk_doc.get("records", [])}
+            if disk_got != expected_q:
+                quarantine_ok = False
+                result["quarantine_disk_records"] = disk_got
     # the "no wedged rendezvous lane" assertion: an abandoned collective
     # must not stall the rest of the run — the chaos wall stays within a
     # bounded multiple of the clean wall, nowhere near the 600s hang
@@ -307,12 +376,19 @@ def run_scenario(scenario: str, workdir: str, config: dict = None,
         result["flightrec_lanes_ok"] = lanes_ok
     result["ok"] = bool(
         result["parity"] and not missing and degraded_ok and bounded_ok
+        and quarantine_ok
         and result["injections"] > 0 and not flight_missing and lanes_ok
         and result["clean_flightrec"] == 0)
     if not result["ok"] and "error" not in result:
         reasons = []
         if not result["parity"]:
             reasons.append("artifact tree differs from the clean golden run")
+        if not quarantine_ok:
+            reasons.append(
+                "quarantine gate failed: expected "
+                f"{EXPECT_QUARANTINE.get(scenario)} got "
+                f"{result.get('quarantine_records')} (clean leg quarantined "
+                f"{result['clean_quarantined_parts']} part(s))")
         if missing:
             reasons.append(f"expected recovery counters missing: {missing}")
         if not degraded_ok:

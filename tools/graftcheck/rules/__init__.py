@@ -12,4 +12,5 @@ from tools.graftcheck.rules import (  # noqa: F401  (import = registration)
     gc009_swallowed_exception,
     gc010_unattributed_dispatch,
     gc011_collective_placement,
+    gc012_unguarded_io,
 )

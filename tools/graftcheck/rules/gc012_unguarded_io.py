@@ -1,0 +1,175 @@
+"""GC012 — unguarded host I/O in node-reachable code.
+
+The hardened data plane (``anovos_tpu/data_ingest/guard.py``) makes every
+part-file decode a guarded operation: retried per policy, quarantined on
+exhaustion, schema-reconciled, value-sanitized, chaos-injectable at the
+``io:<path>`` sites.  That contract dies the day someone adds a direct
+``pd.read_parquet`` / ``pyarrow.csv.read_csv`` / read-mode ``open()`` on
+a path reachable from a scheduler node body: one truncated footer there
+and the run is back to crashing, with no quarantine record, invisible to
+the chaos harness.
+
+This rule keeps host reads routed through the guard in the code the
+scheduler can reach:
+
+* **scan scope** — the ingest layer itself (``anovos_tpu/data_ingest/``,
+  ``anovos_tpu/ops/streaming.py`` — every function there is reachable
+  from node bodies via ``read_dataset``/``describe_streaming``,
+  including import-time module level), plus any file that REGISTERS
+  scheduler nodes (``pipe.spine``/``pipe.fanout``/``sched.add`` — there
+  the registration bodies and their same-file callees one level deep
+  are checked, the GC006/GC008 reachability model);
+* **flagged calls** — read-mode ``open()``/``gzip.open()`` (write/append
+  modes pass: the artifact-capture hook owns those) and the decode
+  entry points ``read_parquet`` / ``read_csv`` / ``read_json`` /
+  ``read_table`` / ``read_schema`` / ``read_metadata`` / ``read_avro`` /
+  ``ParquetFile``;
+* **exempt** — the guard module itself, and any code inside a function
+  carrying the ``@raw_reader`` decorator (``guard.raw_reader``): the
+  DESIGNATED raw decoders the guard wraps.  Anything else needs a
+  per-line ``# graftcheck: disable=GC012`` with a justifying comment or
+  a baseline entry.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from tools.graftcheck.jaxmodel import call_chain
+from tools.graftcheck.registry import FileContext, Rule, register
+from tools.graftcheck.rules.gc008_cache_key import _registration_bodies
+
+# attribute/function names whose call is a host DECODE of external bytes
+_READER_ATTRS = {
+    "read_parquet", "read_csv", "read_json", "read_table",
+    "read_schema", "read_metadata", "read_avro", "ParquetFile",
+}
+
+# whole modules whose every function is node-reachable ingest code
+_INGEST_PREFIXES = ("anovos_tpu/data_ingest/", "anovos_tpu/ops/streaming.py")
+
+# the guard layer itself (raw reads are its job)
+_GUARD_PATH = "anovos_tpu/data_ingest/guard.py"
+
+_MSG = (
+    "unguarded host read {what!r} in node-reachable code — route it "
+    "through data_ingest.guard.guarded_part_read (or mark the designated "
+    "raw decoder @raw_reader); a corrupt part here crashes the run with "
+    "no quarantine record"
+)
+
+
+def _is_raw_reader(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        name = dec.attr if isinstance(dec, ast.Attribute) else getattr(dec, "id", "")
+        if name == "raw_reader":
+            return True
+    return False
+
+
+def _read_mode_open(node: ast.Call) -> bool:
+    """True for ``open()``/``gzip.open()`` calls that READ (the default
+    mode, or a literal mode without w/a/x/+).  Non-literal modes count as
+    reads — unverifiable is unguarded."""
+    chain = call_chain(node)
+    if chain not in ("open", "gzip.open"):
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return True
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return not any(ch in mode.value for ch in "wax+")
+    return True
+
+
+def _flagged(call: ast.Call) -> str:
+    """The offending chain when ``call`` is a host read, else ''."""
+    if _read_mode_open(call):
+        return call_chain(call) or "open"
+    func = call.func
+    name = func.attr if isinstance(func, ast.Attribute) else getattr(func, "id", "")
+    if name in _READER_ATTRS:
+        return call_chain(call) or name
+    return ""
+
+
+def _inside_raw_reader(ctx: FileContext, node: ast.AST) -> bool:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)) and _is_raw_reader(anc):
+            return True
+    return False
+
+
+def _inside_guarded_lambda(ctx: FileContext, node: ast.AST) -> bool:
+    """True when the read sits in a lambda handed straight to
+    ``guarded_part_read`` — THE guarded idiom
+    (``guard.guarded_part_read(f, lambda: raw_decode(f))``)."""
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.Lambda):
+            parent = ctx.parent(anc)
+            if isinstance(parent, ast.Call):
+                func = parent.func
+                name = (func.attr if isinstance(func, ast.Attribute)
+                        else getattr(func, "id", ""))
+                if name == "guarded_part_read":
+                    return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break  # a lambda outside the enclosing def is out of reach
+    return False
+
+
+@register
+class UnguardedHostIORule(Rule):
+    id = "GC012"
+    title = "host I/O reachable from scheduler nodes bypassing the ingest guard"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("anovos_tpu/") or "gc012" in relpath
+
+    def check(self, ctx: FileContext):
+        rel = ctx.relpath
+        if rel == _GUARD_PATH:
+            return
+        if rel.startswith(_INGEST_PREFIXES) or "gc012" in rel:
+            # the whole module (import-time included) is node-reachable
+            for call in ast.walk(ctx.tree):
+                if not isinstance(call, ast.Call):
+                    continue
+                what = _flagged(call)
+                if what and not _inside_raw_reader(ctx, call) \
+                        and not _inside_guarded_lambda(ctx, call):
+                    yield ctx.finding(self.id, call, _MSG.format(what=what))
+            return
+        # registration files: node bodies + same-file callees one level deep
+        bodies = list(_registration_bodies(ctx))
+        if not bodies:
+            return
+        defs = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                defs.setdefault(node.name, node)
+        scope: Set[ast.AST] = set()
+        for _name, body in bodies:
+            scope.add(body)
+            for sub in ast.walk(body):
+                if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                        and sub.func.id in defs):
+                    scope.add(defs[sub.func.id])
+        reported: Set[int] = set()
+        for fn in sorted(scope, key=lambda n: n.lineno):
+            if _is_raw_reader(fn):
+                continue
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call) or id(call) in reported:
+                    continue
+                what = _flagged(call)
+                if what and not _inside_raw_reader(ctx, call) \
+                        and not _inside_guarded_lambda(ctx, call):
+                    reported.add(id(call))
+                    yield ctx.finding(self.id, call, _MSG.format(what=what))
