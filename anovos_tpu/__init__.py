@@ -19,6 +19,7 @@ the same YAML top-level keys):
 - ``drift_stability``   drift_detector, stability
 - ``data_transformer``  transformers, datetime, geospatial
 - ``data_report``       report_preprocessing + report generation (host-side)
+- ``serving``           versioned feature bundles + the online feature server
 - ``models``            JAX/flax models (autoencoder latent features, ...)
 - ``feature_recommender`` / ``feature_store``
 """

@@ -5,6 +5,18 @@ transformer persists its parameters under ``model_path/<name>`` and can be
 re-applied with ``pre_existing_model=True``.  Artifacts are parquet (cutoffs,
 scaler stats) or CSV (encoders) directories like the reference's, written
 via pandas/pyarrow.
+
+``load_model_df`` memoizes parsed model frames behind a stat-signature
+check (path + size + mtime_ns of every part file): the batch pipeline loads
+each model at most a handful of times, but the online-serving apply path
+(``anovos_tpu.serving``) re-applies the same fitted models on every request
+batch — without the cache each micro-batch would pay one parquet/CSV read
+per transformer on the hot path.  A rewritten artifact re-stamps its files,
+invalidating the entry; callers receive a fresh DataFrame each call, so
+column-level mutation cannot poison the cache.  CAVEAT: ``copy()`` does
+not deep-copy the Python objects INSIDE object cells (e.g. binning's
+``parameters`` lists) — callers must not mutate cell contents in place
+(existing consumers all copy first, e.g. ``list(r["parameters"])``).
 """
 
 from __future__ import annotations
@@ -12,9 +24,14 @@ from __future__ import annotations
 import glob
 import os
 import shutil
-from typing import Optional
+import threading
+from typing import Dict, Optional, Tuple
 
 import pandas as pd
+
+_CACHE_LOCK = threading.Lock()
+_CACHE: Dict[Tuple[str, str], Tuple[Tuple[Tuple[str, int, int], ...], pd.DataFrame]] = {}
+_CACHE_MAX = 256  # model tables are tiny; bound is a leak guard, not a budget
 
 
 def save_model_df(df: pd.DataFrame, model_path: str, name: str, fmt: str = "parquet") -> None:
@@ -28,17 +45,45 @@ def save_model_df(df: pd.DataFrame, model_path: str, name: str, fmt: str = "parq
         df.to_csv(os.path.join(path, "part-00000.csv"), index=False)
 
 
-def load_model_df(model_path: str, name: str, fmt: str = "parquet") -> pd.DataFrame:
-    path = os.path.join(model_path, name)
-    if fmt == "parquet":
-        files = sorted(glob.glob(os.path.join(path, "*.parquet")))
-        if not files and os.path.isfile(path):
-            files = [path]
-        return pd.concat([pd.read_parquet(f) for f in files], ignore_index=True)
-    files = sorted(glob.glob(os.path.join(path, "*.csv")))
+def _part_files(path: str, fmt: str) -> list:
+    files = sorted(glob.glob(os.path.join(path, "*." + ("parquet" if fmt == "parquet" else "csv"))))
     if not files and os.path.isfile(path):
         files = [path]
-    # dtype=str: category values like "01" or "1" must round-trip verbatim —
-    # pandas numeric inference would mangle them and break vocab matching on
-    # pre_existing_model re-apply; callers cast numeric columns themselves.
-    return pd.concat([pd.read_csv(f, dtype=str) for f in files], ignore_index=True)
+    return files
+
+
+def _stat_sig(files) -> Optional[Tuple[Tuple[str, int, int], ...]]:
+    out = []
+    try:
+        for f in files:
+            st = os.stat(f)
+            out.append((f, st.st_size, st.st_mtime_ns))
+    except OSError:
+        return None
+    return tuple(out)
+
+
+def load_model_df(model_path: str, name: str, fmt: str = "parquet") -> pd.DataFrame:
+    path = os.path.join(model_path, name)
+    files = _part_files(path, fmt)
+    key = (os.path.abspath(path), fmt)
+    sig = _stat_sig(files)
+    if sig is not None:
+        with _CACHE_LOCK:
+            hit = _CACHE.get(key)
+            if hit is not None and hit[0] == sig:
+                return hit[1].copy()
+    if fmt == "parquet":
+        df = pd.concat([pd.read_parquet(f) for f in files], ignore_index=True)
+    else:
+        # dtype=str: category values like "01" or "1" must round-trip
+        # verbatim — pandas numeric inference would mangle them and break
+        # vocab matching on pre_existing_model re-apply; callers cast
+        # numeric columns themselves.
+        df = pd.concat([pd.read_csv(f, dtype=str) for f in files], ignore_index=True)
+    if sig is not None:
+        with _CACHE_LOCK:
+            if len(_CACHE) >= _CACHE_MAX:
+                _CACHE.clear()
+            _CACHE[key] = (sig, df.copy())
+    return df

@@ -132,6 +132,11 @@ __all__ = [
     "expression_parser",
     "autoencoder_latentFeatures",
     "PCA_latentFeatures",
+    # serving-state export (anovos_tpu.serving rides these)
+    "SERVABLE_TRANSFORMERS",
+    "FittedTransformer",
+    "fitted_state",
+    "from_state",
 ]
 
 
@@ -505,7 +510,12 @@ def cat_to_num_supervised(
     if not cols:
         warnings.warn("No Categorical Encoding - No categorical column(s) to transform")
         return idf
-    y, ym = _event_vector(idf, label_col, event_label)
+    # the event vector is FIT-time state only: the pre-existing-model path
+    # applies the persisted rate maps and must not require the label column
+    # (serving requests carry features, never labels)
+    y = ym = None
+    if not pre_existing_model:
+        y, ym = _event_vector(idf, label_col, event_label)
     new_cols: "OrderedDict[str, Column]" = OrderedDict()
     model_rows: Dict[str, pd.DataFrame] = {}
     for c in cols:
@@ -955,6 +965,24 @@ def _ks_vs_normal_jit(X: jax.Array, M: jax.Array, cp: bool = False) -> jax.Array
     return jnp.where(valid, dev, 0.0).max(axis=0)
 
 
+def _boxcox_fit_lambdas(X: jax.Array, M: jax.Array, ncols: int) -> np.ndarray:
+    """Grid-search λ per column by KS distance to a normal — the fit half
+    of :func:`boxcox_transformation`, extracted so ``fitted_state`` can
+    export the selected λs without re-deriving the search."""
+    best_ks = np.full(ncols, np.inf)
+    lam = np.ones(ncols)
+    for lmb in _BOXCOX_LAMBDAS:
+        # score with the SAME transform that apply uses, so the selected λ
+        # is the one actually emitted
+        Y = jnp.log(X) if lmb == 0.0 else jnp.sign(X) * jnp.abs(X) ** lmb
+        ok = M & jnp.isfinite(Y)
+        ks = np.asarray(_ks_vs_normal(jnp.where(ok, Y, 0.0), ok))[:ncols]
+        better = ks < best_ks
+        lam = np.where(better, lmb, lam)
+        best_ks = np.where(better, ks, best_ks)
+    return lam
+
+
 def boxcox_transformation(
     idf: Table,
     list_of_cols="all",
@@ -977,17 +1005,7 @@ def boxcox_transformation(
         else:
             lam = np.array([float(v) for v in boxcox_lambda])
     else:
-        best_ks = np.full(len(cols), np.inf)
-        lam = np.ones(len(cols))
-        for lmb in _BOXCOX_LAMBDAS:
-            # score with the SAME transform that apply uses below, so the
-            # selected λ is the one actually emitted
-            Y = jnp.log(X) if lmb == 0.0 else jnp.sign(X) * jnp.abs(X) ** lmb
-            ok = M & jnp.isfinite(Y)
-            ks = np.asarray(_ks_vs_normal(jnp.where(ok, Y, 0.0), ok))[: len(cols)]
-            better = ks < best_ks
-            lam = np.where(better, lmb, lam)
-            best_ks = np.where(better, ks, best_ks)
+        lam = _boxcox_fit_lambdas(X, M, len(cols))
     # λ=1 (identity) on the dead bucketed lanes keeps them finite
     lam_d = jnp.asarray(pad_lane_params(lam, X.shape[1], fill=1.0), jnp.float32)[None, :]
     Y = jnp.where(lam_d == 0.0, jnp.log(X), jnp.sign(X) * jnp.abs(X) ** lam_d)
@@ -1183,6 +1201,251 @@ def expression_parser(idf: Table, list_of_expr, postfix: str = "", print_impact:
     if print_impact:
         logger.info(f"expressions added: {list_of_expr}")
     return odf
+
+
+# ----------------------------------------------------------------------
+# serving-state export: fitted_state() / from_state()
+# ----------------------------------------------------------------------
+# The online-serving subsystem (anovos_tpu.serving) needs every fitted
+# transformer's state as a portable, JSON-able document: binning edges,
+# scaler params, boxcox λs, encoder vocab maps, imputer fills, outlier
+# keep-sets.  The round-trip contract is byte-exactness: ``from_state``
+# APPLIES THROUGH THE BATCH FUNCTIONS THEMSELVES (their pre-existing-model
+# branches, with the state materialized back into the exact model-artifact
+# format ``model_io`` persists), so a served apply replays the very same
+# jitted programs as a batch re-apply — parity is by construction, and
+# tests/test_serving.py pins it byte-identically per family.
+
+SERVABLE_TRANSFORMERS = (
+    "attribute_binning",
+    "z_standardization",
+    "IQR_standardization",
+    "normalization",
+    "imputation_MMM",
+    "cat_to_num_unsupervised",
+    "cat_to_num_supervised",
+    "outlier_categories",
+    "boxcox_transformation",
+    "feature_transformation",
+)
+
+# model-artifact format each family persists through model_io (None =
+# stateless or exported directly, no on-disk model round-trip needed)
+_STATE_MODEL_FMT = {
+    "attribute_binning": "parquet",
+    "z_standardization": "parquet",
+    "IQR_standardization": "parquet",
+    "normalization": "parquet",
+    "imputation_MMM": "parquet",
+    "cat_to_num_unsupervised": "csv",
+    "cat_to_num_supervised": "csv",
+    "outlier_categories": "csv",
+    "boxcox_transformation": None,
+    "feature_transformation": None,
+}
+
+# config keys the APPLY path consumes — everything else (bin counts,
+# index orders, coverage thresholds, label columns' event values …) is
+# fit-time material and deliberately absent from the exported state
+_STATE_APPLY_KEYS = {
+    "attribute_binning": ("bin_dtype", "output_mode"),
+    "z_standardization": ("output_mode",),
+    "IQR_standardization": ("output_mode",),
+    "normalization": ("output_mode",),
+    "imputation_MMM": ("method_type", "output_mode"),
+    "cat_to_num_unsupervised": ("method_type", "cardinality_threshold", "output_mode"),
+    "cat_to_num_supervised": ("label_col", "output_mode"),
+    "outlier_categories": ("output_mode",),
+    "boxcox_transformation": ("output_mode",),
+    "feature_transformation": ("method_type", "N", "output_mode"),
+}
+
+STATE_VERSION = 1
+
+
+def _jsonable(v):
+    """Recursive numpy→python coercion so states json.dumps cleanly and
+    floats round-trip bit-exactly (Python json preserves float64)."""
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, np.bool_):
+        return bool(v)
+    if isinstance(v, np.ndarray):
+        return [_jsonable(x) for x in v.tolist()]
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return str(v)
+
+
+def _read_model_tables(model_dir: str, fmt: str) -> Dict[str, dict]:
+    """Every model table under ``model_dir`` as columnar JSON-able dicts,
+    keyed by the model name (relative dir) ``save_model_df`` wrote it as.
+    CSV tables read ``dtype=str`` — the same verbatim-string discipline as
+    ``load_model_df`` — so category values like ``"01"`` survive."""
+    tables: Dict[str, dict] = {}
+    for dirpath, _dirs, files in sorted(os.walk(model_dir)):
+        parts = sorted(f for f in files if f.endswith("." + fmt))
+        if not parts:
+            continue
+        frames = [
+            pd.read_parquet(os.path.join(dirpath, f)) if fmt == "parquet"
+            else pd.read_csv(os.path.join(dirpath, f), dtype=str)
+            for f in parts
+        ]
+        df = pd.concat(frames, ignore_index=True)
+        rel = os.path.relpath(dirpath, model_dir).replace(os.sep, "/")
+        tables[rel] = {c: _jsonable(df[c].tolist()) for c in df.columns}
+    return tables
+
+
+def fitted_state(idf: Table, name: str, config: Optional[dict] = None) -> dict:
+    """Fit transformer ``name`` on ``idf`` under ``config`` and export its
+    complete apply-time state as a JSON-able document.
+
+    The fit runs through the batch function itself (persisting its model
+    artifact into a scratch dir, then lifting the artifact verbatim into
+    the state), so the exported parameters are EXACTLY what a batch
+    ``pre_existing_model=True`` re-apply would read."""
+    import tempfile
+
+    if name not in SERVABLE_TRANSFORMERS:
+        raise ValueError(
+            f"{name!r} is not a servable transformer (one of {SERVABLE_TRANSFORMERS})")
+    config = dict(config or {})
+    config.pop("pre_existing_model", None)
+    config.pop("model_path", None)
+    config.setdefault("print_impact", False)
+    apply_config = {k: config[k] for k in _STATE_APPLY_KEYS[name] if k in config}
+    state = {
+        "state_version": STATE_VERSION,
+        "family": name,
+        "apply_config": _jsonable(apply_config),
+    }
+    list_of_cols = config.get("list_of_cols", "all")
+    drop_cols = config.get("drop_cols", [])
+
+    if name == "feature_transformation":
+        state["cols"] = _num_cols_of(idf, list_of_cols, drop_cols)
+        state["model"] = None
+        return state
+    if name == "boxcox_transformation":
+        cols = _num_cols_of(idf, list_of_cols, drop_cols)
+        given = config.get("boxcox_lambda")
+        if given is not None:
+            lam = (np.full(len(cols), float(given))
+                   if isinstance(given, (int, float))
+                   else np.array([float(v) for v in given]))
+        else:
+            X, M = idf.numeric_block(cols)
+            lam = _boxcox_fit_lambdas(X, M, len(cols))
+        state["cols"] = cols
+        state["model"] = {"fmt": None, "tables": {
+            "boxcox_lambda": {"attribute": cols,
+                              "lambda": [float(v) for v in lam]}}}
+        return state
+
+    fmt = _STATE_MODEL_FMT[name]
+    fn = globals()[name]
+    with tempfile.TemporaryDirectory(prefix="anovos_fitstate_") as mp:
+        fn(idf, **{**config, "model_path": mp})
+        tables = _read_model_tables(mp, fmt)
+    if not tables:
+        raise ValueError(
+            f"{name} fitted no state on this table (no applicable columns?)")
+    state["model"] = {"fmt": fmt, "tables": tables}
+    if name == "cat_to_num_supervised":
+        # per-column model dirs: recover the fit-order column list from the
+        # same resolution the fit used
+        state["cols"] = _cat_cols_of(
+            idf, list_of_cols, drop_cols,
+            extra_drop=[config.get("label_col", "label")])
+    else:
+        main = tables[name]
+        cols = list(dict.fromkeys(main["attribute"]))
+        if name == "imputation_MMM":
+            # the fit resolves "missing" in table-column order but persists
+            # fills num-block-first; re-applying must walk the fit's own
+            # order or append-mode column order drifts
+            in_table = [c for c in idf.col_names if c in set(cols)]
+            cols = in_table + [c for c in cols if c not in set(in_table)]
+        state["cols"] = cols
+    return state
+
+
+class FittedTransformer:
+    """One transformer's apply-only form, rebuilt from a ``fitted_state``
+    document.  ``apply`` routes through the batch function's pre-existing-
+    model branch over a model dir materialized ONCE at construction, so a
+    served apply and a batch re-apply execute identical code."""
+
+    def __init__(self, state: dict):
+        import tempfile
+
+        if state.get("state_version") != STATE_VERSION:
+            raise ValueError(
+                f"fitted_state version {state.get('state_version')!r} != "
+                f"supported {STATE_VERSION}")
+        self.family: str = state["family"]
+        if self.family not in SERVABLE_TRANSFORMERS:
+            raise ValueError(f"unknown transformer family {self.family!r}")
+        self.cols: List[str] = list(state["cols"])
+        self.apply_config: dict = dict(state.get("apply_config") or {})
+        self._lambdas: Optional[List[float]] = None
+        self._model_tmp = None
+        model = state.get("model")
+        if self.family == "boxcox_transformation":
+            tab = model["tables"]["boxcox_lambda"]
+            by_col = dict(zip(tab["attribute"], tab["lambda"]))
+            self._lambdas = [float(by_col[c]) for c in self.cols]
+        elif model is not None:
+            # materialize the model artifact exactly as the fit persisted it
+            self._model_tmp = tempfile.TemporaryDirectory(
+                prefix=f"anovos_serve_{self.family}_")
+            fmt = model["fmt"]
+            for rel, columns in model["tables"].items():
+                save_model_df(pd.DataFrame(dict(columns)),
+                              self._model_tmp.name, rel, fmt=fmt)
+
+    @property
+    def model_dir(self) -> Optional[str]:
+        return self._model_tmp.name if self._model_tmp is not None else None
+
+    def apply(self, idf: Table) -> Table:
+        cfg = self.apply_config
+        out_mode = cfg.get("output_mode", "replace")
+        if self.family == "feature_transformation":
+            return feature_transformation(
+                idf, self.cols, method_type=cfg.get("method_type", "sqrt"),
+                N=cfg.get("N"), output_mode=out_mode)
+        if self.family == "boxcox_transformation":
+            return boxcox_transformation(
+                idf, self.cols, boxcox_lambda=self._lambdas,
+                output_mode=out_mode)
+        fn = globals()[self.family]
+        kwargs = {"pre_existing_model": True, "model_path": self.model_dir,
+                  "output_mode": out_mode}
+        if self.family == "attribute_binning":
+            kwargs["bin_dtype"] = cfg.get("bin_dtype", "numerical")
+        elif self.family == "imputation_MMM":
+            kwargs["method_type"] = cfg.get("method_type", "median")
+        elif self.family == "cat_to_num_unsupervised":
+            kwargs["method_type"] = cfg.get("method_type", "label_encoding")
+            if "cardinality_threshold" in cfg:
+                kwargs["cardinality_threshold"] = cfg["cardinality_threshold"]
+        elif self.family == "cat_to_num_supervised":
+            kwargs["label_col"] = cfg.get("label_col", "label")
+        return fn(idf, self.cols, **kwargs)
+
+
+def from_state(state: dict) -> FittedTransformer:
+    """Rebuild the apply-only transformer from a ``fitted_state`` doc."""
+    return FittedTransformer(state)
 
 
 # model-based imputers and latent-feature transformers live in sibling

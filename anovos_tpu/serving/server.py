@@ -1,0 +1,426 @@
+"""The online feature server: micro-batching request loop over a bundle.
+
+Request lifecycle:
+
+1. **validate + coerce** (client thread, before anything is enqueued):
+   the payload must carry exactly the bundle's required input columns
+   with equal-length value lists; numeric columns accept numbers/null,
+   categorical columns strings/null, timestamps ISO strings.  Schema
+   drift (unknown/missing columns), wrong dtypes, and hostile values
+   (±inf and finite floats beyond the f32 range — the PR 10 sanitize
+   policy's overflow class, applied at the request boundary) all return
+   a STRUCTURED per-request error ("quarantine response") immediately:
+   a hostile request can neither poison a shared micro-batch nor crash
+   the server, and every rejection books
+   ``serve_requests_quarantined_total{reason}``.
+2. **micro-batch**: accepted requests queue; the batcher thread drains
+   up to ``ANOVOS_SERVE_MAX_BATCH`` rows or ``ANOVOS_SERVE_BATCH_WINDOW_MS``
+   of accumulation, concatenates the frames, and pads the batch onto the
+   serving row buckets (``ApplyProgram.pad_frame``) so every width hits
+   a pre-compiled executable.
+3. **apply**: one fused pass through the bundle's transformer chain,
+   wrapped in a tracer span and a ``devprof.node_bracket`` (dispatch
+   attribution on the apply path; the chaos site ``serve:apply`` sits
+   inside the bracket for the ``serve-fault`` scenario).  A failed apply
+   retries once — an injected transient must not fail real requests —
+   and a second failure is FATAL for the batch: a flight-recorder
+   postmortem (trigger ``serve_fatal``) is dumped synchronously, every
+   request in the batch gets a structured error, and the loop keeps
+   serving subsequent batches.
+4. **respond**: per-request row slices serialize back to JSON-able
+   columnar payloads; per-request wall books into
+   ``serve_request_seconds`` and the bounded latency ring that ``stats()``
+   summarizes as p50/p99/QPS.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from anovos_tpu.serving.program import ApplyProgram
+
+logger = logging.getLogger("anovos_tpu.serving.server")
+
+__all__ = ["FeatureServer", "coerce_payload", "frame_to_payload"]
+
+# the device numeric plane is f32 (data_ingest.guard's sanitize contract):
+# any finite float beyond this becomes ±inf on upload
+_F32_MAX = float(np.finfo(np.float32).max)
+_LATENCY_RING = 8192
+
+
+def _error(code: str, detail: str, **extra) -> dict:
+    return {"error": {"code": code, "detail": detail, **extra}}
+
+
+def coerce_payload(input_columns: List[dict], payload: dict,
+                   max_rows: int) -> Tuple[Optional[pd.DataFrame], Optional[dict]]:
+    """Validate one request payload against the bundle schema and coerce
+    it to the canonical frame dtypes (numeric→float64, cat→object str,
+    ts→datetime64).  Returns ``(frame, None)`` or ``(None, error)`` —
+    the error dict IS the response (a per-request quarantine, mirroring
+    the PR 10 ingest policy at this boundary)."""
+    if not isinstance(payload, dict) or not isinstance(payload.get("columns"), dict):
+        return None, _error("bad_request",
+                            'payload must be {"columns": {name: [values...]}}')
+    cols = payload["columns"]
+    schema = {c["name"]: c for c in input_columns}
+    unknown = sorted(set(cols) - set(schema))
+    missing = sorted(set(schema) - set(cols))
+    if unknown or missing:
+        return None, _error(
+            "schema_drift",
+            "request columns do not match the bundle schema",
+            unknown_columns=unknown, missing_columns=missing)
+    lengths = {len(v) for v in cols.values() if isinstance(v, (list, tuple))}
+    if any(not isinstance(v, (list, tuple)) for v in cols.values()):
+        return None, _error("bad_request", "column values must be lists")
+    if len(lengths) != 1:
+        return None, _error("bad_shape",
+                            f"column lengths disagree: {sorted(lengths)}")
+    n = lengths.pop()
+    if not (1 <= n <= max_rows):
+        return None, _error("bad_shape",
+                            f"rows must be 1..{max_rows}, got {n}")
+    data: Dict[str, object] = {}
+    hostile: Dict[str, dict] = {}
+    for name in (c["name"] for c in input_columns):
+        spec = schema[name]
+        vals = cols[name]
+        if spec["kind"] == "cat":
+            bad = [v for v in vals if v is not None and not isinstance(v, str)]
+            if bad:
+                return None, _error(
+                    "wrong_dtype",
+                    f"column {name!r} is categorical: values must be "
+                    f"strings or null (got e.g. {bad[0]!r})", column=name)
+            data[name] = np.array(
+                [v if v is not None else None for v in vals], dtype=object)
+        elif spec["kind"] == "ts":
+            # ISO strings or null ONLY — pd.to_datetime would otherwise
+            # silently read bare numbers as epoch-nanosecond instants
+            bad = [v for v in vals if v is not None and not isinstance(v, str)]
+            if bad:
+                return None, _error(
+                    "wrong_dtype",
+                    f"column {name!r} is a timestamp: values must be ISO "
+                    f"strings or null (got e.g. {bad[0]!r})", column=name)
+            try:
+                data[name] = pd.to_datetime(pd.Series(vals), errors="raise",
+                                            utc=False).to_numpy()
+            except Exception as e:
+                return None, _error(
+                    "wrong_dtype",
+                    f"column {name!r} is a timestamp: {e}", column=name)
+        else:
+            bad = [v for v in vals
+                   if v is not None
+                   and not (isinstance(v, (int, float)) and not isinstance(v, bool))]
+            if bad:
+                return None, _error(
+                    "wrong_dtype",
+                    f"column {name!r} is numeric: values must be numbers "
+                    f"or null (got e.g. {bad[0]!r})", column=name)
+            arr = np.array([np.nan if v is None else float(v) for v in vals],
+                           dtype=np.float64)
+            pos = int((arr == np.inf).sum())
+            neg = int((arr == -np.inf).sum())
+            over = int((np.isfinite(arr) & (np.abs(arr) > _F32_MAX)).sum())
+            if pos or neg or over:
+                hostile[name] = {"posinf": pos, "neginf": neg, "overflow": over}
+            data[name] = arr
+    if hostile:
+        # the sanitize policy at the request boundary: a value the decode
+        # guard would null/clip in batch ingest is a per-request refusal
+        # here — the caller is told exactly what was hostile, the batch
+        # queue never sees the rows
+        return None, _error(
+            "hostile_values",
+            "±inf / f32-overflow values refused at the request boundary "
+            "(data_ingest.guard sanitize policy)", columns=hostile)
+    return pd.DataFrame(data), None
+
+
+def frame_to_payload(df: pd.DataFrame) -> Dict[str, list]:
+    """Feature frame → JSON-able columnar payload (NaN/NaT → null)."""
+    out: Dict[str, list] = {}
+    for name in df.columns:
+        s = df[name]
+        if np.issubdtype(s.dtype, np.datetime64):
+            out[name] = [None if pd.isna(v) else pd.Timestamp(v).isoformat()
+                         for v in s]
+        elif s.dtype == object:
+            out[name] = [None if v is None or (isinstance(v, float) and math.isnan(v))
+                         else str(v) for v in s]
+        elif np.issubdtype(s.dtype, np.integer):
+            out[name] = [int(v) for v in s]
+        else:
+            out[name] = [None if not np.isfinite(v) else float(v) for v in s]
+    return out
+
+
+class _Pending:
+    __slots__ = ("frame", "rows", "event", "response", "t0")
+
+    def __init__(self, frame: pd.DataFrame, t0: float):
+        self.frame = frame
+        self.rows = len(frame)
+        self.event = threading.Event()
+        self.response: Optional[dict] = None
+        self.t0 = t0
+
+
+class FeatureServer:
+    """Threaded micro-batching server over one :class:`ApplyProgram`.
+
+    In-process transport: clients call :meth:`serve` from their own
+    threads (the CLI, bench's concurrent-client smoke load, and the
+    chaos gate all drive it this way); the batching/apply loop runs on
+    one background thread so device dispatch stays single-lane and
+    devprof's drain attribution is meaningful."""
+
+    def __init__(self, program: ApplyProgram,
+                 window_ms: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 obs_dir: Optional[str] = None):
+        self.program = program
+        self.window_s = float(
+            window_ms if window_ms is not None
+            else os.environ.get("ANOVOS_SERVE_BATCH_WINDOW_MS", "5")) / 1000.0
+        self.max_batch = int(
+            max_batch if max_batch is not None
+            else os.environ.get("ANOVOS_SERVE_MAX_BATCH", "256"))
+        self.obs_dir = obs_dir
+        self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        self._carry: Optional[_Pending] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._latencies = deque(maxlen=_LATENCY_RING)
+        self._lock = threading.Lock()
+        self._served = 0
+        self._quarantined = 0
+        self._failed = 0
+        self._t_started: Optional[float] = None
+        self.cold_start_s: Optional[float] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, warm: bool = True) -> "FeatureServer":
+        """Arm obs, AOT-compile the apply path per bucket, start the loop.
+
+        ``cold_start_s`` is the measured server-start wall: warm-up
+        (bounded by the persistent XLA compile cache) through the first
+        live response."""
+        t0 = time.perf_counter()
+        if self.obs_dir:
+            from anovos_tpu.obs import flight
+
+            if not flight.enabled():
+                flight.configure(os.path.join(self.obs_dir, "obs"))
+        if warm:
+            self.program.warm(self.max_batch)
+        self._stop.clear()
+        self._t_started = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._loop, name="anovos-serve-batcher", daemon=True)
+        self._thread.start()
+        if warm:
+            # the cold-start contract is start → FIRST RESPONSE: drive one
+            # live request through the whole queue/batch/apply/serialize path
+            first = self.serve({"columns": frame_to_payload(
+                self.program.synthetic_frame(1))})
+            if "error" in first:
+                raise RuntimeError(f"serving warm probe failed: {first['error']}")
+        self.cold_start_s = round(time.perf_counter() - t0, 3)
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    # -- client API ---------------------------------------------------------
+    def serve(self, payload: dict, timeout_s: float = 120.0) -> dict:
+        """One blocking request: validate, enqueue, await the batch."""
+        from anovos_tpu.obs import get_metrics
+
+        t0 = time.perf_counter()
+        frame, err = coerce_payload(self.program.input_columns, payload,
+                                    self.max_batch)
+        if err is not None:
+            with self._lock:
+                self._quarantined += 1
+            get_metrics().counter(
+                "serve_requests_quarantined_total",
+                "requests refused at the serving boundary with a structured "
+                "per-request error",
+            ).inc(reason=err["error"]["code"])
+            return err
+        pending = _Pending(frame, t0)
+        self._queue.put(pending)
+        if not pending.event.wait(timeout_s):
+            return _error("timeout", f"no response within {timeout_s}s")
+        return pending.response  # type: ignore[return-value]
+
+    # -- batching loop ------------------------------------------------------
+    def _next_batch(self) -> List[_Pending]:
+        batch: List[_Pending] = []
+        rows = 0
+        if self._carry is not None:
+            batch.append(self._carry)
+            rows = self._carry.rows
+            self._carry = None
+        while not batch:
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return []
+                continue
+            batch.append(first)
+            rows = first.rows
+        deadline = time.monotonic() + self.window_s
+        while rows < self.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if rows + nxt.rows > self.max_batch:
+                self._carry = nxt  # heads the next batch — never dropped
+                break
+            batch.append(nxt)
+            rows += nxt.rows
+        return batch
+
+    def _loop(self) -> None:
+        while not (self._stop.is_set() and self._queue.empty()
+                   and self._carry is None):
+            batch = self._next_batch()
+            if not batch:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                self._process(batch)
+            except Exception:  # the loop must outlive any batch
+                logger.exception("serving batch processing failed")
+                for p in batch:
+                    if p.response is None:
+                        p.response = _error("internal", "batch processing failed")
+                        p.event.set()
+
+    def _process(self, batch: List[_Pending]) -> None:
+        from anovos_tpu.obs import devprof, flight, get_metrics, get_tracer
+        from anovos_tpu.resilience.chaos import chaos_point
+
+        reg = get_metrics()
+        frames = [p.frame for p in batch]
+        n = sum(p.rows for p in batch)
+        big = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
+        bucket = self.program.bucket_rows(n, self.max_batch)
+        padded = self.program.pad_frame(big, bucket)
+        out: Optional[pd.DataFrame] = None
+        last: Optional[BaseException] = None
+        for attempt in (1, 2):
+            try:
+                with get_tracer().span("serving/apply", cat="serve",
+                                       rows=n, bucket=bucket,
+                                       requests=len(batch), attempt=attempt), \
+                        devprof.node_bracket("serving/apply"):
+                    chaos_point("serve:apply")
+                    out = self.program.apply_frame(padded)
+                break
+            except Exception as e:
+                last = e
+                logger.warning(
+                    "serving apply attempt %d failed (%s: %s) — %s",
+                    attempt, type(e).__name__, e,
+                    "retrying" if attempt == 1 else "batch is fatal")
+        reg.counter("serve_batches_total",
+                    "micro-batches dispatched through the apply program"
+                    ).inc()
+        reg.histogram("serve_batch_rows",
+                      "rows per dispatched micro-batch",
+                      buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+                      ).observe(n)
+        if out is None:
+            # FATAL for this batch: postmortem first (synchronous, crash-
+            # safe), then structured errors — the server keeps serving
+            with self._lock:
+                self._failed += 1
+            reg.counter(
+                "serve_batches_failed_total",
+                "micro-batches whose apply failed after retry (every request "
+                "got a structured error; a flight postmortem was dumped)",
+            ).inc()
+            flight.dump(
+                "serve_fatal", node="serving/apply",
+                extra={"error": f"{type(last).__name__}: {last}",
+                       "batch_rows": n, "requests": len(batch)})
+            now = time.perf_counter()
+            for p in batch:
+                p.response = _error(
+                    "apply_failed",
+                    f"feature apply failed after retry: "
+                    f"{type(last).__name__}: {str(last)[:300]}")
+                # failed requests COUNT toward the latency tail: a wedged
+                # apply that burns 60s before erroring is p99, and the
+                # serve-fault chaos gate's bounded-p99 check reads it here
+                with self._lock:
+                    self._latencies.append(now - p.t0)
+                reg.histogram("serve_request_seconds",
+                              "request wall from validation to response"
+                              ).observe(now - p.t0)
+                p.event.set()
+            return
+        offset = 0
+        now = time.perf_counter()
+        for p in batch:
+            part = out.iloc[offset:offset + p.rows].reset_index(drop=True)
+            offset += p.rows
+            p.response = {"rows": p.rows, "columns": frame_to_payload(part)}
+            latency = now - p.t0
+            with self._lock:
+                self._served += 1
+                self._latencies.append(latency)
+            reg.histogram("serve_request_seconds",
+                          "request wall from validation to response"
+                          ).observe(latency)
+            p.event.set()
+
+    # -- stats --------------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            lat = sorted(self._latencies)
+            served, quarantined, failed = self._served, self._quarantined, self._failed
+        elapsed = (time.monotonic() - self._t_started) if self._t_started else 0.0
+
+        def pct(p: float) -> Optional[float]:
+            if not lat:
+                return None
+            return round(lat[min(int(p * (len(lat) - 1)), len(lat) - 1)] * 1000, 3)
+
+        return {
+            "served": served,
+            "quarantined": quarantined,
+            "failed_batches": failed,
+            "p50_ms": pct(0.50),
+            "p99_ms": pct(0.99),
+            "qps": round(served / elapsed, 2) if elapsed > 0 else None,
+            "cold_start_s": self.cold_start_s,
+            "window_ms": self.window_s * 1000,
+            "max_batch": self.max_batch,
+        }

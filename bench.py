@@ -447,7 +447,50 @@ def e2e_cold_warm() -> dict:
             result.update(e2e_corrupt_ingest())
         except Exception as e:
             result["e2e_quarantine_error"] = str(e)[-200:]
+    if os.environ.get("BENCH_SERVE", "1") == "1":
+        try:
+            result.update(e2e_serving())
+        except Exception as e:  # serving section must never sink the headline
+            result["e2e_serve_error"] = str(e)[-200:]
     return result
+
+
+def e2e_serving() -> dict:
+    """Online-serving trajectory (anovos_tpu.serving, round 11): run the
+    ``python -m anovos_tpu.serving smoke`` concurrent-client load (4
+    client threads, mixed request widths 1..32 rows) in a fresh process —
+    so the measured cold start is a real process boot against the
+    persistent XLA compile cache — and lift sustained QPS, p50/p99
+    request latency, and cold-start wall into the round record.  A
+    parity failure or dead smoke lands as ``e2e_serve_error``."""
+    env = {**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS") or "cpu"}
+    for k in ("ANOVOS_TPU_CHAOS", "ANOVOS_TPU_CACHE", "XLA_FLAGS"):
+        env.pop(k, None)
+    p = subprocess.run(
+        [sys.executable, "-m", "anovos_tpu.serving", "smoke",
+         "--rows", "2000", "--clients", "4", "--requests", "25", "--json"],
+        capture_output=True, text=True, env=env, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    out: dict = {}
+    rec = _last_json_line(p.stdout)
+    if rec is None:
+        out["e2e_serve_error"] = (
+            f"serving smoke produced no result (rc={p.returncode}): "
+            + (p.stderr or p.stdout)[-160:])
+        return out
+    out["e2e_serve_qps"] = rec.get("serve_qps")
+    out["e2e_serve_p50_ms"] = rec.get("serve_p50_ms")
+    out["e2e_serve_p99_ms"] = rec.get("serve_p99_ms")
+    out["e2e_serve_cold_start_s"] = rec.get("serve_cold_start_s")
+    out["e2e_serve_requests"] = rec.get("serve_requests")
+    out["e2e_serve_parity"] = rec.get("serve_parity_ok")
+    if not rec.get("serve_parity_ok") or rec.get("serve_errors"):
+        out["e2e_serve_error"] = (
+            f"serving smoke gate failed: parity={rec.get('serve_parity_ok')} "
+            f"errors={rec.get('serve_errors')}")
+        print("bench: " + out["e2e_serve_error"], file=sys.stderr)
+    return out
 
 
 def e2e_chaos_recovery() -> dict:

@@ -252,6 +252,15 @@ def bench_e2e() -> dict:
         "chaos_failovers": r.get("e2e_chaos_failovers"),
         "chaos_parity": r.get("e2e_chaos_parity"),
         "chaos_error": r.get("e2e_chaos_error"),
+        # online serving (bench.e2e_serving, round 11): sustained QPS,
+        # request-latency tail, and the bounded cold start under the
+        # persistent XLA compile cache
+        "serve_qps": r.get("e2e_serve_qps"),
+        "serve_p50_ms": r.get("e2e_serve_p50_ms"),
+        "serve_p99_ms": r.get("e2e_serve_p99_ms"),
+        "serve_cold_start_s": r.get("e2e_serve_cold_start_s"),
+        "serve_parity": r.get("e2e_serve_parity"),
+        "serve_error": r.get("e2e_serve_error"),
     }
 
 

@@ -1,0 +1,40 @@
+"""GC013 negative fixture: pre-compiled programs + attributed syncs stay
+quiet."""
+
+import jax
+
+from anovos_tpu.obs import devprof, timed
+
+# module-level jitted program: compiled by warm(), replayed per request
+_apply_program = jax.jit(lambda x: x * 2.0)
+
+
+@jax.jit
+def _decorated_program(x):
+    return x + 1.0
+
+
+def apply_batch(x):
+    # dispatch through the pre-compiled executable, attributed by the
+    # node bracket on the apply path
+    with devprof.node_bracket("serving/apply"):
+        return _fetch(_apply_program(x))
+
+
+def _fetch(y):
+    # called by the bracketed apply path: attribution flows one level
+    return jax.device_get(y)
+
+
+@timed("serving.fetch_row")
+def fetch_row(y):
+    return jax.device_get(y)
+
+
+def bracketed_fetch(y):
+    with devprof.dispatch_bracket("serving.bracketed_fetch"):
+        return y.block_until_ready()
+
+
+def host_only(n):
+    return [i * 2 for i in range(n)]

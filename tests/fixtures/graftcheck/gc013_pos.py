@@ -1,0 +1,27 @@
+"""GC013 positive fixture: per-request jit tracing and unattributed
+host-sync in serving request-path code."""
+
+import functools
+
+import jax
+
+
+def handle_request(fn, x):
+    # a fresh jit wrapper per request: re-traces and re-compiles on the
+    # serving hot path
+    j = jax.jit(fn)  # graftcheck: disable=GC003
+    return j(x)
+
+
+def handle_partial(fn, x):
+    j = functools.partial(jax.jit, static_argnames=("k",))(fn)  # graftcheck: disable=GC003
+    return j(x, k=2)
+
+
+def fetch_features(y):
+    # host-blocking fetch with no timed()/devprof attribution
+    return jax.device_get(y)
+
+
+def wait_for_batch(y):
+    return y.block_until_ready()

@@ -1,0 +1,387 @@
+"""Serving subsystem tests (anovos_tpu.serving, round 11).
+
+The load-bearing contracts:
+
+* ``fitted_state()`` → JSON → ``from_state()`` → apply is BYTE-identical
+  to the batch transformer's own fit+apply, per family and for the full
+  demo chain — including across a CAS bundle round trip in a fresh
+  subprocess (the served model IS the batch model).
+* A bundle whose format version (or content) does not match refuses to
+  load — never a silently-misread model.
+* The server micro-batches concurrent mixed-width clients onto shape
+  buckets with response parity, refuses hostile payloads with
+  structured per-request errors while staying alive, and — after the
+  warm-up pass — serves requests with ZERO fresh XLA compiles.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from anovos_tpu.data_transformer import transformers as T  # noqa: E402
+from anovos_tpu.serving import (  # noqa: E402
+    ApplyProgram,
+    BundleVersionError,
+    FeatureServer,
+    coerce_payload,
+    fit_bundle,
+    frame_to_payload,
+    list_bundles,
+    load_bundle,
+    save_bundle,
+)
+from anovos_tpu.serving.demo import DEMO_CHAIN, demo_frame  # noqa: E402
+from anovos_tpu.shared.table import Table  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def fit_df():
+    return demo_frame(600, seed=7)
+
+
+@pytest.fixture(scope="module")
+def fit_table(fit_df):
+    return Table.from_pandas(fit_df)
+
+
+def _frames_equal(a: pd.DataFrame, b: pd.DataFrame) -> bool:
+    if list(a.columns) != list(b.columns) or len(a) != len(b):
+        return False
+    for c in a.columns:
+        na_a, na_b = a[c].isna(), b[c].isna()
+        if not (na_a == na_b).all():
+            return False
+        if not (a[c][~na_a].values == b[c][~na_b].values).all():
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# fitted_state / from_state round trip, per family
+# ---------------------------------------------------------------------------
+FAMILY_CASES = [
+    ("attribute_binning", {"list_of_cols": ["age", "hours"], "bin_size": 6}),
+    ("attribute_binning", {"list_of_cols": ["age"], "method_type": "equal_frequency",
+                           "bin_size": 4, "bin_dtype": "categorical",
+                           "output_mode": "append"}),
+    ("z_standardization", {"list_of_cols": ["age", "fnlwgt"]}),
+    ("IQR_standardization", {"list_of_cols": ["hours"]}),
+    ("normalization", {"list_of_cols": ["fnlwgt", "hours"], "output_mode": "append"}),
+    ("imputation_MMM", {"list_of_cols": ["age", "workclass"],
+                        "method_type": "median"}),
+    ("cat_to_num_unsupervised", {"list_of_cols": ["workclass", "education"],
+                                 "method_type": "label_encoding"}),
+    ("cat_to_num_supervised", {"list_of_cols": ["workclass"], "label_col": "label",
+                               "event_label": "1", "output_mode": "append"}),
+    ("outlier_categories", {"list_of_cols": ["education"], "coverage": 0.8,
+                            "max_category": 4}),
+    ("boxcox_transformation", {"list_of_cols": ["hours"]}),
+    ("feature_transformation", {"list_of_cols": ["hours"], "method_type": "sqrt",
+                                "output_mode": "append"}),
+]
+
+
+@pytest.mark.parametrize("family,cfg", FAMILY_CASES,
+                         ids=[f"{f}-{i}" for i, (f, _) in enumerate(FAMILY_CASES)])
+def test_family_roundtrip_byte_parity(fit_table, tmp_path, family, cfg):
+    """batch fit+apply ≡ fitted_state → JSON → from_state → apply."""
+    kwargs = dict(cfg)
+    if T._STATE_MODEL_FMT.get(family):
+        kwargs["model_path"] = str(tmp_path / "m")
+    batch = getattr(T, family)(fit_table, **kwargs).to_pandas()
+    state = json.loads(json.dumps(T.fitted_state(fit_table, family, cfg)))
+    served = T.from_state(state).apply(fit_table).to_pandas()
+    assert _frames_equal(batch, served), family
+
+
+def test_fitted_state_rejects_unknown_family(fit_table):
+    with pytest.raises(ValueError, match="not a servable"):
+        T.fitted_state(fit_table, "expression_parser", {})
+
+
+def test_from_state_rejects_version_mismatch(fit_table):
+    state = T.fitted_state(fit_table, "z_standardization",
+                           {"list_of_cols": ["age"]})
+    state["state_version"] = 99
+    with pytest.raises(ValueError, match="version"):
+        T.from_state(state)
+
+
+def test_supervised_apply_needs_no_label_column(fit_table):
+    """The pre-existing-model path must not require the label column —
+    serving requests carry features, never labels."""
+    state = T.fitted_state(
+        fit_table, "cat_to_num_supervised",
+        {"list_of_cols": ["workclass"], "label_col": "label", "event_label": "1"})
+    unlabeled = fit_table.drop(["label"])
+    out = T.from_state(state).apply(unlabeled)
+    assert "workclass" in out.col_names
+
+
+# ---------------------------------------------------------------------------
+# bundle: CAS round trip + version refusal
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def bundle_store(fit_table, tmp_path_factory):
+    cache = str(tmp_path_factory.mktemp("bundle_cas"))
+    bundle = fit_bundle(fit_table, DEMO_CHAIN, source="test")
+    version = save_bundle(bundle, cache)
+    return cache, version, bundle
+
+
+def test_bundle_save_load_roundtrip(bundle_store):
+    cache, version, bundle = bundle_store
+    loaded = load_bundle(cache, version)
+    assert loaded.version == version
+    assert loaded.doc == bundle.doc
+    assert [s["family"] for s in loaded.chain] == [n for n, _ in DEMO_CHAIN]
+    # label is fit-time-only material: never a required request column
+    assert "label" not in loaded.input_names
+    listed = list_bundles(cache)
+    assert [b["version"] for b in listed] == [version]
+
+
+def test_bundle_save_is_idempotent(bundle_store, fit_table):
+    cache, version, _ = bundle_store
+    again = save_bundle(fit_bundle(fit_table, DEMO_CHAIN, source="test"), cache)
+    assert again == version  # content addressing: same state, same version
+
+
+def test_bundle_missing_version_refused(bundle_store):
+    cache, _, _ = bundle_store
+    with pytest.raises(BundleVersionError, match="not found"):
+        load_bundle(cache, "0" * 64)
+
+
+def test_bundle_format_version_mismatch_refused(fit_table, tmp_path):
+    cache = str(tmp_path / "cas")
+    bundle = fit_bundle(
+        fit_table, [("z_standardization", {"list_of_cols": ["age"]})])
+    bundle.doc["bundle_format"] = 999
+    import anovos_tpu.serving.bundle as B
+
+    bundle.version = B._digest(bundle.doc)  # re-address the altered doc
+    version = save_bundle(bundle, cache)
+    with pytest.raises(BundleVersionError, match="format version"):
+        load_bundle(cache, version)
+
+
+def test_bundle_tampered_payload_refused(fit_table, tmp_path):
+    cache = str(tmp_path / "cas")
+    bundle = fit_bundle(
+        fit_table, [("z_standardization", {"list_of_cols": ["age"]})])
+    version = save_bundle(bundle, cache)
+    import anovos_tpu.serving.bundle as B
+    from anovos_tpu.cache.store import CacheStore
+
+    path = os.path.join(CacheStore(cache).payload_dir(B._NODE_PREFIX + version),
+                        B._DOC_NAME)
+    with open(path) as f:
+        doc = json.load(f)
+    doc["chain"][0]["apply_config"]["output_mode"] = "append"
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(BundleVersionError, match="digest mismatch"):
+        load_bundle(cache, version)
+
+
+# ---------------------------------------------------------------------------
+# the server: micro-batching, parity, hostility, zero compiles after warm
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def warmed(bundle_store):
+    cache, version, _ = bundle_store
+    program = ApplyProgram(load_bundle(cache, version))
+    program.warm(64)
+    return program
+
+
+def _payload(src: pd.DataFrame, start: int, width: int) -> dict:
+    return {"columns": frame_to_payload(src.iloc[start:start + width])}
+
+
+def test_server_concurrent_mixed_width_parity(warmed, fit_df, tmp_path):
+    src = fit_df[[c["name"] for c in warmed.input_columns]]
+    server = FeatureServer(warmed, window_ms=20, max_batch=64,
+                           obs_dir=str(tmp_path))
+    server.start(warm=False)
+    try:
+        widths = (1, 3, 8, 17)
+        payloads = [_payload(src, (i * 19) % 400, widths[i % len(widths)])
+                    for i in range(24)]
+        results: list = [None] * len(payloads)
+
+        def client(cid):
+            for r in range(6):
+                i = cid * 6 + r
+                results[i] = server.serve(payloads[i])
+
+        threads = [threading.Thread(target=client, args=(c,)) for c in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, (p, resp) in enumerate(zip(payloads, results)):
+            assert resp is not None and "error" not in resp, (i, resp)
+            frame, err = coerce_payload(warmed.input_columns, p, 64)
+            assert err is None
+            ref = frame_to_payload(warmed.apply_frame(frame))
+            assert resp["columns"] == ref, f"request {i} diverged from batch apply"
+        stats = server.stats()
+        assert stats["served"] == len(payloads)
+        assert stats["p50_ms"] is not None and stats["p99_ms"] is not None
+    finally:
+        server.close()
+
+
+def test_server_refuses_hostile_payloads_and_survives(warmed, fit_df, tmp_path):
+    src = fit_df[[c["name"] for c in warmed.input_columns]]
+    ok_payload = _payload(src, 0, 2)
+    server = FeatureServer(warmed, window_ms=5, max_batch=64,
+                           obs_dir=str(tmp_path))
+    server.start(warm=False)
+    try:
+        cols = ok_payload["columns"]
+        hostile = {
+            "hostile_values": {"columns": {**cols, "age": [float("inf"), 1.0]}},
+            "hostile_values ": {"columns": {**cols, "age": [1e39, None]}},
+            "schema_drift": {"columns": {**cols, "bogus": [1.0, 2.0]}},
+            "schema_drift ": {"columns": {k: v for k, v in cols.items()
+                                          if k != "age"}},
+            "wrong_dtype": {"columns": {**cols, "age": ["nope", 1.0]}},
+            "wrong_dtype ": {"columns": {**cols, "workclass": [1.0, 2.0]}},
+            "bad_shape": {"columns": {**cols, "age": [1.0]}},
+            "bad_shape ": {"columns": frame_to_payload(
+                pd.concat([src.iloc[:60]] * 2, ignore_index=True))},
+            "bad_request": {"rows": [1, 2]},
+        }
+        for expect_code, payload in hostile.items():
+            resp = server.serve(payload)
+            assert "error" in resp, (expect_code, resp)
+            assert resp["error"]["code"] == expect_code.strip(), resp
+        # the server is still serving — and serving CORRECTLY
+        resp = server.serve(ok_payload)
+        assert "error" not in resp
+        frame, _ = coerce_payload(warmed.input_columns, ok_payload, 64)
+        assert resp["columns"] == frame_to_payload(warmed.apply_frame(frame))
+        stats = server.stats()
+        assert stats["quarantined"] == len(hostile)
+        from anovos_tpu.obs import get_metrics
+
+        quarantine = get_metrics().counter("serve_requests_quarantined_total")
+        by_reason = {labels["reason"]: v for labels, v in quarantine.items()}
+        assert by_reason.get("hostile_values", 0) >= 2
+        assert by_reason.get("schema_drift", 0) >= 2
+    finally:
+        server.close()
+
+
+def test_no_fresh_compiles_after_warm(warmed, fit_df, tmp_path):
+    """The AOT contract: request-time applies replay pre-compiled
+    executables — zero XLA compiles after the per-bucket warm-up."""
+    from anovos_tpu.obs import compile_census
+
+    src = fit_df[[c["name"] for c in warmed.input_columns]]
+    server = FeatureServer(warmed, window_ms=2, max_batch=64,
+                           obs_dir=str(tmp_path))
+    server.start(warm=False)
+    try:
+        server.serve(_payload(src, 0, 5))  # settle any lazy first-touch
+        mark = compile_census.mark()
+        for start, width in ((0, 1), (7, 9), (40, 17), (100, 33)):
+            resp = server.serve(_payload(src, start, width))
+            assert "error" not in resp
+        census = compile_census.census(since=mark)
+        assert int(census.get("compiles_total") or 0) == 0, census
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# fresh-subprocess full-coverage parity through the CAS bundle
+# ---------------------------------------------------------------------------
+def _run(code: str) -> None:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=420, cwd=REPO)
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_full_coverage_bundle_parity_fresh_subprocess(tmp_path):
+    """The satellite gate: fitted_state → CAS bundle → (fresh process)
+    from_state → apply reproduces the batch transformer chain's output
+    byte-identically over the full-coverage demo config."""
+    work = str(tmp_path)
+    # process A: batch-run the chain AND export the bundle
+    _run(f"""
+import json, os
+import pandas as pd
+from anovos_tpu.shared.runtime import init_runtime
+init_runtime()
+from anovos_tpu.shared.table import Table
+from anovos_tpu.data_transformer import transformers as T
+from anovos_tpu.serving.demo import DEMO_CHAIN, demo_frame
+from anovos_tpu.serving import fit_bundle, save_bundle
+
+work = {work!r}
+df = demo_frame(500, seed=7)
+t = Table.from_pandas(df)
+batch = t
+for name, cfg in DEMO_CHAIN:
+    batch = getattr(T, name)(batch, **cfg)
+batch.to_pandas().to_parquet(os.path.join(work, "batch.parquet"), index=False)
+version = save_bundle(fit_bundle(t, DEMO_CHAIN), os.path.join(work, "cas"))
+with open(os.path.join(work, "version.txt"), "w") as f:
+    f.write(version)
+""")
+    # process B (fresh, no fit-time state): serve from the bundle
+    _run(f"""
+import os
+import pandas as pd
+from anovos_tpu.shared.runtime import init_runtime
+init_runtime()
+from anovos_tpu.shared.table import Table
+from anovos_tpu.serving import load_bundle, ApplyProgram
+from anovos_tpu.serving.demo import demo_frame
+
+work = {work!r}
+with open(os.path.join(work, "version.txt")) as f:
+    version = f.read().strip()
+program = ApplyProgram(load_bundle(os.path.join(work, "cas"), version))
+served = program.apply_table(Table.from_pandas(demo_frame(500, seed=7))).to_pandas()
+batch = pd.read_parquet(os.path.join(work, "batch.parquet"))
+assert list(batch.columns) == list(served.columns), (list(batch.columns), list(served.columns))
+for c in batch.columns:
+    na_b, na_s = batch[c].isna(), served[c].isna()
+    assert (na_b == na_s).all(), c
+    assert (batch[c][~na_b].values == served[c][~na_s].values).all(), c
+""")
+
+
+def test_serve_fault_chaos_scenario():
+    """tools/chaos_run.py --scenario serve-fault must pass its gates in a
+    fresh single-device process (the e2e acceptance wiring)."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    for k in ("ANOVOS_TPU_CHAOS", "ANOVOS_TPU_CACHE", "XLA_FLAGS",
+              "ANOVOS_TPU_FLIGHTREC"):
+        env.pop(k, None)
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.chaos_run", "--scenario", "serve-fault",
+         "--json"],
+        capture_output=True, text=True, env=env, timeout=420, cwd=REPO)
+    assert p.returncode == 0, p.stdout + p.stderr
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rec["ok"], rec
+    assert rec["parity"] and rec["clean_flightrec"] == 0
+    assert any(d["trigger"] == "serve_fatal" for d in rec["flightrec"])

@@ -32,6 +32,15 @@ Scenarios (sites target the default synthetic config's nodes; use
   degraded section's artifacts are absent by design); instead the gate
   pins the exact degraded set, the bounded wall, and lane attribution in
   the flight dumps.
+* ``serve-fault`` — the ONLINE-SERVING scenario (no workflow run): a
+  feature server boots from a demo bundle, then a chaos-injected hang +
+  double exception fire on the ``serve:apply`` site while clean and
+  hostile requests interleave.  Gates: bounded p99, zero corrupted
+  responses (every clean request's payload byte-identical to the batch
+  apply of the same rows), structured per-request errors for the
+  hostile payloads, a ``serve_fatal`` flight dump for the injected
+  fatal, the server still serving afterwards — and a clean leg with
+  byte parity and ZERO flight dumps.
 
 Usage::
 
@@ -79,6 +88,12 @@ SCENARIOS = {
     "corrupt-ingest": ("seed=7;corrupt@io:*part-00001.parquet:n=99;"
                        "truncate@io:*part-00002.parquet:n=99;"
                        "slowread@io:*part-00003.parquet:secs=0.2"),
+    # the online-serving scenario: hang listed FIRST so the first batch
+    # attempt sleeps 0.5s then hits the exception; the retry hits the
+    # second exception → the batch is fatal (flight dump + structured
+    # errors) while every later batch serves normally.
+    "serve-fault": ("seed=7;hang@serve:apply:secs=0.5:n=1;"
+                    "exc@serve:apply:n=2"),
 }
 
 # how many synthetic input part files a scenario's dataset is split into
@@ -416,6 +431,160 @@ def run_scenario(scenario: str, workdir: str, config: dict = None,
     return result
 
 
+def run_serve_fault(workdir: str) -> dict:
+    """The online-serving fault gate (no workflow run involved).
+
+    Clean leg: boot a server from the demo bundle, serve mixed-width
+    requests, every response byte-identical to the batch apply, zero
+    flight dumps.  Chaos leg: install the ``serve-fault`` plan, lead
+    with a victim request (hang + exc, retry exc → fatal batch), then
+    interleave clean and hostile requests.  Gates: the victim got a
+    structured ``apply_failed`` error, a ``serve_fatal`` flight dump
+    exists, hostile payloads got structured quarantine responses, every
+    clean response stayed byte-identical (zero corrupted responses),
+    p99 stayed bounded, and the server was still serving at the end."""
+    import numpy as np
+
+    from anovos_tpu.obs import flight
+    from anovos_tpu.resilience import chaos
+    from anovos_tpu.serving.bundle import load_bundle
+    from anovos_tpu.serving.demo import build_demo_bundle, demo_frame
+    from anovos_tpu.serving.program import ApplyProgram
+    from anovos_tpu.serving.server import (
+        FeatureServer, coerce_payload, frame_to_payload)
+    from anovos_tpu.shared.runtime import init_runtime
+
+    init_runtime()
+    spec = SCENARIOS["serve-fault"]
+    result = {"scenario": "serve-fault", "spec": spec}
+    cache = os.path.join(workdir, "cache")
+    version = build_demo_bundle(cache, rows=1500)
+    bundle = load_bundle(cache, version)
+    src = demo_frame(1500, seed=11)[bundle.input_names]
+    widths = (1, 3, 8, 17)
+    payloads, off = [], 0
+    for i in range(16):
+        w = widths[i % len(widths)]
+        payloads.append({"columns": frame_to_payload(src.iloc[off:off + w])})
+        off += w
+    hostile = [
+        {"columns": {**payloads[0]["columns"],
+                     "age": [float("inf")]}},
+        {"columns": {**payloads[0]["columns"], "age": [1e39]}},
+        {"columns": {**{k: v for k, v in payloads[0]["columns"].items()
+                        if k != "age"}, "bogus_col": [1.0]}},
+        {"columns": {**payloads[0]["columns"], "age": ["not-a-number"]}},
+    ]
+
+    def reference(program, payload):
+        frame, err = coerce_payload(program.input_columns, payload, 256)
+        assert err is None
+        return frame_to_payload(program.apply_frame(frame))
+
+    def run_leg(leg: str, chaos_spec: str) -> dict:
+        obs_dir = os.path.join(workdir, leg)
+        os.makedirs(obs_dir, exist_ok=True)
+        flight.configure(os.path.join(obs_dir, "obs"))
+        program = ApplyProgram(load_bundle(cache, version))
+        server = FeatureServer(program, obs_dir=obs_dir)
+        t0 = time.monotonic()
+        server.start(warm=True)
+        # faults target STEADY-STATE serving: the plan lands after boot so
+        # the warm probe is not the victim
+        chaos.install(chaos_spec or None)
+        out: dict = {"cold_start_s": round(time.monotonic() - t0, 3)}
+        victim = None
+        if chaos_spec:
+            victim = server.serve(payloads[-1])
+        clean_bad = []
+        hostile_bad = []
+        for i, p in enumerate(payloads[:12]):
+            resp = server.serve(p)
+            if "error" in resp or resp.get("columns") != reference(program, p):
+                clean_bad.append(i)
+            if chaos_spec and i % 3 == 0:
+                h = server.serve(hostile[(i // 3) % len(hostile)])
+                if "error" not in h:
+                    hostile_bad.append(i)
+        stats = server.stats()
+        server.close()
+        dumps = flight_dumps(obs_dir)
+        chaos_plan = chaos.plan()
+        out.update({
+            "victim": victim,
+            "clean_corrupted": clean_bad,
+            "hostile_unrefused": hostile_bad,
+            "stats": stats,
+            "flightrec": [{"file": os.path.basename(p), "trigger": t,
+                           "node": n} for p, t, n in dumps],
+            "injections": chaos_plan.injection_count() if chaos_plan else 0,
+        })
+        chaos.reset()
+        flight.reset()
+        return out
+
+    clean = run_leg("clean", "")
+    result["clean_flightrec"] = len(clean["flightrec"])
+    result["clean_corrupted"] = clean["clean_corrupted"]
+    result["clean_p99_ms"] = clean["stats"]["p99_ms"]
+    result["clean_wall_s"] = clean["cold_start_s"]
+
+    chaos_leg = run_leg("chaos", spec)
+    result["injections"] = chaos_leg["injections"]
+    result["chaos_p99_ms"] = chaos_leg["stats"]["p99_ms"]
+    result["chaos_corrupted"] = chaos_leg["clean_corrupted"]
+    result["hostile_unrefused"] = chaos_leg["hostile_unrefused"]
+    result["flightrec"] = chaos_leg["flightrec"]
+    result["quarantined"] = chaos_leg["stats"]["quarantined"]
+    result["served_after_fatal"] = chaos_leg["stats"]["served"]
+
+    victim = chaos_leg["victim"] or {}
+    victim_ok = (victim.get("error") or {}).get("code") == "apply_failed"
+    fatal_dumped = any(d["trigger"] == "serve_fatal"
+                      for d in chaos_leg["flightrec"])
+    # bounded p99: the injected 0.5s hang + one retry must not push the
+    # tail anywhere near a hung-server cliff
+    p99_bound_ms = 10_000.0
+    result["p99_bound_ms"] = p99_bound_ms
+    bounded = (chaos_leg["stats"]["p99_ms"] or np.inf) <= p99_bound_ms
+    result["parity"] = not (clean["clean_corrupted"]
+                            or chaos_leg["clean_corrupted"])
+    result["ok"] = bool(
+        result["parity"] and victim_ok and fatal_dumped and bounded
+        and not chaos_leg["hostile_unrefused"]
+        and chaos_leg["stats"]["served"] >= len(payloads[:12])
+        and result["injections"] >= 3
+        and result["clean_flightrec"] == 0)
+    if not result["ok"]:
+        reasons = []
+        if clean["clean_corrupted"] or chaos_leg["clean_corrupted"]:
+            reasons.append(
+                f"corrupted clean responses (clean leg {clean['clean_corrupted']}, "
+                f"chaos leg {chaos_leg['clean_corrupted']})")
+        if not victim_ok:
+            reasons.append(f"victim request did not fail structurally: {victim}")
+        if not fatal_dumped:
+            reasons.append(
+                f"no serve_fatal flight dump (got {chaos_leg['flightrec']})")
+        if not bounded:
+            reasons.append(
+                f"chaos p99 {chaos_leg['stats']['p99_ms']}ms exceeded the "
+                f"{p99_bound_ms}ms bound")
+        if chaos_leg["hostile_unrefused"]:
+            reasons.append("hostile payload(s) served instead of refused: "
+                           f"{chaos_leg['hostile_unrefused']}")
+        if chaos_leg["stats"]["served"] < len(payloads[:12]):
+            reasons.append("server stopped serving after the fatal batch")
+        if result["injections"] < 3:
+            reasons.append(
+                f"chaos plan fired {result['injections']} (< 3 — site drifted?)")
+        if result["clean_flightrec"]:
+            reasons.append(f"{result['clean_flightrec']} flight dump(s) on the "
+                           "CLEAN serving leg")
+        result["error"] = "; ".join(reasons)
+    return result
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="run a config under a chaos scenario; exit nonzero "
@@ -448,8 +617,13 @@ def main(argv=None) -> int:
         with open(ns.config) as f:
             cfg = yaml.load(f, yaml.SafeLoader)
     workdir = ns.workdir or tempfile.mkdtemp(prefix="anovos_chaos_")
-    result = run_scenario(ns.scenario, workdir, config=cfg, spec=ns.spec,
-                          node_timeout=ns.node_timeout)
+    if ns.scenario == "serve-fault":
+        # --node-timeout is a workflow-scenario knob (ANOVOS_TPU_NODE_TIMEOUT);
+        # the serving scenario's tail bound is the p99 gate instead
+        result = run_serve_fault(workdir)
+    else:
+        result = run_scenario(ns.scenario, workdir, config=cfg, spec=ns.spec,
+                              node_timeout=ns.node_timeout)
     if ns.json:
         print(json.dumps(result, sort_keys=True))
     else:

@@ -13,4 +13,5 @@ from tools.graftcheck.rules import (  # noqa: F401  (import = registration)
     gc010_unattributed_dispatch,
     gc011_collective_placement,
     gc012_unguarded_io,
+    gc013_serving_request_path,
 )

@@ -1,0 +1,147 @@
+"""GC013 — request-path tracing / unattributed host-sync in ``serving/``.
+
+The online feature server's latency contract rests on one structural
+invariant: EVERY ``jax.jit`` in the apply chain lowers and compiles at
+server start (``ApplyProgram.warm`` — per shape bucket, against the
+persistent XLA compile cache), so a request-time apply only ever replays
+cached executables.  A ``jax.jit(...)`` constructed inside serving code
+re-traces per call — a multi-second p99 cliff the smoke load would only
+catch statistically; and a bare ``jax.device_get`` /
+``.block_until_ready()`` on the request path is a host sync whose wall
+books as anonymous host time, invisible to the devprof split the serving
+bench steers by.
+
+This rule flags, in ``anovos_tpu/serving/``:
+
+* **any ``jax.jit`` / ``functools.partial(jax.jit, …)`` CALL inside a
+  function body** — per-request tracing.  Module-level jitted
+  definitions (the pre-compiled-program discipline) are exempt; genuine
+  startup-only construction must carry an inline suppression with its
+  justification.
+* **host-sync calls (``jax.device_get`` / ``.block_until_ready()``)
+  in functions with no dispatch attribution** — a function is attributed
+  when it is decorated ``@timed(...)``, itself enters
+  ``devprof.dispatch_bracket`` / ``devprof.node_bracket``, or is called
+  (one level, same module — including ``self.``-method calls) by an
+  attributed function.  All device dispatch on the request path must go
+  through the pre-compiled executables under ``timed()`` /
+  ``dispatch_bracket`` / ``node_bracket``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set
+
+from tools.graftcheck.jaxmodel import attr_chain, call_chain
+from tools.graftcheck.registry import FileContext, Rule, register
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` / ``jit(...)`` / ``functools.partial(jax.jit, …)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    chain = call_chain(node)
+    if chain in ("jax.jit", "jit"):
+        return True
+    if chain in ("functools.partial", "partial") and node.args:
+        head = node.args[0]
+        if attr_chain(head) in ("jax.jit", "jit"):
+            return True
+        if _is_jit_call(head):
+            return True
+    return False
+
+
+def _is_timed_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        return call_chain(dec) in ("timed", "obs.timed")
+    return attr_chain(dec) in ("timed", "obs.timed")
+
+
+_BRACKETS = ("dispatch_bracket", "node_bracket")
+
+
+def _enters_bracket(fn: ast.FunctionDef) -> bool:
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            chain = call_chain(sub) or ""
+            if any(chain.endswith(b) for b in _BRACKETS):
+                return True
+    return False
+
+
+def _called_names(fn: ast.FunctionDef) -> Set[str]:
+    """Bare function names and ``self.<name>`` method names ``fn`` calls."""
+    out: Set[str] = set()
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Name):
+            out.add(f.id)
+        elif (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+              and f.value.id in ("self", "cls")):
+            out.add(f.attr)
+    return out
+
+
+@register
+class ServingRequestPathRule(Rule):
+    id = "GC013"
+    title = ("per-request jax.jit tracing / unattributed host-sync in "
+             "serving request-path code")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("anovos_tpu/serving/") or "gc013" in relpath
+
+    def check(self, ctx: FileContext) -> Iterable:
+        # EVERY def is scanned, including same-named methods on different
+        # classes — a name-keyed dict would silently skip all but the first
+        all_fns = [n for n in ast.walk(ctx.tree)
+                   if isinstance(n, ast.FunctionDef)]
+        names = {fn.name for fn in all_fns}
+        attributed: Set[str] = set()
+        for fn in all_fns:
+            if any(_is_timed_decorator(d) for d in fn.decorator_list):
+                attributed.add(fn.name)
+            elif _enters_bracket(fn):
+                attributed.add(fn.name)
+        # attribution flows one level to same-module callees (a helper
+        # under a bracketed caller must not be double-bracketed).  Name-
+        # based: a call to a name attributes every same-named def — the
+        # conservative direction is bounded by how rare the collision is,
+        # and the scan itself never skips a body either way.
+        for fn in all_fns:
+            if fn.name in attributed:
+                attributed |= _called_names(fn) & names
+
+        for fn in all_fns:
+            name = fn.name
+            decorator_nodes = {id(d) for dec in fn.decorator_list
+                               for d in ast.walk(dec)}
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call) or id(sub) in decorator_nodes:
+                    continue
+                if _is_jit_call(sub):
+                    yield ctx.finding(
+                        self.id, sub,
+                        f"{name!r} constructs a jit wrapper inside serving "
+                        "code — request-path applies must replay executables "
+                        "pre-compiled by ApplyProgram.warm(), never trace; "
+                        "hoist to module level (or suppress with a startup-"
+                        "only justification)")
+                    continue
+                if name in attributed:
+                    continue
+                chain = call_chain(sub) or ""
+                if chain in ("jax.device_get", "device_get") or \
+                        chain.endswith(".block_until_ready"):
+                    yield ctx.finding(
+                        self.id, sub,
+                        f"{name!r} host-syncs ({chain.rsplit('.', 1)[-1]}) on "
+                        "the serving request path with no dispatch "
+                        "attribution — route it through timed()/"
+                        "devprof.dispatch_bracket/node_bracket so the wall "
+                        "books against the apply split instead of anonymous "
+                        "host time")
