@@ -78,13 +78,25 @@ KNOWN_ENV_KNOBS = (
     "ANOVOS_REPLICATE_MAX_BYTES",
     "ANOVOS_REREAD_FROM_DISK",
     "ANOVOS_SHAPE_BUCKETS",
-    # streaming backpressure depth (ops/streaming.py).  Drain order is
-    # FIFO at any window so committed artifacts do not change — but the
-    # knob is read inside the node-reachable streaming path, and the
-    # env-read audit (GC008/GC012) wants every such knob on the audited
-    # list; a false invalidation on a knob nobody flips mid-project is
-    # cheap, an unauditable env read is not.
+    # streaming prefetch pool (data_ingest/prefetch.py): decode worker
+    # count and the spill-tier staging directory.  Both are pure
+    # performance knobs — chunk assembly is ORDERED regardless of worker
+    # count, and a spilled frame round-trips exactly — but like
+    # ANOVOS_STREAM_INFLIGHT below they are read inside the node-reachable
+    # streaming path, and the env-read audit (GC008/GC012) wants every
+    # such knob on the audited list; a false invalidation on knobs nobody
+    # flips mid-project is cheap, an unauditable env read is not.
+    "ANOVOS_STREAM_DECODE_WORKERS",
+    # streaming backpressure depth (ops/streaming.py); since round 12
+    # ``auto`` (the default) lets the controller resize it from the
+    # decode-vs-drain split.  Drain order is FIFO at any window so
+    # committed artifacts do not change — but the knob is read inside the
+    # node-reachable streaming path, and the env-read audit (GC008/GC012)
+    # wants every such knob on the audited list; a false invalidation on
+    # a knob nobody flips mid-project is cheap, an unauditable env read
+    # is not.
     "ANOVOS_STREAM_INFLIGHT",
+    "ANOVOS_STREAM_SPILL_DIR",
     # bf16 mixed-precision sweep (ops/mxu.py): routes the MXU-safe
     # pre-centered matmuls (corr/cov/PCA) through bf16 inputs with f32
     # accumulation — artifacts change within the tested tolerance bands,

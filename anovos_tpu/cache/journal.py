@@ -25,6 +25,17 @@ and ``part_quarantined`` (the ingest guard set a part aside — ``file``,
 ``error_class``, ``stage``, ``rows_lost``; the crash-safe
 ``obs/quarantine_manifest.json`` is the durable record, this line the
 WAL trail next to node_retry/node_degraded).
+The async prefetch pipeline (round 12) adds ``chunk_spilled`` (a
+decoded frame outran the in-flight window and was staged to the
+``ANOVOS_STREAM_SPILL_DIR`` disk tier — ``file_index``; purely an
+overlap/telemetry record, the frame round-trips exactly).  Round 12
+also widened ``chunk_begin``/``chunk_commit``/``chunks_invalidated``
+to multi-pass streams: quality streams use phase 1, drift streams
+phases 1/2/3 (source stats / source histograms / target histograms),
+and a ``chunks_invalidated`` whose ``phase`` names the first histogram
+pass means the binning EDGES drifted (a quarantined source part came
+back, or the persisted model changed) and every histogram partial was
+dropped — not just the chunks downstream of the shifted file.
 The journal is append-only ACROSS runs in the same output directory, so
 a killed run's committed frontier is still on disk when ``--resume``
 re-runs the config: resumed nodes hit the cache store (the store commit,

@@ -24,6 +24,7 @@ import numpy as np
 import pandas as pd
 
 from anovos_tpu.data_analyzer import stats_generator as sg
+from anovos_tpu.obs import timed
 from anovos_tpu.ops.fuse import fuse_enabled
 from anovos_tpu.ops.quantiles import masked_quantiles
 from anovos_tpu.ops.reductions import masked_moments
@@ -320,6 +321,28 @@ def nullColumns_detection(
     return odf, stats
 
 
+def _load_outlier_model(model_path: str):
+    """Persisted outlier bounds (``outlier_numcols``): {attribute: [lo, hi]}
+    (None = open side) plus the skewed-attribute list — shared by the
+    in-memory ``pre_existing_model`` path and the streaming variant so
+    both resolve the model identically."""
+    from anovos_tpu.data_transformer.model_io import load_model_df
+
+    dfm = load_model_df(model_path, "outlier_numcols")
+    bounds: Dict[str, list] = {}
+    skewed: List[str] = []
+    for _, r in dfm.iterrows():
+        p = list(r["parameters"])
+        if "skewed_attribute" in [str(x) for x in p]:
+            skewed.append(r["attribute"])
+        else:
+            bounds[r["attribute"]] = [
+                None if x is None or (isinstance(x, float) and np.isnan(x)) else float(x)
+                for x in p
+            ]
+    return bounds, skewed
+
+
 def outlier_detection(
     idf: Table,
     list_of_cols="all",
@@ -361,19 +384,8 @@ def outlier_detection(
     skewed_cols: List[str] = []
 
     if pre_existing_model:
-        from anovos_tpu.data_transformer.model_io import load_model_df
-
-        dfm = load_model_df(model_path, "outlier_numcols")
-        bounds: Dict[str, list] = {}
-        for _, r in dfm.iterrows():
-            p = list(r["parameters"])
-            if "skewed_attribute" in [str(x) for x in p]:
-                skewed_cols.append(r["attribute"])
-            else:
-                bounds[r["attribute"]] = [
-                    None if x is None or (isinstance(x, float) and np.isnan(x)) else float(x)
-                    for x in p
-                ]
+        bounds, model_skewed = _load_outlier_model(model_path)
+        skewed_cols.extend(model_skewed)
         cols = [c for c in cols if c in bounds]
         lower = np.array([bounds[c][0] if bounds[c][0] is not None else -np.inf for c in cols])
         upper = np.array([bounds[c][1] if bounds[c][1] is not None else np.inf for c in cols])
@@ -882,3 +894,167 @@ def invalidEntries_detection(
     if print_impact:
         logger.info(stats.to_string(index=False))
     return odf, stats
+
+
+# ---------------------------------------------------------------------------
+# out-of-core streaming variants (round 12): whole-table quality passes over
+# the prefetch iterator — datasets that never fit in memory get the SAME
+# stats frames, byte-identical to the in-memory path, with chunk-level
+# checkpoints so a mid-run kill + --resume re-reads only undone chunks.
+# ---------------------------------------------------------------------------
+@jax.jit
+def _outlier_counts_program(X, M, lo, hi):
+    """Counts-only twin of ``_outlier_flags`` for one streamed chunk: the
+    same flag arithmetic, reduced on device so only two (k,) vectors come
+    home per chunk."""
+    flag = jnp.where(M & (X > hi[None, :]), 1, 0) + jnp.where(M & (X < lo[None, :]), -1, 0)
+    return (flag == -1).sum(axis=0), (flag == 1).sum(axis=0)
+
+
+@timed("quality_checker.missing_stats_streaming")
+def missing_stats_streaming(
+    file_path: str,
+    file_type: str,
+    list_of_cols="all",
+    drop_cols=[],
+    chunk_rows: int = 1_000_000,
+    file_configs: dict = None,
+    checkpoint_dir: str = None,
+    resume: bool = False,
+    print_impact=False,
+) -> pd.DataFrame:
+    """Streaming ``missingCount_computation``: [attribute, missing_count,
+    missing_pct] over a part-file dataset of ANY size, byte-identical to
+    the in-memory stats frame (valid counts are exact integers; the pct
+    rounding is the same ``np.round(·, 4)``).  Host residency is one
+    chunk window — the counts are host tallies over the raw frames, so
+    this pass is decode-bound and rides the prefetch pool end to end."""
+    from anovos_tpu.data_ingest.data_ingest import _resolve_files
+    from anovos_tpu.data_ingest.prefetch import StreamController, StreamStats
+    from anovos_tpu.ops import streaming as st
+
+    cfg = dict(file_configs or {})
+    files = _resolve_files(file_path, file_type)
+    schema = st.stream_schema(files, file_type, cfg)
+    all_cols = [c for c, _k in schema]
+    num_cols = [c for c, k in schema if k == "num"]
+    cols = parse_cols(list_of_cols, all_cols, drop_cols)
+    if not cols:
+        raise TypeError("Invalid input for Column(s)")
+    ctl, stats = StreamController(), StreamStats()
+    ckpt = None
+    if checkpoint_dir:
+        ckpt = st.StreamCheckpoint(
+            checkpoint_dir,
+            st._stream_sig(files, file_type, cols, chunk_rows, 0,
+                           op="quality_missing"),
+            resume=resume)
+    skip = ckpt.committed(1) if (ckpt is not None and resume) else frozenset()
+    parts = st._run_pass(
+        files, file_type, num_cols, chunk_rows, cfg,
+        pass_no=1,
+        dispatch=lambda v, m: {},
+        host_part=lambda df: {
+            "rows": np.asarray(len(df), np.int64),
+            "valid": df[cols].notna().sum().to_numpy(np.int64),
+        },
+        ctl=ctl, stats=stats, ckpt=ckpt, skip_chunks=skip,
+        on_file_rows=st.checkpoint_on_file_rows(ckpt),
+        need_block=False)  # host tallies only — skip the padded f32 block
+    if not parts:
+        from anovos_tpu.data_ingest.guard import IngestError
+
+        raise IngestError(
+            f"missing_stats_streaming: no readable rows in {len(files)} "
+            "part file(s) (every part quarantined?)")
+    total = int(sum(int(p["rows"]) for p in parts.values()))
+    valid = np.sum([p["valid"] for p in parts.values()], axis=0).astype(np.int64)
+    missing = total - valid
+    odf = pd.DataFrame({
+        "attribute": cols,
+        "missing_count": missing,
+        "missing_pct": np.round(missing / max(total, 1), 4),
+    })
+    st._publish_stats("missing_stats_streaming", ctl, stats)
+    if print_impact:
+        logger.info(odf.to_string(index=False))
+    return odf
+
+
+@timed("quality_checker.outlier_stats_streaming")
+def outlier_stats_streaming(
+    file_path: str,
+    file_type: str,
+    model_path: str,
+    list_of_cols="all",
+    drop_cols=[],
+    chunk_rows: int = 1_000_000,
+    file_configs: dict = None,
+    checkpoint_dir: str = None,
+    resume: bool = False,
+    print_impact=False,
+) -> pd.DataFrame:
+    """Streaming outlier counting against PRE-FITTED bounds: the
+    out-of-core twin of ``outlier_detection(pre_existing_model=True)``
+    — fit bounds on a sample (or a prior run), then count outliers over
+    the full dataset without ever materializing it.  [attribute,
+    lower_outliers, upper_outliers], byte-identical to the in-memory
+    stats frame (per-chunk device counts are exact integers summed in
+    int64)."""
+    from anovos_tpu.data_ingest.data_ingest import _resolve_files
+    from anovos_tpu.data_ingest.prefetch import StreamController, StreamStats
+    from anovos_tpu.ops import streaming as st
+    from anovos_tpu.shared.table import pad_lane_params
+
+    cfg = dict(file_configs or {})
+    files = _resolve_files(file_path, file_type)
+    schema = st.stream_schema(files, file_type, cfg)
+    num_all = [c for c, k in schema if k == "num"]
+    cols = parse_cols(list_of_cols if list_of_cols != "all" else num_all,
+                      num_all, drop_cols)
+    bounds, _skewed = _load_outlier_model(model_path)
+    cols = [c for c in cols if c in bounds]
+    if not cols:
+        return pd.DataFrame(columns=["attribute", "lower_outliers", "upper_outliers"])
+    lower = np.array([bounds[c][0] if bounds[c][0] is not None else -np.inf for c in cols])
+    upper = np.array([bounds[c][1] if bounds[c][1] is not None else np.inf for c in cols])
+    ctl, stats = StreamController(), StreamStats()
+    ckpt = None
+    if checkpoint_dir:
+        ckpt = st.StreamCheckpoint(
+            checkpoint_dir,
+            st._stream_sig(files, file_type, cols, chunk_rows, 0,
+                           op="quality_outlier:" + ",".join(
+                               f"{lo}:{hi}" for lo, hi in zip(lower, upper))),
+            resume=resume)
+    from anovos_tpu.shared.runtime import get_runtime
+
+    k_pad = get_runtime().pad_cols(len(cols))
+    # host f32 bound arrays ride through the jit boundary directly, the
+    # same convention as the fused in-memory path (dead bucketed lanes
+    # are mask=False → flag 0 → zero counts)
+    lo_p = pad_lane_params(lower, k_pad).astype(np.float32)
+    hi_p = pad_lane_params(upper, k_pad).astype(np.float32)
+    skip = ckpt.committed(1) if (ckpt is not None and resume) else frozenset()
+    parts = st._run_pass(
+        files, file_type, cols, chunk_rows, cfg,
+        pass_no=1,
+        dispatch=lambda v, m: dict(zip(
+            ("n_lo", "n_hi"),
+            _outlier_counts_program(jnp.asarray(v), jnp.asarray(m), lo_p, hi_p))),
+        ctl=ctl, stats=stats, ckpt=ckpt, skip_chunks=skip,
+        on_file_rows=st.checkpoint_on_file_rows(ckpt))
+    if not parts:
+        from anovos_tpu.data_ingest.guard import IngestError
+
+        raise IngestError(
+            f"outlier_stats_streaming: no readable rows in {len(files)} "
+            "part file(s) (every part quarantined?)")
+    n_lo = np.sum([p["n_lo"] for p in parts.values()], axis=0).astype(np.int64)[: len(cols)]
+    n_hi = np.sum([p["n_hi"] for p in parts.values()], axis=0).astype(np.int64)[: len(cols)]
+    odf = pd.DataFrame(
+        {"attribute": cols, "lower_outliers": n_lo, "upper_outliers": n_hi})
+    st._publish_stats("outlier_stats_streaming", ctl, stats)
+    if print_impact:
+        logger.info(odf.to_string(index=False))
+    return odf

@@ -242,7 +242,19 @@ def statistics(
                     os.path.join(d, "part-00000.csv"), index=False
                 )
 
-    # ---- vectorized metrics over padded (k, max_bins) arrays --------------
+    odf = _metrics_frame(freq_p, freq_q, cols, methods, threshold)
+    if print_impact:
+        logger.info(odf.to_string(index=False))
+    return odf
+
+
+def _metrics_frame(freq_p: Dict[str, np.ndarray], freq_q: Dict[str, np.ndarray],
+                   cols: List[str], methods: List[str],
+                   threshold: float) -> pd.DataFrame:
+    """Vectorized metrics over padded (k, max_bins) arrays — the shared
+    tail of the in-memory and streaming drift paths (one rounding/
+    flagging policy, so the two are byte-identical given equal
+    frequencies)."""
     cols_eff = [c for c in cols if c in freq_p and c in freq_q]
     if not cols_eff:
         return pd.DataFrame(columns=["attribute"] + methods + ["flagged"])
@@ -261,8 +273,6 @@ def statistics(
     for m in methods:
         odf[m] = np.round(mets[m], 4)
     odf["flagged"] = (odf[methods] > threshold).any(axis=1).astype(int)
-    if print_impact:
-        logger.info(odf.to_string(index=False))
     return odf
 
 
@@ -401,3 +411,304 @@ def drift_device_args(
         _side_args(idf_source, num_cols, cat_cols, cuts,
                    _lut_for(idf_source, cat_cols, union_vocabs), bin_size, n_union),
     )
+
+
+# ---------------------------------------------------------------------------
+# out-of-core streaming drift (round 12): the two-pass histogram machinery
+# applied chunkwise over the prefetch iterator — source cutoffs fitted from
+# streamed global bounds (bit-identical to fit_cutoffs' equal_range tail),
+# per-chunk binned counts summed exactly, categorical counts tallied
+# host-side — so a dataset that never fits in memory produces the SAME
+# drift frame and the SAME persisted binning/frequency model, byte for
+# byte, as the in-memory path (use_sampling=False).
+# ---------------------------------------------------------------------------
+def _drift_side_host_part(df: pd.DataFrame, cat_cols: List[str]) -> dict:
+    """Host partial of one raw chunk: live row count + per-categorical
+    value counts (string-keyed, exactly the union-vocab key space the
+    in-memory LUT remap counts into)."""
+    out = {"rows": np.asarray(len(df), np.int64)}
+    for j, c in enumerate(cat_cols):
+        vc = df[c].dropna().astype(str).value_counts()
+        out[f"cat{j}_v"] = vc.index.to_numpy(dtype="U")
+        out[f"cat{j}_n"] = vc.to_numpy(np.int64)
+    return out
+
+
+def _merge_side_parts(parts: dict, cat_cols: List[str]):
+    """(total rows, per-column value Counter, moment partial list) from a
+    pass' committed partials."""
+    from collections import Counter
+
+    rows = 0
+    counters = [Counter() for _ in cat_cols]
+    for i in sorted(parts):
+        p = parts[i]
+        rows += int(p["rows"])
+        for j in range(len(cat_cols)):
+            vals = p.get(f"cat{j}_v")
+            cnts = p.get(f"cat{j}_n")
+            if vals is None:
+                continue
+            for v, n in zip(vals, cnts):
+                counters[j][str(v)] += int(n)
+    return rows, counters
+
+
+def statistics_streaming(
+    file_path: str,
+    file_type: str,
+    source_file_path: Optional[str] = None,
+    list_of_cols="all",
+    drop_cols=None,
+    method_type: str = "PSI",
+    bin_method: str = "equal_range",
+    bin_size: int = 10,
+    threshold: float = 0.1,
+    chunk_rows: int = 1_000_000,
+    file_configs: Optional[dict] = None,
+    pre_existing_source: bool = False,
+    source_save: bool = True,
+    source_path: str = "NA",
+    model_directory: str = "drift_statistics",
+    checkpoint_dir: Optional[str] = None,
+    resume: bool = False,
+    print_impact: bool = False,
+) -> pd.DataFrame:
+    """Streaming ``statistics``: drift between two part-file datasets of
+    ANY size (passes: source bounds+cat counts → source histograms →
+    target histograms; device residency O(chunk_rows·k) throughout).
+
+    Restrictions vs the in-memory path: ``bin_method`` must be
+    ``equal_range`` when fitting (equal_frequency needs exact whole-table
+    quantiles) and there is no sampling — parity target is
+    ``statistics(..., use_sampling=False)``.  With
+    ``pre_existing_source=True`` the persisted binning model and source
+    frequency CSVs are reused and only the target streams.  With
+    ``checkpoint_dir``/``resume`` every chunk of every pass commits —
+    a mid-run kill resumes re-reading only undone chunks, and a cutoff
+    shift (a quarantined source part came back) invalidates exactly the
+    histogram passes binned over the stale edges."""
+    from anovos_tpu.data_ingest.data_ingest import _resolve_files
+    from anovos_tpu.data_ingest.guard import IngestError
+    from anovos_tpu.data_ingest.prefetch import StreamController, StreamStats
+    from anovos_tpu.data_transformer.model_io import load_model_df, save_model_df
+    from anovos_tpu.ops import streaming as st
+    from anovos_tpu.ops.drift_kernels import binned_histograms, cutoffs_from_bounds
+    from anovos_tpu.shared.runtime import get_runtime
+    from anovos_tpu.shared.utils import parse_cols as _parse
+
+    methods = check_distance_method(method_type)
+    drop_cols = drop_cols or []
+    cfg = dict(file_configs or {})
+    if not pre_existing_source:
+        if source_file_path is None:
+            raise ValueError(
+                "statistics_streaming: source_file_path required unless "
+                "pre_existing_source=True")
+        if bin_method != "equal_range":
+            raise ValueError(
+                "statistics_streaming fits cutoffs from streamed global "
+                "bounds — only bin_method='equal_range' is supported "
+                "(equal_frequency needs exact whole-table quantiles)")
+    if source_path == "NA":
+        source_path = "intermediate_data"
+    model_dir = os.path.join(source_path, model_directory)
+
+    tgt_files = _resolve_files(file_path, file_type)
+    src_files = _resolve_files(source_file_path, file_type) \
+        if source_file_path else []
+    schema = st.stream_schema(tgt_files, file_type, cfg)
+    all_names = [c for c, _k in schema]
+    num_all = [c for c, k in schema if k == "num"]
+    cat_all = [c for c, k in schema if k == "cat"]
+    cols = _parse(list_of_cols if list_of_cols != "all" else num_all + cat_all,
+                  all_names, drop_cols)
+    num_cols = [c for c in cols if c in num_all]
+    cat_cols = [c for c in cols if c in cat_all]
+
+    ctl, stats = StreamController(), StreamStats()
+    ckpt = None
+    if checkpoint_dir:
+        ckpt = st.StreamCheckpoint(
+            checkpoint_dir,
+            st._stream_sig(
+                tgt_files + src_files, file_type, cols, chunk_rows, bin_size,
+                op=f"drift:{method_type}:{bin_method}:{pre_existing_source}"),
+            resume=resume)
+    # pass-scoped invalidation: source passes (1, 2) number chunks over
+    # the source files, the target pass (3) over the target files — a
+    # shift in one set must not unlink the other's intact partials.  A
+    # source shift that moves the CUTOFFS stales pass 3 too; check_bounds
+    # below owns that cross-set dependency.
+    on_rows_src = st.checkpoint_on_file_rows(ckpt, passes=(1, 2))
+    on_rows_tgt = st.checkpoint_on_file_rows(ckpt, passes=(3,))
+
+    def _skip(pass_no):
+        return ckpt.committed(pass_no) if (ckpt is not None and resume) \
+            else frozenset()
+
+    # ---- numeric cutoffs + source frequencies -----------------------------
+    union_vocabs: Dict[str, np.ndarray] = {}
+    freq_p: Dict[str, np.ndarray] = {}
+    num_cols_eff = list(num_cols)
+    cutoffs = None
+    src_rows = 0
+    src_counters = None
+    if pre_existing_source:
+        dfm = load_model_df(model_dir, "attribute_binning")
+        cut_map = {r["attribute"]: list(r["parameters"]) for _, r in dfm.iterrows()}
+        num_cols_eff = [c for c in num_cols if c in cut_map]
+        cutoffs = np.array([cut_map[c] for c in num_cols_eff], dtype=np.float64)
+    else:
+        parts1 = st._run_pass(
+            src_files, file_type, num_cols, chunk_rows, cfg,
+            pass_no=1,
+            dispatch=lambda v, m: st._chunk_stats(jnp.asarray(v), jnp.asarray(m)),
+            host_part=lambda df: _drift_side_host_part(df, cat_cols),
+            ctl=ctl, stats=stats, ckpt=ckpt, skip_chunks=_skip(1),
+            on_file_rows=on_rows_src)
+        if not parts1:
+            raise IngestError(
+                f"statistics_streaming: no readable rows in "
+                f"{len(src_files)} source part file(s)")
+        src_rows, src_counters = _merge_side_parts(parts1, cat_cols)
+        if num_cols:
+            agg = st._pairwise_merge([parts1[i] for i in sorted(parts1)])
+            cuts_full = np.asarray(cutoffs_from_bounds(
+                jnp.asarray(agg["min"], jnp.float32),
+                jnp.asarray(agg["max"], jnp.float32),
+                jnp.asarray(agg["n"], jnp.float32), bin_size))
+            cutoffs, num_cols_eff, _ = _drop_allnan_cutoffs(
+                cuts_full[: len(num_cols)], num_cols)
+        else:
+            num_cols_eff = []
+
+    # histogram passes are binned over THESE edges: a cutoff shift since
+    # the prior run (or a changed model) stales every committed histogram
+    # chunk, including ones upstream of the file that shifted
+    if ckpt is not None:
+        edges = (np.asarray(cutoffs, np.float64)
+                 if cutoffs is not None and len(num_cols_eff)
+                 else np.zeros((0, max(bin_size - 1, 1))))
+        ckpt.check_bounds(edges.astype(np.float32),
+                          np.asarray([bin_size], np.float32),
+                          passes=(3,) if pre_existing_source else (2, 3))
+
+    cuts_pad = None
+    k_pad = 0
+    if num_cols_eff:
+        k_pad = get_runtime().pad_cols(len(num_cols_eff))
+        cuts_pad = np.full((k_pad, bin_size - 1), np.nan, np.float32)
+        cuts_pad[: len(num_cols_eff)] = np.asarray(cutoffs, np.float32)
+
+    def _hist_dispatch(v, m):
+        return {"hist": binned_histograms(
+            jnp.asarray(v), jnp.asarray(m), jnp.asarray(cuts_pad), bin_size)}
+
+    def _sum_hists(parts) -> Optional[np.ndarray]:
+        if not parts:
+            return None
+        out = None
+        for i in sorted(parts):
+            h = parts[i]["hist"].astype(np.float32)
+            out = h if out is None else out + h
+        return out
+
+    # ---- source histograms (fresh fit only) -------------------------------
+    if not pre_existing_source:
+        if num_cols_eff:
+            parts2 = st._run_pass(
+                src_files, file_type, num_cols_eff, chunk_rows, cfg,
+                pass_no=2, dispatch=_hist_dispatch,
+                ctl=ctl, stats=stats, ckpt=ckpt, skip_chunks=_skip(2),
+                on_file_rows=on_rows_src)
+            src_num = _sum_hists(parts2)[: len(num_cols_eff)]
+        else:
+            src_num = None
+
+    # ---- target pass ------------------------------------------------------
+    parts3 = st._run_pass(
+        tgt_files, file_type, num_cols_eff, chunk_rows, cfg,
+        pass_no=3,
+        dispatch=_hist_dispatch if num_cols_eff else (lambda v, m: {}),
+        host_part=lambda df: _drift_side_host_part(df, cat_cols),
+        ctl=ctl, stats=stats, ckpt=ckpt, skip_chunks=_skip(3),
+        on_file_rows=on_rows_tgt)
+    if not parts3:
+        raise IngestError(
+            f"statistics_streaming: no readable rows in {len(tgt_files)} "
+            "target part file(s)")
+    count_target, tgt_counters = _merge_side_parts(parts3, cat_cols)
+    tgt_num = _sum_hists(parts3) if num_cols_eff else None
+    if tgt_num is not None:
+        tgt_num = tgt_num[: len(num_cols_eff)]
+    # counters keyed by NAME: cat_cols is re-filtered below (columns with
+    # no persisted source frequencies drop out), which would shift
+    # positional indexing
+    tgt_cnt = {c: tgt_counters[j] for j, c in enumerate(cat_cols)}
+    src_cnt = ({c: src_counters[j] for j, c in enumerate(cat_cols)}
+               if src_counters is not None else {})
+
+    # ---- union vocabularies + frequencies ---------------------------------
+    freq_q: Dict[str, np.ndarray] = {}
+    if pre_existing_source:
+        for c in cols:
+            path = os.path.join(model_dir, "frequency_counts", c, "part-00000.csv")
+            if not os.path.exists(path):
+                warnings.warn(
+                    f"drift statistics: no persisted source frequencies for {c}; skipping")
+                continue
+            f = pd.read_csv(path, dtype=str)
+            kcol = f.columns[0]
+            smap = dict(zip(f[kcol].astype(str), f["p"].astype(float)))
+            if c in num_cols_eff:
+                freq_p[c] = np.array([smap.get(str(k), 0.0) for k in range(1, bin_size + 1)])
+            elif c in cat_cols:
+                uni = np.array(sorted(set(smap) | set(tgt_cnt[c])), dtype=object)
+                union_vocabs[c] = uni
+                freq_p[c] = np.array([smap.get(str(v), 0.0) for v in uni])
+        cat_cols = [c for c in cat_cols if c in union_vocabs]
+    else:
+        for c in cat_cols:
+            union_vocabs[c] = np.array(
+                sorted(set(src_cnt[c]) | set(tgt_cnt[c])), dtype=object)
+        if cutoffs is not None and len(num_cols_eff):
+            save_model_df(
+                pd.DataFrame(
+                    {"attribute": num_cols_eff,
+                     "parameters": [list(map(float, c)) for c in cutoffs]}),
+                model_dir,
+                "attribute_binning",
+            )
+        for i, c in enumerate(num_cols_eff):
+            freq_p[c] = src_num[i] / max(src_rows, 1)
+        for c in cat_cols:
+            cnt = src_cnt[c]
+            freq_p[c] = np.array(
+                [cnt.get(str(v), 0) for v in union_vocabs[c]],
+                np.float32) / max(src_rows, 1)
+        if source_save:
+            for c in num_cols_eff + cat_cols:
+                d = os.path.join(model_dir, "frequency_counts", c)
+                os.makedirs(d, exist_ok=True)
+                keys = (
+                    list(range(1, bin_size + 1)) if c in num_cols_eff
+                    else list(union_vocabs[c])
+                )
+                pd.DataFrame({c: keys, "p": freq_p[c]}).to_csv(
+                    os.path.join(d, "part-00000.csv"), index=False
+                )
+
+    for i, c in enumerate(num_cols_eff):
+        freq_q[c] = tgt_num[i] / max(count_target, 1)
+    for c in cat_cols:
+        cnt = tgt_cnt[c]
+        freq_q[c] = np.array(
+            [cnt.get(str(v), 0) for v in union_vocabs[c]],
+            np.float32) / max(count_target, 1)
+
+    odf = _metrics_frame(freq_p, freq_q, cols, methods, threshold)
+    st._publish_stats("drift_statistics_streaming", ctl, stats)
+    if print_impact:
+        logger.info(odf.to_string(index=False))
+    return odf

@@ -74,10 +74,12 @@ __all__ = [
     "enabled",
     "reset",
     "current_node",
+    "current_frame",
     "node_bracket",
     "dispatch_bracket",
     "transfer_bracket",
     "record_transfer",
+    "record_decode",
     "results",
     "active_frames",
 ]
@@ -109,6 +111,7 @@ def enabled() -> bool:
 class _Frame:
     __slots__ = ("name", "t0", "dispatch_s", "transfer_s", "device_s",
                  "h2d_bytes", "d2h_bytes", "dispatches", "transfers",
+                 "decode_s", "decode_bytes", "decodes",
                  "last_op", "hbm0", "lane", "devices", "_lock")
 
     def __init__(self, name: str, lane: Optional[str] = None,
@@ -128,6 +131,17 @@ class _Frame:
         self.d2h_bytes = 0
         self.dispatches = 0
         self.transfers = 0
+        # streaming-ingest decode attribution (round 12): host wall spent
+        # DECODING part files (pyarrow/pandas) vs merely consuming them.
+        # Both were lumped into the host_s remainder before; the split is
+        # what the AUTOTUNE window controller steers on.  Decode booked
+        # from prefetch-pool worker threads can OVERLAP the node wall, so
+        # decode_s is reported as an informational sub-attribution and
+        # deliberately stays OUT of the clamped device+dispatch+transfer
+        # +host ≤ wall invariant.
+        self.decode_s = 0.0
+        self.decode_bytes = 0
+        self.decodes = 0
         self.last_op: Optional[str] = None
         self.hbm0 = _hbm_in_use()
         # transfer/dispatch hooks fire from the node's worker thread, but
@@ -154,6 +168,13 @@ class _Frame:
             # process-wide transfer_d2d_bytes_total counter
             self.last_op = label
 
+    def add_decode(self, seconds: float, nbytes: int, label: str) -> None:
+        with self._lock:
+            self.decode_s += seconds
+            self.decode_bytes += nbytes
+            self.decodes += 1
+            self.last_op = label
+
     def snapshot(self) -> dict:
         """In-flight view (flight-recorder dumps read this mid-node)."""
         with self._lock:
@@ -165,6 +186,8 @@ class _Frame:
                 "transfer_s": round(self.transfer_s, 4),
                 "h2d_bytes": self.h2d_bytes,
                 "d2h_bytes": self.d2h_bytes,
+                "decode_s": round(self.decode_s, 4),
+                "decode_bytes": self.decode_bytes,
                 "last_op": self.last_op,
             }
 
@@ -215,6 +238,13 @@ class _Frame:
             "last_op": self.last_op,
             "clamped": clamped,
         }
+        if self.decodes:
+            # informational sub-attribution (see __init__): under a prefetch
+            # pool the decode wall runs on background threads and may exceed
+            # the host_s remainder — it measures decode WORK, not node wall
+            out["decode_s"] = round(self.decode_s, 6)
+            out["decode_bytes"] = self.decode_bytes
+            out["decodes"] = self.decodes
         if self.lane is not None:
             out["lane"] = self.lane
             out["devices"] = list(self.devices)
@@ -418,6 +448,41 @@ def transfer_bracket(direction: str, nbytes: int, label: str = ""):
                             time.perf_counter() - t0, label)
         except Exception:
             logger.exception("devprof transfer record failed")
+
+
+def record_decode(seconds: float, nbytes: int, label: str = "decode",
+                  frame=None) -> None:
+    """Book one part-file decode (wall + input bytes).
+
+    ``frame`` lets prefetch-pool WORKER threads attribute their decode to
+    the CONSUMING node's frame (captured via :func:`current_frame` when
+    the pool was created — the pool threads themselves carry no
+    thread-local frame, the async-writer situation all over again).
+    Honors the ``ANOVOS_TPU_DEVPROF=0`` off switch like every bracket."""
+    if not enabled():
+        return
+    try:
+        reg = get_metrics()
+        reg.counter(
+            "stream_decode_seconds_total",
+            "host wall spent decoding part files in streaming passes",
+        ).inc(seconds)
+        reg.counter(
+            "stream_decode_bytes_total",
+            "part-file bytes decoded in streaming passes",
+        ).inc(nbytes)
+    except Exception:
+        logger.exception("devprof decode record failed")
+    fr = frame if frame is not None else getattr(_TL, "frame", None)
+    if fr is not None:
+        fr.add_decode(seconds, int(nbytes), label)
+
+
+def current_frame():
+    """The in-flight devprof frame of THIS thread (None outside a node
+    bracket or with devprof disabled).  Prefetch pools capture it at
+    construction so worker-thread decode books to the consuming node."""
+    return getattr(_TL, "frame", None)
 
 
 def current_node() -> "Optional[str]":

@@ -175,6 +175,27 @@ def fit_cutoffs(
     lo = jnp.where(M, X, big).min(axis=0)
     hi = jnp.where(M, X, -big).max(axis=0)
     n = M.sum(axis=0)
+    return _equal_range_cuts(lo, hi, n, nbins)
+
+
+def _equal_range_cuts(lo: jax.Array, hi: jax.Array, n: jax.Array,
+                      nbins: int) -> jax.Array:
+    """The equal_range cutoff arithmetic, shared so the streaming fit
+    (global min/max merged across chunks — exact, order-independent)
+    reproduces ``fit_cutoffs`` bit-for-bit."""
     width = (hi - lo) / nbins
     cuts = lo[:, None] + jnp.arange(1, nbins, dtype=jnp.float32)[None, :] * width[:, None]
     return jnp.where(n[:, None] > 0, cuts, jnp.nan)
+
+
+@functools.partial(jax.jit, static_argnames=("nbins",))
+def cutoffs_from_bounds(lo: jax.Array, hi: jax.Array, n: jax.Array,
+                        nbins: int) -> jax.Array:
+    """Interior equal_range cutoffs from already-reduced per-column
+    bounds: the out-of-core fit.  ``lo``/``hi`` are the streamed global
+    f32 min/max (identical values to the in-memory reduction — min/max
+    are exact under any merge order), ``n`` the valid counts; the cut
+    arithmetic is the exact ``fit_cutoffs`` tail, so a streaming drift
+    run persists byte-identical binning models."""
+    return _equal_range_cuts(lo.astype(jnp.float32), hi.astype(jnp.float32),
+                             n, nbins)

@@ -24,8 +24,16 @@ is RESUMABLE: with ``checkpoint_dir`` set, each drained chunk's partial
 statistics commit (tmp+rename ``.npz``) and journal ``chunk_begin`` /
 ``chunk_commit`` WAL events; ``resume=True`` after a mid-stream crash
 re-reads only the files still feeding undone chunks and recomputes
-nothing that committed.  The backpressure window is configurable via
-``ANOVOS_STREAM_INFLIGHT`` (default 4).
+nothing that committed.
+
+Round 12 made the pipeline ASYNCHRONOUS: part decode runs in a bounded
+background pool (``data_ingest.prefetch.DecodePool``) that stages
+host-ready frames ahead of the consumer, and the in-flight window is
+AUTOTUNED (``ANOVOS_STREAM_INFLIGHT=auto``, the default) from the
+per-chunk decode-vs-drain split; an integer value pins the round-10
+behavior.  ``ANOVOS_STREAM_DECODE_WORKERS=0`` restores the fully
+synchronous pipeline (artifacts are identical either way — assembly is
+ordered and the drain FIFO).
 """
 
 from __future__ import annotations
@@ -33,8 +41,9 @@ from __future__ import annotations
 import functools
 import json
 import os
+import time
 from collections import deque
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,20 +51,32 @@ import numpy as np
 import pandas as pd
 
 from anovos_tpu.data_ingest.guard import IngestError, policy_from_env, raw_reader
+from anovos_tpu.data_ingest.prefetch import DecodePool, StreamController, StreamStats
 from anovos_tpu.obs import timed
 
+# the most recent streaming pass' instrumentation (bench + tooling read
+# it after a call; pure telemetry, never an input).  Lock-guarded:
+# concurrently scheduled streaming nodes (the aside fan-out) race the
+# rebind otherwise.
+import threading as _threading
 
-def _inflight_chunks() -> int:
-    """Streaming backpressure: how many chunks may be dispatched-but-
-    undrained at once — deep enough to overlap upload/compute/download,
-    shallow enough that device residency stays O(window·chunk_rows·k).
-    ``ANOVOS_STREAM_INFLIGHT`` replaces the former hardcoded 4; the
-    device-residency bound at any window is pinned by
-    tests/test_ingest_guard.py."""
-    try:
-        return max(1, int(os.environ.get("ANOVOS_STREAM_INFLIGHT", "4") or 4))
-    except ValueError:
-        return 4
+_LAST_STREAM: Dict[str, object] = {}
+_LAST_STREAM_LOCK = _threading.Lock()
+
+
+def last_stream_summary() -> dict:
+    """Decode/overlap instrumentation of the most recent streaming call
+    in this process (``e2e_stream_overlap_pct``'s source)."""
+    with _LAST_STREAM_LOCK:
+        return dict(_LAST_STREAM)
+
+
+def _publish_stats(op: str, ctl: StreamController, stats: StreamStats) -> None:
+    with _LAST_STREAM_LOCK:
+        _LAST_STREAM.clear()
+        _LAST_STREAM.update({"op": op, "window": ctl.window,
+                             "workers": ctl.workers, "resizes": ctl.resizes,
+                             **stats.summary()})
 
 
 @jax.jit
@@ -133,11 +154,20 @@ def _chunk_hist(X: jax.Array, M: jax.Array, lo: jax.Array, hi: jax.Array, nbins:
     )[: k * nbins].reshape(k, nbins)
 
 
+# sentinel for host-only passes (emit=False): distinguishes "no numeric
+# block was built" from the committed-chunk skip (None)
+_NO_BLOCK = object()
+
+
 def _iter_chunks(
     files: List[str], file_type: str, cols: List[str], chunk_rows: int, cfg: dict,
     skip_chunks: frozenset = frozenset(),
     file_rows: Optional[dict] = None,
     on_file_rows=None,
+    pool: Optional[DecodePool] = None,
+    on_raw: Optional[Callable] = None,
+    stats: Optional[StreamStats] = None,
+    emit: bool = True,
 ) -> Iterator[Tuple[int, Optional[np.ndarray], Optional[np.ndarray]]]:
     """(chunk index, (chunk_rows, k_pad) float32 block, mask) triples,
     padded to constant shape.
@@ -161,23 +191,63 @@ def _iter_chunks(
     True when that count DIFFERS from the prior run's record (a
     transiently-failing part came back, or a good one went bad) — chunk
     contents from ``at_chunk`` on have shifted, the caller invalidated
-    its committed partials, and the local skip set forgets them too."""
-    from anovos_tpu.data_ingest.data_ingest import read_host_frame
-    from anovos_tpu.shared.runtime import get_runtime
+    its committed partials, and the local skip set forgets them too
+    (``pool.cancel_skip_plan`` then voids any planned decode skips).
 
-    k_pad = get_runtime().pad_cols(len(cols))
+    Round 12: with ``pool`` set, decode is PREFETCHED — the pool's
+    workers stage frames ahead through the same guarded per-part read,
+    and this generator merely assembles them in file order (quarantine /
+    raise / reconcile / sanitize semantics byte-identical).  ``on_raw``
+    receives each non-skipped chunk's raw frame slice (host-side
+    consumers: categorical counts, row tallies).  ``stats`` collects the
+    decode/fetch-wait split the AUTOTUNE controller steers on."""
+    from anovos_tpu.obs import devprof
+
+    def _fetch(fi: int, f: str) -> pd.DataFrame:
+        if pool is not None:
+            return pool.fetch(fi, f)
+        # synchronous decode on the consuming thread: meter it so devprof
+        # can split host time into decode vs consume (the whole decode
+        # wall is also consumer wait — there is nothing to overlap with)
+        from anovos_tpu.data_ingest.data_ingest import read_host_frame
+
+        t0 = time.perf_counter()
+        try:
+            return read_host_frame([f], file_type, cfg)
+        finally:
+            dt = time.perf_counter() - t0
+            try:
+                nbytes = os.path.getsize(f)
+            except OSError:
+                nbytes = 0
+            devprof.record_decode(dt, nbytes, label=os.path.basename(f))
+            if stats is not None:
+                stats.add_decode(dt, nbytes)
+                stats.add_fetch_wait(dt)
+
     buf: List[pd.DataFrame] = []
     nbuf = 0
     idx = 0  # next chunk index to yield; buffer holds rows idx*chunk_rows + ...
 
-    def _emit(df: pd.DataFrame):
-        vals = df[cols].to_numpy(np.float32, na_value=np.nan)
-        mask = ~np.isnan(vals)
-        out_v = np.zeros((chunk_rows, k_pad), np.float32)
-        out_m = np.zeros((chunk_rows, k_pad), bool)
-        out_v[: len(vals), : len(cols)] = np.where(mask, vals, 0)
-        out_m[: len(vals), : len(cols)] = mask
-        return out_v, out_m
+    if emit:
+        from anovos_tpu.shared.runtime import get_runtime
+
+        k_pad = get_runtime().pad_cols(len(cols))
+
+        def _emit(df: pd.DataFrame):
+            vals = df[cols].to_numpy(np.float32, na_value=np.nan)
+            mask = ~np.isnan(vals)
+            out_v = np.zeros((chunk_rows, k_pad), np.float32)
+            out_m = np.zeros((chunk_rows, k_pad), bool)
+            out_v[: len(vals), : len(cols)] = np.where(mask, vals, 0)
+            out_m[: len(vals), : len(cols)] = mask
+            return out_v, out_m
+    else:
+        # host-only pass (emit=False): the consumer reads raw frames via
+        # on_raw — building the padded float block per chunk would be
+        # ~chunk_rows·k_pad·5 bytes of pure waste in a decode-bound pass
+        def _emit(df: pd.DataFrame):
+            return _NO_BLOCK, _NO_BLOCK
 
     for fi, f in enumerate(files):
         known = (file_rows or {}).get(f)
@@ -192,7 +262,7 @@ def _iter_chunks(
                 idx = hi + 1
                 continue
         try:
-            df = read_host_frame([f], file_type, cfg)
+            df = _fetch(fi, f)
         except IngestError:
             if policy_from_env().on_corrupt == "raise":
                 # fail-fast policy: nothing was quarantined or recorded —
@@ -204,9 +274,13 @@ def _iter_chunks(
             # chunk boundaries simply shift up by the lost rows
             if on_file_rows is not None and on_file_rows(f, 0, idx):
                 skip_chunks = frozenset(c for c in skip_chunks if c < idx)
+                if pool is not None:
+                    pool.cancel_skip_plan()
             continue
         if on_file_rows is not None and on_file_rows(f, len(df), idx):
             skip_chunks = frozenset(c for c in skip_chunks if c < idx)
+            if pool is not None:
+                pool.cancel_skip_plan()
         buf.append(df)
         nbuf += len(df)
         while nbuf >= chunk_rows:
@@ -214,7 +288,10 @@ def _iter_chunks(
             if idx in skip_chunks:
                 yield idx, None, None
             else:
-                v, m = _emit(cat.iloc[:chunk_rows])
+                chunk = cat.iloc[:chunk_rows]
+                if on_raw is not None:
+                    on_raw(idx, chunk)
+                v, m = _emit(chunk)
                 yield idx, v, m
             idx += 1
             rest = cat.iloc[chunk_rows:]
@@ -224,6 +301,8 @@ def _iter_chunks(
         if idx in skip_chunks:
             yield idx, None, None
         else:
+            if on_raw is not None:
+                on_raw(idx, cat)
             v, m = _emit(cat)
             yield idx, v, m
 
@@ -238,6 +317,58 @@ def _read_schema_numeric_raw(f: str) -> List[str]:
         fld.name for fld in pq.read_schema(f)
         if pat.is_integer(fld.type) or pat.is_floating(fld.type) or pat.is_decimal(fld.type)
     ]
+
+
+@raw_reader
+def _read_schema_kinds_raw(f: str) -> List[Tuple[str, str]]:
+    """RAW parquet schema read: every column with its coarse kind
+    (``num`` | ``cat`` | ``other``) — guarded callers only."""
+    import pyarrow.parquet as pq
+    import pyarrow.types as pat
+
+    out = []
+    for fld in pq.read_schema(f):
+        if pat.is_integer(fld.type) or pat.is_floating(fld.type) or pat.is_decimal(fld.type):
+            kind = "num"
+        elif pat.is_string(fld.type) or pat.is_large_string(fld.type):
+            kind = "cat"
+        else:
+            kind = "other"
+        out.append((fld.name, kind))
+    return out
+
+
+def stream_schema(files: List[str], file_type: str,
+                  cfg: Optional[dict] = None) -> List[Tuple[str, str]]:
+    """[(column, num|cat|other)] of a part-file dataset WITHOUT reading
+    row data: the parquet footer of the first readable part (a corrupt
+    head part quarantines and the next one is asked).  Non-self-describing
+    formats decode one head part — the one synchronous read the streaming
+    consumers are allowed (see graftcheck GC014's schema-probe exemption)."""
+    from anovos_tpu.data_ingest.guard import guarded_part_read
+
+    if file_type == "parquet":
+        for f in files:
+            kinds = guarded_part_read(
+                f, lambda f=f: _read_schema_kinds_raw(f),
+                file_type="parquet", stage="schema")
+            if kinds is not None:
+                return kinds
+        raise IngestError(
+            f"no parquet part with a readable footer among {len(files)} file(s)")
+    from anovos_tpu.data_ingest.data_ingest import read_host_frame
+
+    head = read_host_frame(files[:1], file_type, dict(cfg or {}))
+    out = []
+    for c in head.columns:
+        if pd.api.types.is_numeric_dtype(head[c]):
+            kind = "num"
+        elif head[c].dtype == object or str(head[c].dtype) in ("string", "str"):
+            kind = "cat"
+        else:
+            kind = "other"
+        out.append((str(c), kind))
+    return out
 
 
 def _parquet_numeric_cols(files: List[str]) -> List[str]:
@@ -275,11 +406,15 @@ class StreamCheckpoint:
     def __init__(self, root: str, sig: str, resume: bool = False):
         from anovos_tpu.cache.journal import RunJournal
 
+        from collections import defaultdict
+
         self.root = os.path.abspath(root)
         self.sig = sig
         os.makedirs(self.root, exist_ok=True)
         self.file_rows: Dict[str, int] = {}
-        self._committed: Dict[int, set] = {1: set(), 2: set()}
+        # pass number -> committed chunk indices; passes are whatever the
+        # consumer uses (describe: 1/2, drift: 1/2/3, quality: 1)
+        self._committed: Dict[int, set] = defaultdict(set)
         mpath = os.path.join(self.root, self.MANIFEST)
         prior = None
         if os.path.exists(mpath):
@@ -297,9 +432,10 @@ class StreamCheckpoint:
                 self.file_rows = dict(prior.get("file_rows", {}))
                 # the .npz on disk is the durability point: trust files,
                 # not the manifest's (possibly stale) committed list
-                for p in (1, 2):
+                for pk, idxs in (prior.get("committed", {}) or {}).items():
+                    p = int(pk)
                     self._committed[p] = {
-                        i for i in prior.get("committed", {}).get(str(p), [])
+                        i for i in idxs
                         if os.path.exists(self._part_path(p, i))
                     }
         elif prior is not None:
@@ -344,11 +480,18 @@ class StreamCheckpoint:
             n += 1
         return n
 
-    def invalidate_from(self, idx: int) -> None:
-        """Drop every committed chunk at/after ``idx``, both passes: a
-        file's decoded row count changed since the prior run, so the
-        prior partials from there on describe different row ranges."""
-        dropped = self._drop_committed(1, idx) + self._drop_committed(2, idx)
+    def invalidate_from(self, idx: int,
+                        passes: Optional[Tuple[int, ...]] = None) -> None:
+        """Drop every committed chunk at/after ``idx``: a file's decoded
+        row count changed since the prior run, so the prior partials from
+        there on describe different row ranges.  ``passes`` scopes the
+        drop to the passes that stream THAT file set — drift's target
+        pass numbers chunks over different files than its source passes,
+        and a target shift must not unlink intact source partials
+        (``None`` = all passes, the single-file-set default)."""
+        dropped = sum(self._drop_committed(p, idx)
+                      for p in sorted(passes if passes is not None
+                                      else self._committed))
         if dropped:
             import logging
 
@@ -360,15 +503,17 @@ class StreamCheckpoint:
                                 from_chunk=idx, dropped=dropped)
             self._flush_manifest()
 
-    def check_bounds(self, lo: np.ndarray, hi: np.ndarray) -> None:
-        """Pass-2 partials are histogram counts binned over pass 1's
-        ``[lo, hi]``: if those bounds differ from the prior run's (any
+    def check_bounds(self, lo: np.ndarray, hi: np.ndarray,
+                     passes: Tuple[int, ...] = (2,)) -> None:
+        """Partials of ``passes`` are histogram counts binned over pass
+        1's derived edges (describe: ``[lo, hi]``; drift: the fitted
+        cutoff matrix): if those differ from the prior run's (any
         surviving row changed — e.g. a quarantined part came back),
-        EVERY committed pass-2 chunk was binned over different bucket
-        edges and must recompute — including chunks upstream of the
-        shift point, which ``invalidate_from`` alone keeps.  Bit-exact
-        equality is the right test: identical surviving rows reduce to
-        identical f32 bounds deterministically."""
+        EVERY committed chunk of those passes was binned over different
+        bucket edges and must recompute — including chunks upstream of
+        the shift point, which ``invalidate_from`` alone keeps.
+        Bit-exact equality is the right test: identical surviving rows
+        reduce to identical f32 bounds deterministically."""
         bpath = os.path.join(self.root, "pass2_bounds.npz")
         prior = None
         if os.path.exists(bpath):
@@ -381,10 +526,11 @@ class StreamCheckpoint:
                 and np.array_equal(prior[0], lo) and np.array_equal(prior[1], hi))
         if same:
             return
-        dropped = self._drop_committed(2, 0)
+        dropped = sum(self._drop_committed(p, 0) for p in passes)
         if dropped:
             self.journal.append("chunks_invalidated", stream=self.sig[:16],
-                                from_chunk=0, dropped=dropped, phase=2)
+                                from_chunk=0, dropped=dropped,
+                                phase=passes[0])
             self._flush_manifest()
         tmp = bpath + ".tmp.npz"
         with open(tmp, "wb") as f:
@@ -428,11 +574,11 @@ class StreamCheckpoint:
 
 
 def _stream_sig(files: List[str], file_type: str, cols: List[str],
-                chunk_rows: int, nbins: int) -> str:
-    """Identity of one streaming computation: the exact file set (stat
-    signatures — same policy as cache.fingerprint.dataset_fingerprint)
-    and the chunking/binning parameters.  Any change invalidates
-    checkpointed progress wholesale."""
+                chunk_rows: int, nbins: int, op: str = "describe") -> str:
+    """Identity of one streaming computation: the operation, the exact
+    file set (stat signatures — same policy as
+    cache.fingerprint.dataset_fingerprint) and the chunking/binning
+    parameters.  Any change invalidates checkpointed progress wholesale."""
     from anovos_tpu.cache.fingerprint import digest
 
     sigs = []
@@ -442,7 +588,132 @@ def _stream_sig(files: List[str], file_type: str, cols: List[str],
             sigs.append(f"{f}:{st.st_size}:{st.st_mtime_ns}")
         except OSError:
             sigs.append(f"{f}:gone")
-    return digest(file_type, ",".join(cols), str(chunk_rows), str(nbins), *sigs)
+    return digest(op, file_type, ",".join(cols), str(chunk_rows), str(nbins),
+                  *sigs)
+
+
+def checkpoint_on_file_rows(ckpt: Optional["StreamCheckpoint"],
+                            passes: Optional[Tuple[int, ...]] = None):
+    """The standard ``on_file_rows`` hook for a checkpointed stream: a
+    readability change shifts every downstream chunk, so the checkpoint
+    drops the prior partials (they recompute) and the iterator's local
+    skip set forgets them.  ``passes`` scopes the invalidation to the
+    passes whose chunk indices are numbered over THIS hook's file set
+    (multi-file-set streams like drift pass it explicitly)."""
+    if ckpt is None:
+        return None
+
+    def _on_file_rows(path, n, at_chunk):
+        if ckpt.record_file_rows(path, n):
+            ckpt.invalidate_from(at_chunk, passes=passes)
+            return True
+        return False
+
+    return _on_file_rows
+
+
+def _open_pool(files: List[str], file_type: str, cfg: dict,
+               ctl: StreamController, stats: StreamStats,
+               ckpt: Optional["StreamCheckpoint"],
+               skip_chunks: frozenset, chunk_rows: int) -> Optional[DecodePool]:
+    """A decode pool for one pass (None when decode is pinned synchronous).
+    Resume-planned files are excluded from speculation so a resumed run
+    re-reads exactly what the synchronous pipeline would."""
+    if ctl.workers <= 0:
+        return None
+    from anovos_tpu.data_ingest.prefetch import plan_file_skips
+
+    plan = frozenset()
+    if ckpt is not None and skip_chunks:
+        plan = plan_file_skips(files, ckpt.file_rows, skip_chunks, chunk_rows)
+    return DecodePool(files, file_type, cfg, ctl, skip_plan=plan, stats=stats,
+                      journal=ckpt.journal if ckpt is not None else None)
+
+
+def _run_pass(
+    files: List[str], file_type: str, cols: List[str], chunk_rows: int,
+    cfg: dict, *,
+    pass_no: int,
+    dispatch: Callable,
+    ctl: StreamController,
+    stats: StreamStats,
+    ckpt: Optional["StreamCheckpoint"] = None,
+    skip_chunks: frozenset = frozenset(),
+    on_file_rows=None,
+    host_part: Optional[Callable] = None,
+    need_block: bool = True,
+) -> Dict[int, Dict[str, np.ndarray]]:
+    """One windowed streaming pass: prefetch-fed chunks dispatched to
+    ``dispatch(v, m) -> {name: device array}`` and drained a WINDOW
+    behind (upload/compute overlap under the documented
+    O(window·chunk_rows·k) residency bound), optionally joined with
+    ``host_part(raw_frame) -> {name: np array}`` host-side partials
+    (categorical counts, row tallies) and committed per chunk to the
+    checkpoint.  Returns {chunk idx: host partial} — committed chunks of
+    a resumed run load from disk without decode or device compute.
+
+    The AUTOTUNE controller observes each chunk's consumer-side split
+    (blocked-on-decode vs blocked-on-drain) and resizes the window /
+    decode worker pool live; artifacts are invariant to both knobs."""
+    pool = _open_pool(files, file_type, cfg, ctl, stats, ckpt,
+                      skip_chunks, chunk_rows)
+    pending: deque = deque()
+    parts: Dict[int, Dict[str, np.ndarray]] = {}
+    raw_parts: Dict[int, Dict[str, np.ndarray]] = {}
+    t_pass = time.perf_counter()
+    last_drain_t = t_pass
+
+    def _drain_oldest():
+        nonlocal last_drain_t
+        i, dev, host = pending.popleft()
+        t0 = time.perf_counter()
+        # deliberate bounded-window download: the tiny per-chunk partial
+        # must materialize to merge (and to commit, when checkpointed) —
+        # the window keeps uploads/compute overlapped ahead of this sync
+        part = {k: np.asarray(s) for k, s in dev.items()}  # graftcheck: disable=GC001
+        now = time.perf_counter()
+        stats.add_drain_wait(now - t0)
+        if host:
+            part.update(host)
+        parts[i] = part
+        if ckpt is not None:
+            ckpt.commit(pass_no, i, part)
+        stats.chunks += 1
+        fetch_w, drain_w = stats.take_chunk_signals()
+        ctl.observe(fetch_w, drain_w, now - last_drain_t)
+        last_drain_t = now
+        if pool is not None:
+            pool.maybe_grow()
+
+    on_raw = None
+    if host_part is not None:
+        def on_raw(idx, frame):
+            raw_parts[idx] = host_part(frame)
+
+    try:
+        for idx, v, m in _iter_chunks(
+                files, file_type, cols, chunk_rows, cfg,
+                skip_chunks=skip_chunks,
+                file_rows=ckpt.file_rows if ckpt is not None else None,
+                on_file_rows=on_file_rows,
+                pool=pool, on_raw=on_raw, stats=stats, emit=need_block):
+            if v is None:
+                parts[idx] = ckpt.load(pass_no, idx)
+                continue
+            if ckpt is not None:
+                ckpt.begin(pass_no, idx)
+            dev = {} if v is _NO_BLOCK else dispatch(v, m)
+            pending.append((idx, dev, raw_parts.pop(idx, None)))
+            stats.high_water = max(stats.high_water, len(pending))
+            while len(pending) >= max(1, ctl.window):
+                _drain_oldest()
+        while pending:
+            _drain_oldest()
+    finally:
+        if pool is not None:
+            pool.close()
+        stats.wall_s = round(stats.wall_s + time.perf_counter() - t_pass, 4)
+    return parts
 
 
 @timed("ops.describe_streaming")
@@ -477,8 +748,7 @@ def describe_streaming(
     sums are integer-valued f32 in the same order, so the results are
     identical.
     """
-    from anovos_tpu.data_ingest.data_ingest import _resolve_files, read_host_frame
-    from anovos_tpu.data_ingest.guard import guarded_part_read
+    from anovos_tpu.data_ingest.data_ingest import _resolve_files
     from anovos_tpu.obs import get_metrics
 
     cfg = dict(file_configs or {})
@@ -490,13 +760,14 @@ def describe_streaming(
             # asked (the stream itself will quarantine it again for data)
             list_of_cols = _parquet_numeric_cols(files)
         else:
-            head = read_host_frame(files[:1], file_type, cfg)
-            list_of_cols = [c for c in head.columns if pd.api.types.is_numeric_dtype(head[c])]
+            list_of_cols = [c for c, k in stream_schema(files, file_type, cfg)
+                            if k == "num"]
     cols = list(list_of_cols)
     if not cols:
         raise ValueError("describe_streaming: no numeric columns")
 
-    window = _inflight_chunks()
+    ctl = StreamController()
+    stats = StreamStats()
     inflight_gauge = get_metrics().gauge(
         "stream_inflight_high_water",
         "max dispatched-but-undrained chunks (device-residency bound)")
@@ -508,53 +779,25 @@ def describe_streaming(
             resume=resume,
         )
 
-    # dispatch each chunk's moment program as it streams in and drain the
-    # (tiny) per-chunk partials a WINDOW behind: fetching inside the loop
-    # blocked chunk k+1's upload behind chunk k's download (graftcheck
-    # GC001), while dispatching everything unsynchronized would let the
-    # host read-loop run ahead and keep every chunk's input buffers
-    # resident at once — the window keeps the documented O(chunk_rows·k)
-    # device bound AND the upload/compute overlap.  The f64 pairwise merge
-    # stays on host by design (Chan et al.)
-    pending: "deque" = deque()
-    parts: dict = {}  # chunk idx -> host partial (resume can fill out of order)
-    high_water = 0
-
-    def _drain_oldest():
-        i, p = pending.popleft()
-        part = {k: np.asarray(s) for k, s in p.items()}
-        parts[i] = part
-        if ckpt is not None:
-            ckpt.commit(1, i, part)
-
-    if ckpt is not None:
-        def _on_file_rows(path, n, at_chunk):
-            # a readability change shifts every downstream chunk: the
-            # checkpoint drops the prior partials so they recompute
-            if ckpt.record_file_rows(path, n):
-                ckpt.invalidate_from(at_chunk)
-                return True
-            return False
-    else:
-        _on_file_rows = None
+    # pass 1 rides the generic windowed pass (_run_pass): the prefetch
+    # pool stages decoded frames ahead, each chunk's moment program is
+    # dispatched as it assembles, and the (tiny) per-chunk partials drain
+    # a WINDOW behind — fetching inside the loop blocked chunk k+1's
+    # upload behind chunk k's download (graftcheck GC001), while
+    # dispatching everything unsynchronized would let the read-loop keep
+    # every chunk's input buffers resident at once.  The f64 pairwise
+    # merge stays on host by design (Chan et al.)
+    _on_file_rows = checkpoint_on_file_rows(ckpt)
 
     skip1 = ckpt.committed(1) if (ckpt is not None and resume) else frozenset()
-    for idx, v, m in _iter_chunks(
-            files, file_type, cols, chunk_rows, cfg, skip_chunks=skip1,
-            file_rows=ckpt.file_rows if ckpt is not None else None,
-            on_file_rows=_on_file_rows):
-        if v is None:
-            parts[idx] = ckpt.load(1, idx)
-            continue
-        if ckpt is not None:
-            ckpt.begin(1, idx)
-        pending.append((idx, _chunk_stats(jnp.asarray(v), jnp.asarray(m))))
-        high_water = max(high_water, len(pending))
-        if len(pending) >= window:
-            _drain_oldest()
-    while pending:
-        _drain_oldest()
-    if not parts:
+    parts = _run_pass(
+        files, file_type, cols, chunk_rows, cfg,
+        pass_no=1,
+        dispatch=lambda v, m: _chunk_stats(jnp.asarray(v), jnp.asarray(m)),
+        ctl=ctl, stats=stats, ckpt=ckpt, skip_chunks=skip1,
+        on_file_rows=_on_file_rows)
+    # host dict of already-materialized np partials — not a device value
+    if not parts:  # graftcheck: disable=GC001
         raise IngestError(
             f"describe_streaming: no readable rows in {len(files)} part "
             "file(s) (every part quarantined?)")
@@ -576,28 +819,37 @@ def describe_streaming(
         # bounds are k_pad floats — a deliberate, tiny durability read
         ckpt.check_bounds(np.asarray(lo), np.asarray(hi))  # graftcheck: disable=GC001
     skip2 = ckpt.committed(2) if (ckpt is not None and resume) else frozenset()
-    for i, v, m in _iter_chunks(
-            files, file_type, cols, chunk_rows, cfg, skip_chunks=skip2,
-            file_rows=ckpt.file_rows if ckpt is not None else None,
-            on_file_rows=_on_file_rows):
-        if v is None:
-            hist_d = hist_d + ckpt.load(2, i)["hist"]
-            continue
-        if ckpt is None:
-            hist_d = hist_d + _chunk_hist(jnp.asarray(v), jnp.asarray(m), lo, hi, nbins)
-            if i % window == window - 1:
-                jax.block_until_ready(hist_d)
-        else:
-            ckpt.begin(2, i)
-            # deliberate per-chunk download: the chunk's counts must
-            # materialize on host to COMMIT (resumability is the point);
-            # the uncheckpointed branch above keeps the device-side
-            # accumulation for the no-checkpoint fast path
-            h = np.asarray(  # graftcheck: disable=GC001
-                _chunk_hist(jnp.asarray(v), jnp.asarray(m), lo, hi, nbins))
-            ckpt.commit(2, i, {"hist": h})
-            hist_d = hist_d + h
-    inflight_gauge.set_max(float(high_water), window=str(window))
+    pool2 = _open_pool(files, file_type, cfg, ctl, stats, ckpt,
+                       skip2, chunk_rows)
+    t_pass2 = time.perf_counter()
+    try:
+        for i, v, m in _iter_chunks(
+                files, file_type, cols, chunk_rows, cfg, skip_chunks=skip2,
+                file_rows=ckpt.file_rows if ckpt is not None else None,
+                on_file_rows=_on_file_rows, pool=pool2, stats=stats):
+            if v is None:
+                hist_d = hist_d + ckpt.load(2, i)["hist"]
+                continue
+            if ckpt is None:
+                hist_d = hist_d + _chunk_hist(jnp.asarray(v), jnp.asarray(m), lo, hi, nbins)
+                if (i + 1) % max(1, ctl.window) == 0:
+                    jax.block_until_ready(hist_d)
+            else:
+                ckpt.begin(2, i)
+                # deliberate per-chunk download: the chunk's counts must
+                # materialize on host to COMMIT (resumability is the point);
+                # the uncheckpointed branch above keeps the device-side
+                # accumulation for the no-checkpoint fast path
+                h = np.asarray(  # graftcheck: disable=GC001
+                    _chunk_hist(jnp.asarray(v), jnp.asarray(m), lo, hi, nbins))
+                ckpt.commit(2, i, {"hist": h})
+                hist_d = hist_d + h
+    finally:
+        if pool2 is not None:
+            pool2.close()
+        stats.wall_s = round(stats.wall_s + time.perf_counter() - t_pass2, 4)
+    inflight_gauge.set_max(float(stats.high_water), window=ctl.label)
+    _publish_stats("describe_streaming", ctl, stats)
 
     # shared finalizer (ops/reductions.finalize_moments) — one statistical
     # policy for GSPMD, shard_map, and streaming paths alike

@@ -411,6 +411,12 @@ class _LazyTable:
             return self._table
 
 
+def _write_frame_csv(df, path: str) -> None:
+    """Async-writer body for a streaming stats frame (tiny CSV)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    df.to_csv(path, index=False)
+
+
 class _PipelineRun:
     """Per-run registrar: turns the YAML walk into scheduler nodes.
 
@@ -545,6 +551,30 @@ class _PipelineRun:
                                           on_hit=on_hit))
         self._track(writes)
 
+    def aside(self, name, fn, reads=(), writes=(), timed=None, cache_slice=None,
+              on_error=None, placement="host") -> None:
+        """``fn()`` never touches the df spine: an out-of-core node that
+        reads its OWN part files through the streaming/prefetch pipeline
+        (the table may not even exist — streaming-only runs skip ETL).
+        No ``df:N`` read is declared, so the scheduler is free to overlap
+        it with the entire spine."""
+        reads = tuple(reads)
+        placement = self._effective_placement(placement)
+
+        def body():
+            self.writer.wait(reads)
+            t0 = time.monotonic()
+            fn()
+            if timed:
+                _log_block_time(timed, t0)
+
+        self.sched.add(name, body, reads=reads, writes=tuple(writes),
+                       on_error=on_error if on_error is not None else self.fanout_policy,
+                       placement=placement,
+                       cache=self._policy(name, cache_slice, writes,
+                                          placement=placement))
+        self._track(writes)
+
     def fanout(self, name, fn, reads=(), writes=(), timed=None, cache_slice=None,
                on_error=None, placement="mesh") -> None:
         """``fn(df)`` only reads the table: pinned to the current version.
@@ -610,12 +640,19 @@ def main(
     # parts quarantined during the ETL read buffer until then
     ingest_guard.reset()
     auth_key = _auth_key(auth_key_val)
-    with get_tracer().span("input_dataset/ETL", cat="node"):
-        df = ETL(all_configs.get("input_dataset"))
+    stream_cfg = all_configs.get("streaming_analysis")
+    if all_configs.get("input_dataset") is None and stream_cfg:
+        # out-of-core mode: the dataset never materializes as a Table —
+        # every registered node is a streaming_analysis node reading its
+        # part files through the prefetch pipeline
+        df = None
+    else:
+        with get_tracer().span("input_dataset/ETL", cat="node"):
+            df = ETL(all_configs.get("input_dataset"))
     # pre-treatment ingest result, pinned ONLY when a drift_statistics spec
     # will actually reuse it (pinning unconditionally would hold the full
     # ingest-time table in memory through the whole run for nothing)
-    base_df = df if _drift_source_matches_input(all_configs) else None
+    base_df = df if (df is not None and _drift_source_matches_input(all_configs)) else None
 
     write_main = all_configs.get("write_main", None)
     write_intermediate = all_configs.get("write_intermediate", None)
@@ -1011,6 +1048,129 @@ def main(
                                    placement="mesh",
                                    cache_slice=_slice_or_none({subkey2: value2}, value2))
 
+            if key == "streaming_analysis" and args is not None:
+                # out-of-core whole-table passes (round 12): each enabled
+                # sub-analysis streams its part files through the prefetch
+                # pipeline — the table never materializes, host RSS stays
+                # bounded by the in-flight window, and every pass is
+                # chunk-checkpointed under obs/stream_ckpt so --resume
+                # re-reads only undone chunks.  Artifacts are byte-
+                # identical to the in-memory equivalents.
+                s_path = args.get("file_path")
+                if not s_path:
+                    raise TypeError("streaming_analysis requires file_path")
+                s_type = args.get("file_type", "parquet")
+                s_chunk = int(args.get("chunk_rows", 1_000_000) or 1_000_000)
+                s_fcfg = args.get("file_configs")
+                out_dir = (args.get("output_path") or report_input_path
+                           or (write_stats or {}).get("file_path")
+                           or "stream_stats")
+                ckpt_base = os.path.join(
+                    report_input_path or (write_main or {}).get("file_path")
+                    or ".", "obs", "stream_ckpt")
+                s_fp = dataset_fingerprint(
+                    {"read_dataset": {"file_path": s_path}})
+
+                if args.get("describe") is not None and args.get("describe") is not False:
+                    d_cfg = args["describe"] if isinstance(args["describe"], dict) else {}
+
+                    def _stream_describe(d_cfg=d_cfg):
+                        from anovos_tpu.ops.streaming import describe_streaming
+
+                        odf = describe_streaming(
+                            s_path, s_type, chunk_rows=s_chunk,
+                            file_configs=s_fcfg,
+                            checkpoint_dir=os.path.join(ckpt_base, "describe"),
+                            resume=resume, **d_cfg)
+                        writer.submit("stats:stream_describe", _write_frame_csv,
+                                      odf, os.path.join(out_dir, "stream_describe.csv"))
+                    pipe.aside("streaming_analysis/describe", _stream_describe,
+                               writes=("stats:stream_describe",),
+                               timed="streaming_analysis, describe",
+                               placement="device",
+                               cache_slice={"describe": d_cfg,
+                                            "chunk_rows": s_chunk,
+                                            "dataset_fp": s_fp})
+
+                if args.get("quality_missing") is not None and \
+                        args.get("quality_missing") is not False:
+                    q_cfg = args["quality_missing"] if isinstance(
+                        args["quality_missing"], dict) else {}
+
+                    def _stream_missing(q_cfg=q_cfg):
+                        from anovos_tpu.data_analyzer.quality_checker import (
+                            missing_stats_streaming)
+
+                        odf = missing_stats_streaming(
+                            s_path, s_type, chunk_rows=s_chunk,
+                            file_configs=s_fcfg,
+                            checkpoint_dir=os.path.join(ckpt_base, "quality_missing"),
+                            resume=resume, **q_cfg)
+                        writer.submit("stats:stream_missing", _write_frame_csv,
+                                      odf, os.path.join(out_dir, "stream_missing.csv"))
+                    pipe.aside("streaming_analysis/quality_missing", _stream_missing,
+                               writes=("stats:stream_missing",),
+                               timed="streaming_analysis, quality_missing",
+                               placement="host",
+                               cache_slice={"quality_missing": q_cfg,
+                                            "chunk_rows": s_chunk,
+                                            "dataset_fp": s_fp})
+
+                if args.get("quality_outlier"):
+                    o_cfg = dict(args["quality_outlier"])
+                    o_model = o_cfg.pop("model_path", None)
+                    if not o_model:
+                        raise TypeError(
+                            "streaming_analysis.quality_outlier requires "
+                            "model_path (pre-fitted outlier bounds)")
+
+                    def _stream_outlier(o_cfg=o_cfg, o_model=o_model):
+                        from anovos_tpu.data_analyzer.quality_checker import (
+                            outlier_stats_streaming)
+
+                        odf = outlier_stats_streaming(
+                            s_path, s_type, o_model, chunk_rows=s_chunk,
+                            file_configs=s_fcfg,
+                            checkpoint_dir=os.path.join(ckpt_base, "quality_outlier"),
+                            resume=resume, **o_cfg)
+                        writer.submit("stats:stream_outlier", _write_frame_csv,
+                                      odf, os.path.join(out_dir, "stream_outlier.csv"))
+                    pipe.aside("streaming_analysis/quality_outlier", _stream_outlier,
+                               writes=("stats:stream_outlier",),
+                               timed="streaming_analysis, quality_outlier",
+                               placement="device",
+                               cache_slice={"quality_outlier": o_cfg,
+                                            "chunk_rows": s_chunk,
+                                            "dataset_fp": s_fp,
+                                            "model_fp": dataset_fingerprint(
+                                                {"read_dataset": {"file_path": o_model}})})
+
+                if args.get("drift"):
+                    dr_cfg = dict(args["drift"])
+                    dr_src = dr_cfg.pop("source_file_path", None)
+
+                    def _stream_drift(dr_cfg=dr_cfg, dr_src=dr_src):
+                        from anovos_tpu.drift_stability.drift_detector import (
+                            statistics_streaming)
+
+                        odf = statistics_streaming(
+                            s_path, s_type, dr_src, chunk_rows=s_chunk,
+                            file_configs=s_fcfg,
+                            checkpoint_dir=os.path.join(ckpt_base, "drift"),
+                            resume=resume, **dr_cfg)
+                        writer.submit("stats:stream_drift", _write_frame_csv,
+                                      odf, os.path.join(out_dir, "stream_drift.csv"))
+                    pipe.aside("streaming_analysis/drift", _stream_drift,
+                               writes=("stats:stream_drift", "drift:model"),
+                               timed="streaming_analysis, drift",
+                               placement="device",
+                               cache_slice={"drift": dr_cfg,
+                                            "chunk_rows": s_chunk,
+                                            "dataset_fp": s_fp,
+                                            "source_fp": dataset_fingerprint(
+                                                {"read_dataset": {"file_path": dr_src}})})
+                continue
+
             if key == "report_preprocessing" and args is not None:
                 for subkey, value in args.items():
                     if subkey == "charts_to_objects" and value is not None:
@@ -1192,6 +1352,10 @@ def main(
         LAST_RUN_SUMMARY = summary
         logger.info(DagScheduler.format_summary(summary))
         df = pipe.current_df()
+        if df is None and (write_main or all_configs.get("write_feast_features")):
+            raise ValueError(
+                "write_main/write_feast_features require input_dataset — a "
+                "streaming-only run has no materialized table to write")
 
         # feast export adds its timestamp columns BEFORE the single final
         # write (reference :854-866); config validated up front (ref :173-182)

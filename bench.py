@@ -452,6 +452,11 @@ def e2e_cold_warm() -> dict:
             result.update(e2e_serving())
         except Exception as e:  # serving section must never sink the headline
             result["e2e_serve_error"] = str(e)[-200:]
+    if os.environ.get("BENCH_OOCORE", "1") == "1":
+        try:
+            result.update(e2e_oocore())
+        except Exception as e:  # oocore section must never sink the headline
+            result["e2e_oocore_error"] = str(e)[-200:]
     return result
 
 
@@ -490,6 +495,47 @@ def e2e_serving() -> dict:
             f"serving smoke gate failed: parity={rec.get('serve_parity_ok')} "
             f"errors={rec.get('serve_errors')}")
         print("bench: " + out["e2e_serve_error"], file=sys.stderr)
+    return out
+
+
+def e2e_oocore() -> dict:
+    """Out-of-core streaming trajectory (round 12): run the
+    ``tools/oocore_bench`` synthetic-parts workload (default 3.2M rows in
+    32 parts — BENCH_OOCORE_ROWS/PARTS override) in a fresh process so
+    peak RSS is the streaming pipeline's own, and lift wall, rows/s, the
+    RSS ceiling (the flat-RSS claim: bounded by the in-flight window,
+    not the dataset) and the measured decode/compute overlap share into
+    the round record.  ``BENCH_OOCORE=0`` skips."""
+    env = {**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS") or "cpu"}
+    for k in ("ANOVOS_TPU_CHAOS", "ANOVOS_TPU_CACHE", "XLA_FLAGS"):
+        env.pop(k, None)
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.oocore_bench", "--json"],
+        capture_output=True, text=True, env=env, timeout=E2E_TIMEOUT,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    out: dict = {}
+    rec = _last_json_line(p.stdout)
+    if rec is None:
+        out["e2e_oocore_error"] = (
+            f"oocore bench produced no result (rc={p.returncode}): "
+            + (p.stderr or p.stdout)[-160:])
+        return out
+    out["e2e_oocore_wall_s"] = rec.get("oocore_wall_s")
+    out["e2e_oocore_rows_per_s"] = rec.get("oocore_rows_per_s")
+    out["e2e_oocore_peak_rss_mb"] = rec.get("oocore_peak_rss_mb")
+    out["e2e_oocore_rows"] = rec.get("oocore_rows")
+    out["e2e_oocore_vs_inmem_ratio"] = rec.get("oocore_vs_inmem_ratio")
+    out["e2e_stream_overlap_pct"] = rec.get("stream_overlap_pct")
+    # the acceptance floor: streaming must hold ≥ 0.8× the in-memory
+    # rows/s (it measures >1× in practice — decode overlap beats the
+    # monolithic read+describe)
+    ratio = rec.get("oocore_vs_inmem_ratio")
+    if ratio is not None and ratio < 0.8:
+        out["e2e_oocore_error"] = (
+            f"streaming rows/s fell to {ratio}x of the in-memory path "
+            "(acceptance floor 0.8x)")
+        print("bench: " + out["e2e_oocore_error"], file=sys.stderr)
     return out
 
 

@@ -1,0 +1,35 @@
+"""GC014 negative fixture: the sanctioned streaming-consumer shapes —
+row data through the prefetch iterator, schema through the footer probe,
+tiny model artifacts read directly (side inputs, not the dataset)."""
+
+import pandas as pd
+
+
+def stats_pass_streaming(files, file_type, cfg, ctl, stats):
+    cols = [c for c, k in stream_schema(files, file_type, cfg) if k == "num"]
+    parts = _run_pass(files, file_type, cols, 1 << 20, cfg,
+                      pass_no=1, dispatch=lambda v, m: {},
+                      ctl=ctl, stats=stats)
+    return parts
+
+
+def drift_pass_streaming(files, model_dir):
+    # a persisted frequency model is a kilobyte side input, not a part
+    freq = pd.read_csv(model_dir + "/part-00000.csv", dtype=str)
+    with open(model_dir + "/log.txt", "w") as fh:  # write-mode open passes
+        fh.write("ok")
+    return freq
+
+
+def load_everything(files):
+    # NOT a *_streaming consumer: in-memory readers are out of this
+    # rule's scope (GC012 owns guard routing)
+    return [pd.read_parquet(f) for f in files]
+
+
+def stream_schema(files, file_type, cfg):
+    return []
+
+
+def _run_pass(*a, **k):
+    return {}

@@ -1,0 +1,29 @@
+"""GC014 positive fixture: streaming consumer bodies decoding parts
+synchronously — each call stalls the device for the full decode wall and
+silently de-overlaps the prefetched pipeline."""
+
+import gzip
+
+import pandas as pd
+import pyarrow.csv as pacsv
+
+
+def quality_pass_streaming(files, file_type, cfg):
+    totals = None
+    for f in files:
+        df = read_host_frame([f], file_type, cfg)  # sync decode in the loop
+        totals = df.notna().sum() if totals is None else totals + df.notna().sum()
+    return totals
+
+
+def hist_pass_streaming(files):
+    for f in files:
+        df = pd.read_parquet(f)  # raw part decode on the consumer thread
+        tbl = pacsv.read_csv(f)  # pyarrow CSV decode, same stall
+        with gzip.open(f, "rt") as fh:  # read-mode open of a part
+            fh.read()
+        yield df, tbl
+
+
+def read_host_frame(files, file_type, cfg):
+    return pd.DataFrame()

@@ -1,0 +1,394 @@
+"""Async prefetching input pipeline (round 12): the overlap matrix.
+
+* prefetch-vs-synchronous BYTE parity for all three streaming passes
+  (describe / quality / drift), each side in its own fresh subprocess;
+* mid-stream kill + resume UNDER PREFETCH for all three — only undone
+  chunks re-read, results identical;
+* device-residency bound pinned at window 1 and under ``auto``;
+* a quarantined part skipped THROUGH the pool (worker-thread decode
+  failure → guard record → stream continues over the survivors);
+* the AUTOTUNE controller's moves (grow on starvation, pin on explicit
+  specs), the resume skip plan's arithmetic, the spill tier's exact
+  round trip, and the devprof decode split.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from anovos_tpu.data_ingest import data_ingest, guard, prefetch
+from anovos_tpu.obs import get_metrics
+from anovos_tpu.resilience import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.setenv("ANOVOS_INGEST_RETRIES", "0")
+    # a real pool regardless of the box's cpu count: the matrix exercises
+    # worker-thread decode, not the auto sizing (tested separately)
+    monkeypatch.setenv("ANOVOS_STREAM_DECODE_WORKERS", "3")
+    guard.reset()
+    chaos.reset()
+    get_metrics().reset()
+    yield
+    guard.reset()
+    chaos.reset()
+
+
+@pytest.fixture(scope="module")
+def parts(tmp_path_factory):
+    d = tmp_path_factory.mktemp("prefetch_parts")
+    rng = np.random.default_rng(3)
+    for i in range(5):
+        pd.DataFrame({
+            "a": np.where(rng.random(2048) < 0.1, np.nan,
+                          rng.normal(i, 2.0, 2048)),
+            "b": rng.exponential(5.0, 2048),
+            "c": rng.choice(["x", "y", "z"], 2048),
+        }).to_parquet(d / f"part-{i:05d}.parquet", index=False)
+    return d
+
+
+# ----------------------------------------------------------------------
+# controller + skip plan units
+# ----------------------------------------------------------------------
+def test_controller_fixed_specs_never_move(monkeypatch):
+    ctl = prefetch.StreamController(window_spec=3, workers_spec=2)
+    for _ in range(20):
+        ctl.observe(fetch_wait_s=5.0, drain_wait_s=5.0, chunk_wall_s=1.0)
+    assert ctl.window == 3 and ctl.workers == 2 and ctl.resizes == 0
+    assert ctl.label == "3"
+
+
+def test_controller_auto_grows_workers_then_window():
+    ctl = prefetch.StreamController(window_spec=None, workers_spec=None)
+    w0, win0 = ctl.workers, ctl.window
+    assert ctl.label == "auto" and win0 == 2
+    for _ in range(ctl.worker_cap + ctl.window_cap + 4):
+        ctl.observe(fetch_wait_s=1.0, drain_wait_s=0.0, chunk_wall_s=1.0)
+    assert ctl.workers == ctl.worker_cap >= w0
+    assert ctl.window == ctl.window_cap <= 8
+    # device-bound + quiet pool: the window comes back down
+    for _ in range(64):
+        ctl.observe(fetch_wait_s=0.0, drain_wait_s=1.0, chunk_wall_s=1.0)
+    assert ctl.window == 2
+
+
+def test_env_spec_parsing(monkeypatch):
+    monkeypatch.setenv("ANOVOS_STREAM_INFLIGHT", "auto")
+    assert prefetch.stream_window_spec() is None
+    monkeypatch.setenv("ANOVOS_STREAM_INFLIGHT", "6")
+    assert prefetch.stream_window_spec() == 6
+    monkeypatch.setenv("ANOVOS_STREAM_DECODE_WORKERS", "0")
+    assert prefetch.decode_workers_spec() == 0
+    monkeypatch.delenv("ANOVOS_STREAM_DECODE_WORKERS")
+    assert prefetch.decode_workers_spec() is None
+
+
+def test_plan_file_skips_matches_iterator_arithmetic():
+    files = [f"f{i}" for i in range(5)]
+    rows = {f: 2048 for f in files}
+    # chunks 0..4 committed, chunk_rows == file rows: every file skippable
+    plan = prefetch.plan_file_skips(files, rows, frozenset(range(5)), 2048)
+    assert plan == frozenset(range(5))
+    # only a prefix committed: the suffix must be decoded
+    plan = prefetch.plan_file_skips(files, rows, frozenset({0, 1}), 2048)
+    assert plan == frozenset({0, 1})
+    # a file straddling a chunk boundary breaks the run of skips behind it
+    rows2 = dict(rows, f1=1000)
+    plan = prefetch.plan_file_skips(files, rows2, frozenset(range(5)), 2048)
+    assert 0 in plan and 1 not in plan and 2 not in plan
+    # unknown row count: nothing downstream is plannable
+    rows3 = {f: rows[f] for f in files if f != "f0"}
+    assert prefetch.plan_file_skips(files, rows3, frozenset(range(5)), 2048) \
+        == frozenset()
+
+
+# ----------------------------------------------------------------------
+# parity + residency + quarantine through the pool
+# ----------------------------------------------------------------------
+def test_prefetch_parity_in_process(parts, monkeypatch):
+    from anovos_tpu.ops.streaming import describe_streaming
+
+    monkeypatch.setenv("ANOVOS_STREAM_DECODE_WORKERS", "0")
+    sync = describe_streaming(str(parts), "parquet", chunk_rows=1024)
+    monkeypatch.setenv("ANOVOS_STREAM_DECODE_WORKERS", "3")
+    monkeypatch.setenv("ANOVOS_STREAM_INFLIGHT", "auto")
+    pooled = describe_streaming(str(parts), "parquet", chunk_rows=1024)
+    pd.testing.assert_frame_equal(sync, pooled)
+
+
+def test_residency_bound_window_1_and_auto(parts, monkeypatch):
+    from anovos_tpu.ops.streaming import describe_streaming
+
+    get_metrics().reset()
+    monkeypatch.setenv("ANOVOS_STREAM_INFLIGHT", "1")
+    r1 = describe_streaming(str(parts), "parquet", chunk_rows=1024)
+    hw = get_metrics().gauge("stream_inflight_high_water").value(window="1")
+    assert hw == 1  # fully synchronous device pipeline at the floor
+
+    get_metrics().reset()
+    monkeypatch.setenv("ANOVOS_STREAM_INFLIGHT", "auto")
+    ra = describe_streaming(str(parts), "parquet", chunk_rows=1024)
+    hwa = get_metrics().gauge("stream_inflight_high_water").value(window="auto")
+    assert hwa is not None and hwa <= prefetch._AUTO_WINDOW_CAP
+    pd.testing.assert_frame_equal(r1, ra)  # window is pure backpressure
+
+
+def test_quarantined_part_skips_through_pool(parts, monkeypatch):
+    from anovos_tpu.ops.streaming import describe_streaming
+
+    ref = describe_streaming(str(parts), "parquet", chunk_rows=1024)
+    # middle part dies on every attempt, decoded on a POOL WORKER thread
+    chaos.install("corrupt@io:*part-00002.parquet:n=99")
+    got = describe_streaming(str(parts), "parquet", chunk_rows=1024)
+    assert int(got.set_index("attribute").loc["b", "count"]) == 4 * 2048
+    recs = guard.records()
+    assert len(recs) == 1 and recs[0].file.endswith("part-00002.parquet")
+    chaos.reset()
+    guard.reset()
+    # synchronous pipeline quarantines identically: parity of degraded runs
+    monkeypatch.setenv("ANOVOS_STREAM_DECODE_WORKERS", "0")
+    chaos.install("corrupt@io:*part-00002.parquet:n=99")
+    sync = describe_streaming(str(parts), "parquet", chunk_rows=1024)
+    pd.testing.assert_frame_equal(got, sync)
+    assert not ref.equals(got)  # the degraded run really lost the part
+
+
+def test_spill_tier_round_trip(parts, tmp_path, monkeypatch):
+    from anovos_tpu.ops.streaming import describe_streaming
+
+    monkeypatch.setenv("ANOVOS_STREAM_DECODE_WORKERS", "0")
+    ref = describe_streaming(str(parts), "parquet", chunk_rows=1024)
+    spill = tmp_path / "spill"
+    monkeypatch.setenv("ANOVOS_STREAM_DECODE_WORKERS", "4")
+    monkeypatch.setenv("ANOVOS_STREAM_INFLIGHT", "1")  # tiny window → spill
+    monkeypatch.setenv("ANOVOS_STREAM_SPILL_DIR", str(spill))
+    get_metrics().reset()
+    got = describe_streaming(str(parts), "parquet", chunk_rows=1024)
+    pd.testing.assert_frame_equal(ref, got)
+    from anovos_tpu.ops.streaming import last_stream_summary
+
+    assert last_stream_summary()["spilled"] > 0
+    # staged frames are cleaned up with the pools
+    leftovers = [p for p in spill.rglob("*") if p.is_file()]
+    assert not leftovers, leftovers
+
+
+def test_devprof_decode_split(parts, monkeypatch):
+    from anovos_tpu.obs import devprof
+    from anovos_tpu.ops.streaming import describe_streaming
+
+    get_metrics().reset()
+    with devprof.node_bracket("stream_test_node"):
+        describe_streaming(str(parts), "parquet", chunk_rows=1024)
+    res = devprof.results()["stream_test_node"]
+    # pool-thread decode books to the CONSUMING node's frame
+    assert res.get("decode_s", 0) > 0
+    assert res.get("decode_bytes", 0) > 0
+    assert get_metrics().counter("stream_decode_seconds_total").value() > 0
+    assert get_metrics().counter("stream_decode_bytes_total").value() > 0
+
+
+# ----------------------------------------------------------------------
+# mid-stream kill + resume under prefetch — all three passes
+# ----------------------------------------------------------------------
+def _bomb_commit(monkeypatch, streaming, after):
+    orig = streaming.StreamCheckpoint.commit
+    state = {"n": 0}
+
+    def bomb(self, pass_no, idx, arrays):
+        orig(self, pass_no, idx, arrays)
+        state["n"] += 1
+        if state["n"] == after:
+            raise RuntimeError("simulated mid-stream kill")
+
+    monkeypatch.setattr(streaming.StreamCheckpoint, "commit", bomb)
+    return orig
+
+
+def _counting_reads(monkeypatch):
+    reads = []
+    orig = data_ingest.read_host_frame
+
+    def counting(files, *a, **k):
+        reads.extend(files)
+        return orig(files, *a, **k)
+
+    monkeypatch.setattr(data_ingest, "read_host_frame", counting)
+    return reads
+
+
+def test_describe_kill_resume_under_prefetch(parts, tmp_path, monkeypatch):
+    from anovos_tpu.ops import streaming
+
+    ref = streaming.describe_streaming(str(parts), "parquet", chunk_rows=2048)
+    ck = str(tmp_path / "ck")
+    orig = _bomb_commit(monkeypatch, streaming, after=2)
+    with pytest.raises(RuntimeError, match="simulated"):
+        streaming.describe_streaming(str(parts), "parquet", chunk_rows=2048,
+                                     checkpoint_dir=ck)
+    monkeypatch.setattr(streaming.StreamCheckpoint, "commit", orig)
+    reads = _counting_reads(monkeypatch)
+    res = streaming.describe_streaming(str(parts), "parquet", chunk_rows=2048,
+                                       checkpoint_dir=ck, resume=True)
+    pd.testing.assert_frame_equal(res, ref)
+    # fewer than the 10 decodes (5 files × 2 passes) a fresh run pays —
+    # and the POOL never speculatively re-read a planned-skip file
+    assert len(reads) < 10, reads
+
+
+def test_quality_kill_resume_under_prefetch(parts, tmp_path, monkeypatch):
+    from anovos_tpu.data_analyzer import quality_checker as qc
+    from anovos_tpu.ops import streaming
+
+    ref = qc.missing_stats_streaming(str(parts), "parquet", chunk_rows=2048)
+    ck = str(tmp_path / "ckq")
+    orig = _bomb_commit(monkeypatch, streaming, after=2)
+    with pytest.raises(RuntimeError, match="simulated"):
+        qc.missing_stats_streaming(str(parts), "parquet", chunk_rows=2048,
+                                   checkpoint_dir=ck)
+    monkeypatch.setattr(streaming.StreamCheckpoint, "commit", orig)
+    reads = _counting_reads(monkeypatch)
+    res = qc.missing_stats_streaming(str(parts), "parquet", chunk_rows=2048,
+                                     checkpoint_dir=ck, resume=True)
+    pd.testing.assert_frame_equal(res, ref)
+    assert len(reads) < 5, reads  # single pass: 2 committed chunks skipped
+
+
+def test_drift_kill_resume_under_prefetch(parts, tmp_path, monkeypatch):
+    from anovos_tpu.drift_stability import drift_detector as dd
+    from anovos_tpu.ops import streaming
+
+    src = tmp_path / "src"
+    rng = np.random.default_rng(8)
+    os.makedirs(src)
+    for i in range(3):
+        pd.DataFrame({
+            "a": rng.normal(i, 2.0, 2048),
+            "b": rng.exponential(4.0, 2048),
+            "c": rng.choice(["x", "y"], 2048),
+        }).to_parquet(src / f"part-{i:05d}.parquet", index=False)
+
+    def run(ck=None, resume=False, mp=""):
+        return dd.statistics_streaming(
+            str(parts), "parquet", str(src), method_type="all",
+            chunk_rows=2048, source_path=mp, checkpoint_dir=ck, resume=resume)
+
+    ref = run(mp=str(tmp_path / "m1"))
+    ck = str(tmp_path / "ckd")
+    # kill in the TARGET pass (after the source passes committed)
+    orig = _bomb_commit(monkeypatch, streaming, after=10)
+    with pytest.raises(RuntimeError, match="simulated"):
+        run(ck=ck, mp=str(tmp_path / "m2"))
+    monkeypatch.setattr(streaming.StreamCheckpoint, "commit", orig)
+    reads = _counting_reads(monkeypatch)
+    res = run(ck=ck, resume=True, mp=str(tmp_path / "m3"))
+    pd.testing.assert_frame_equal(res, ref)
+    # a fresh run decodes 11 files (3 src × 2 passes + 5 tgt); the resume
+    # skipped every committed chunk's decode
+    assert len(reads) < 11, reads
+
+
+# ----------------------------------------------------------------------
+# fresh-subprocess byte parity: describe / quality / drift
+# ----------------------------------------------------------------------
+_PARITY_CHILD = r"""
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import hashlib
+from anovos_tpu.data_analyzer import quality_checker as qc
+from anovos_tpu.drift_stability import drift_detector as dd
+from anovos_tpu.ops.streaming import describe_streaming
+
+data, src, mdir = sys.argv[1], sys.argv[2], sys.argv[3]
+out = {{}}
+out["describe"] = hashlib.sha256(
+    describe_streaming(data, "parquet", chunk_rows=1024)
+    .to_csv(index=False).encode()).hexdigest()
+out["quality"] = hashlib.sha256(
+    qc.missing_stats_streaming(data, "parquet", chunk_rows=1024)
+    .to_csv(index=False).encode()).hexdigest()
+out["drift"] = hashlib.sha256(
+    dd.statistics_streaming(data, "parquet", src, method_type="all",
+                            chunk_rows=1024, source_path=mdir)
+    .to_csv(index=False).encode()).hexdigest()
+print(json.dumps(out))
+"""
+
+
+def test_fresh_subprocess_parity_all_three(parts, tmp_path):
+    src = tmp_path / "src"
+    rng = np.random.default_rng(4)
+    os.makedirs(src)
+    for i in range(3):
+        pd.DataFrame({
+            "a": rng.normal(i, 2.0, 1500),
+            "b": rng.exponential(4.0, 1500),
+            "c": rng.choice(["x", "y", "w"], 1500),
+        }).to_parquet(src / f"part-{i:05d}.parquet", index=False)
+    script = _PARITY_CHILD.format(repo=REPO)
+    hashes = {}
+    for label, workers in (("sync", "0"), ("prefetch", "3")):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "ANOVOS_STREAM_DECODE_WORKERS": workers,
+               "ANOVOS_STREAM_INFLIGHT": "auto"}
+        env.pop("ANOVOS_TPU_CHAOS", None)
+        p = subprocess.run(
+            [sys.executable, "-c", script, str(parts), str(src),
+             str(tmp_path / f"model_{label}")],
+            capture_output=True, text=True, env=env, timeout=600, cwd=REPO)
+        assert p.returncode == 0, p.stderr[-2000:]
+        hashes[label] = json.loads(p.stdout.strip().splitlines()[-1])
+    assert hashes["sync"] == hashes["prefetch"]
+
+
+# ----------------------------------------------------------------------
+# workflow integration: streaming_analysis nodes (out-of-core mode)
+# ----------------------------------------------------------------------
+def test_workflow_streaming_only_run(parts, tmp_path, monkeypatch):
+    """A config with NO input_dataset and a streaming_analysis section:
+    ETL is skipped (the table never materializes), the aside nodes
+    stream the part files, and the written CSVs are byte-identical to
+    the direct function calls."""
+    from anovos_tpu import workflow
+    from anovos_tpu.data_analyzer import quality_checker as qc
+    from anovos_tpu.ops.streaming import describe_streaming
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.delenv("ANOVOS_TPU_CACHE", raising=False)
+    out = tmp_path / "out"
+    cfg = {
+        "streaming_analysis": {
+            "file_path": str(parts), "file_type": "parquet",
+            "chunk_rows": 2048,
+            # empty dicts mean "enabled with defaults" (the YAML idiom
+            # `describe: {}`) — a falsy-check regression silently skipped
+            # these nodes once
+            "describe": {},
+            "quality_missing": {},
+            "output_path": str(out),
+        },
+    }
+    workflow.main(cfg, "local")
+    got_desc = (out / "stream_describe.csv").read_bytes()
+    got_miss = (out / "stream_missing.csv").read_bytes()
+    ref_desc = describe_streaming(str(parts), "parquet", chunk_rows=2048)
+    ref_miss = qc.missing_stats_streaming(str(parts), "parquet", chunk_rows=2048)
+    assert got_desc == ref_desc.to_csv(index=False).encode()
+    assert got_miss == ref_miss.to_csv(index=False).encode()
+    summary = workflow.LAST_RUN_SUMMARY
+    names = {n["name"] if isinstance(n, dict) else n
+             for n in (summary.get("nodes") or [])}
+    if names:
+        assert any("streaming_analysis/describe" in str(n) for n in names)
+    # chunk checkpoints landed under the run's obs subtree
+    assert (tmp_path / "obs" / "stream_ckpt" / "describe").is_dir()

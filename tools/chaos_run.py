@@ -94,6 +94,13 @@ SCENARIOS = {
     # errors) while every later batch serves normally.
     "serve-fault": ("seed=7;hang@serve:apply:secs=0.5:n=1;"
                     "exc@serve:apply:n=2"),
+    # the STREAMING-INGEST scenario (no workflow run): six of eight part
+    # files become slow reads (0.6s each, both describe passes → 7.2s of
+    # serial decode penalty).  The prefetch pool must ABSORB the slow
+    # parts — workers sleep concurrently while the device crunches
+    # already-staged chunks — so the chaos wall stays well under the
+    # synchronous penalty, with byte-identical results.
+    "slowread-stream": "seed=7;slowread@io:*part-0000[0-5].parquet:secs=0.6:n=99",
 }
 
 # how many synthetic input part files a scenario's dataset is split into
@@ -585,6 +592,105 @@ def run_serve_fault(workdir: str) -> dict:
     return result
 
 
+def run_slowread_stream(workdir: str) -> dict:
+    """The streaming-ingest fault gate (no workflow run).
+
+    Clean leg: ``describe_streaming`` over an 8-part dataset with the
+    prefetch pool on.  Chaos leg: the ``slowread-stream`` plan delays six
+    of the eight parts by 0.6s per read (both passes → 7.2s of serial
+    decode penalty).  Gates: byte-identical stats frames, zero
+    quarantines on both legs, and a BOUNDED chaos wall — the pool must
+    absorb the slow parts concurrently, so the overhead stays under 60%
+    of the serial penalty (a synchronous pipeline pays all of it), plus
+    measurable decode/compute overlap on the chaos leg."""
+    import numpy as np
+    import pandas as pd
+
+    from anovos_tpu.data_ingest import guard
+    from anovos_tpu.ops.streaming import describe_streaming, last_stream_summary
+    from anovos_tpu.resilience import chaos
+
+    spec = SCENARIOS["slowread-stream"]
+    result = {"scenario": "slowread-stream", "spec": spec}
+    data = os.path.join(workdir, "stream_data")
+    if not os.path.isdir(data):
+        os.makedirs(data)
+        rng = np.random.default_rng(7)
+        for i in range(8):
+            pd.DataFrame({
+                "a": rng.normal(i, 2.0, 2048),
+                "b": rng.exponential(5.0, 2048),
+            }).to_parquet(os.path.join(data, f"part-{i:05d}.parquet"),
+                          index=False)
+    prev = {k: os.environ.get(k) for k in
+            ("ANOVOS_STREAM_INFLIGHT", "ANOVOS_STREAM_DECODE_WORKERS")}
+    try:
+        # pin a real pool: the gate measures pool absorption, not the
+        # box's cpu count
+        os.environ["ANOVOS_STREAM_INFLIGHT"] = "auto"
+        os.environ["ANOVOS_STREAM_DECODE_WORKERS"] = "4"
+        guard.reset()
+        chaos.reset()
+        t0 = time.monotonic()
+        clean = describe_streaming(data, "parquet", chunk_rows=2048)
+        result["clean_wall_s"] = round(time.monotonic() - t0, 3)
+        result["clean_quarantined_parts"] = len(guard.records())
+
+        chaos.install(spec)
+        t0 = time.monotonic()
+        slow = describe_streaming(data, "parquet", chunk_rows=2048)
+        result["chaos_wall_s"] = round(time.monotonic() - t0, 3)
+        plan = chaos.plan()
+        result["injections"] = plan.injection_count() if plan else 0
+        result["quarantined_parts"] = len(guard.records())
+        ss = last_stream_summary()
+        result["stream_overlap_pct"] = ss.get("overlap_pct")
+        result["stream_workers"] = ss.get("workers")
+    finally:
+        chaos.reset()
+        guard.reset()
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    serial_penalty = 6 * 0.6 * 2  # parts × secs × passes
+    bound = result["clean_wall_s"] + 0.6 * serial_penalty
+    result["serial_penalty_s"] = serial_penalty
+    result["chaos_wall_bound_s"] = round(bound, 2)
+    parity = bool(clean.equals(slow))
+    result["parity"] = parity
+    bounded = result["chaos_wall_s"] <= bound
+    overlapped = (result["stream_overlap_pct"] or 0) >= 0.3
+    result["ok"] = bool(
+        parity and bounded and overlapped
+        and result["injections"] >= 12
+        and result["quarantined_parts"] == 0
+        and result["clean_quarantined_parts"] == 0)
+    if not result["ok"]:
+        reasons = []
+        if not parity:
+            reasons.append("slow-read stats frame differs from the clean run")
+        if not bounded:
+            reasons.append(
+                f"chaos wall {result['chaos_wall_s']}s exceeded the bound "
+                f"{result['chaos_wall_bound_s']}s — the pool serialized the "
+                "slow parts instead of absorbing them")
+        if not overlapped:
+            reasons.append(
+                f"overlap {result['stream_overlap_pct']} < 0.3 — device "
+                "compute stalled for the decode wall")
+        if result["injections"] < 12:
+            reasons.append(
+                f"chaos plan fired {result['injections']} (< 12 — io site "
+                "names drifted?)")
+        if result["quarantined_parts"] or result["clean_quarantined_parts"]:
+            reasons.append("slowread must delay, never quarantine")
+        result["error"] = "; ".join(reasons)
+    return result
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="run a config under a chaos scenario; exit nonzero "
@@ -621,6 +727,9 @@ def main(argv=None) -> int:
         # --node-timeout is a workflow-scenario knob (ANOVOS_TPU_NODE_TIMEOUT);
         # the serving scenario's tail bound is the p99 gate instead
         result = run_serve_fault(workdir)
+    elif ns.scenario == "slowread-stream":
+        # streaming-ingest scenario: the bound is the pool-absorption gate
+        result = run_slowread_stream(workdir)
     else:
         result = run_scenario(ns.scenario, workdir, config=cfg, spec=ns.spec,
                               node_timeout=ns.node_timeout)

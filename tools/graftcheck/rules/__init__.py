@@ -14,4 +14,5 @@ from tools.graftcheck.rules import (  # noqa: F401  (import = registration)
     gc011_collective_placement,
     gc012_unguarded_io,
     gc013_serving_request_path,
+    gc014_sync_decode,
 )

@@ -52,6 +52,8 @@ KNOWN_CALLEES: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...], Tuple[str, ...]
     "anovos_basic_report": ((), ("report:basic",), ()),
     "anovos_report": ((ALL,), (), ()),
     "statistics": ((), ("drift:model",), ()),   # drift_detector.statistics persists the model
+    # the out-of-core twin persists the same binning/frequency model
+    "statistics_streaming": ((), ("drift:model",), ()),
     "charts_to_objects": ((), (), ("drift:model",)),  # reuses the drift model when told to
 }
 

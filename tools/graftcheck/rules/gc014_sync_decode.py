@@ -1,0 +1,115 @@
+"""GC014 — synchronous part decode inside a streaming consumer.
+
+Round 12 made the streaming input pipeline asynchronous: part files
+decode in a bounded background pool (``data_ingest.prefetch``) while the
+device crunches the previous chunk, and the in-flight window is
+autotuned from the decode-vs-drain split.  That overlap dies the day a
+streaming consumer body calls a part decode DIRECTLY: a
+``read_host_frame``/``pd.read_parquet`` in the consumer loop stalls the
+device for the full decode wall, invisibly — the pipeline silently
+degrades back to round-10 synchronous behavior with no test failing.
+
+This rule keeps whole-table streaming passes routed through the prefetch
+iterator:
+
+* **scan scope** — functions whose name ends in ``_streaming`` (the
+  streaming-consumer naming contract: ``describe_streaming``,
+  ``missing_stats_streaming``, ``statistics_streaming``, …) anywhere
+  under ``anovos_tpu/``, including nested helpers defined inside them;
+* **flagged calls** — the part-decode entry points: ``read_host_frame``,
+  ``read_dataset`` (+ ``read_dataset_distributed``), ``_read_one_part``,
+  ``guarded_part_read``, ``read_parquet``, ``read_avro``,
+  ``ParquetFile``, ``pacsv.read_csv`` and read-mode ``open()`` /
+  ``gzip.open()`` — a consumer that needs row data must go through
+  ``_run_pass``/``_iter_chunks`` (which own the pool wiring), and
+  schema probes through ``stream_schema`` / ``_parquet_numeric_cols``
+  (footer-only, no row decode);
+* **deliberately NOT flagged** — ``pd.read_csv``/``np.load``-style reads
+  of tiny MODEL artifacts (a drift run's persisted frequency CSVs, the
+  outlier bounds): those are side inputs, not the dataset — flagging
+  them would push people to thread kilobyte files through the pool.
+
+Anything else needs a per-line ``# graftcheck: disable=GC014`` with a
+justifying comment or a baseline entry.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.jaxmodel import call_chain
+from tools.graftcheck.registry import FileContext, Rule, register
+
+# part-decode entry points: calling any of these on the consumer thread
+# serializes decode against device compute
+_DECODE_NAMES = {
+    "read_host_frame", "read_dataset", "read_dataset_distributed",
+    "_read_one_part", "guarded_part_read", "read_parquet", "read_avro",
+    "ParquetFile",
+}
+
+# pyarrow's CSV decoder — flagged by chain so pandas' read_csv (model
+# artifacts) stays allowed
+_DECODE_CHAINS = {"pacsv.read_csv", "pyarrow.csv.read_csv"}
+
+_MSG = (
+    "synchronous part decode {what!r} inside streaming consumer {fn!r} — "
+    "route row data through the prefetch iterator (_run_pass/_iter_chunks) "
+    "and schema probes through stream_schema; a direct decode here stalls "
+    "the device for the full decode wall and silently de-overlaps the "
+    "pipeline"
+)
+
+
+def _read_mode_open(node: ast.Call) -> bool:
+    chain = call_chain(node)
+    if chain not in ("open", "gzip.open"):
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return True
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return not any(ch in mode.value for ch in "wax+")
+    return True
+
+
+def _flagged(call: ast.Call) -> str:
+    chain = call_chain(call) or ""
+    if chain in _DECODE_CHAINS:
+        return chain
+    func = call.func
+    name = func.attr if isinstance(func, ast.Attribute) else getattr(func, "id", "")
+    if name in _DECODE_NAMES:
+        return chain or name
+    if _read_mode_open(call):
+        return chain or "open"
+    return ""
+
+
+@register
+class SyncDecodeInStreamingConsumerRule(Rule):
+    id = "GC014"
+    title = "synchronous part decode inside a streaming consumer body"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("anovos_tpu/") or "gc014" in relpath
+
+    def check(self, ctx: FileContext):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not fn.name.endswith("_streaming"):
+                continue
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                what = _flagged(call)
+                if what:
+                    yield ctx.finding(
+                        self.id, call,
+                        _MSG.format(what=what, fn=fn.name))

@@ -1,0 +1,130 @@
+"""Out-of-core streaming bench: the round-12 perf headline.
+
+Generates a synthetic income-shaped dataset IN PARTS (each part written
+and released — generation itself stays out-of-core), then:
+
+1. **streaming leg** — ``describe_streaming`` over the part directory
+   with the prefetch pool + AUTOTUNE window (the round-12 pipeline).
+   Records wall, rows/s, the process peak RSS at that point (the
+   flat-RSS claim: bounded by the window, not the dataset), and the
+   measured decode/compute overlap share.
+2. **in-memory leg** — ``read_dataset`` + the fused ``table_describe``
+   over the same files: the rows/s yardstick the streaming path must
+   stay within ~20% of, and the RSS contrast (the whole table resident).
+
+Run ``python -m tools.oocore_bench --rows 3200000 --parts 32 --json``;
+``bench.py`` invokes it in a fresh subprocess when ``BENCH_OOCORE`` ≠ 0
+and lifts the numbers into ``e2e_oocore_*`` / ``e2e_stream_overlap_pct``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import tempfile
+import time
+
+
+def _peak_rss_mb() -> float:
+    # ru_maxrss is KB on Linux
+    return round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1)
+
+
+def generate_parts(root: str, rows: int, parts: int, seed: int = 11) -> None:
+    """Income-shaped synthetic parts, written one at a time (peak host
+    memory during generation is ONE part, not the dataset)."""
+    import numpy as np
+    import pandas as pd
+
+    per = max(1, rows // parts)
+    for i in range(parts):
+        n = per if i < parts - 1 else rows - per * (parts - 1)
+        rng = np.random.default_rng(seed + i)
+        df = pd.DataFrame({
+            "age": np.where(rng.random(n) < 0.02, np.nan,
+                            rng.normal(40, 12, n)).round(1),
+            "fnlwgt": rng.normal(1.9e5, 1.05e5, n).round(0),
+            "education_num": rng.integers(1, 17, n).astype("float64"),
+            "capital_gain": rng.exponential(1100, n).round(0),
+            "hours_per_week": rng.normal(40, 12, n).round(0),
+        })
+        df.to_parquet(os.path.join(root, f"part-{i:05d}.parquet"), index=False)
+        del df
+
+
+def run(rows: int, parts: int, chunk_rows: int, workdir: str = None) -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    root = workdir or tempfile.mkdtemp(prefix="anovos_oocore_")
+    data = os.path.join(root, "data")
+    os.makedirs(data, exist_ok=True)
+    if not os.listdir(data):
+        generate_parts(data, rows, parts)
+    gen_rss = _peak_rss_mb()
+
+    from anovos_tpu.ops.streaming import describe_streaming, last_stream_summary
+
+    t0 = time.monotonic()
+    streamed = describe_streaming(data, "parquet", chunk_rows=chunk_rows)
+    stream_wall = round(time.monotonic() - t0, 3)
+    stream_rss = _peak_rss_mb()
+    ss = last_stream_summary()
+
+    out = {
+        "oocore_rows": rows,
+        "oocore_parts": parts,
+        "oocore_chunk_rows": chunk_rows,
+        "oocore_wall_s": stream_wall,
+        "oocore_rows_per_s": round(rows / max(stream_wall, 1e-9), 1),
+        "oocore_peak_rss_mb": stream_rss,
+        "oocore_gen_rss_mb": gen_rss,
+        "stream_overlap_pct": ss.get("overlap_pct"),
+        "stream_window": ss.get("window"),
+        "stream_workers": ss.get("workers"),
+        "stream_decode_s": ss.get("decode_s"),
+        "stream_spilled": ss.get("spilled"),
+    }
+
+    # in-memory yardstick: full materialization + the fused describe
+    from anovos_tpu.data_ingest.data_ingest import read_dataset
+    from anovos_tpu.ops.describe import table_describe
+
+    t0 = time.monotonic()
+    idf = read_dataset(data, "parquet")
+    num_all, cat_all, _ = idf.attribute_type_segregation()
+    table_describe(idf, num_all, cat_all)
+    inmem_wall = round(time.monotonic() - t0, 3)
+    out.update({
+        "inmem_wall_s": inmem_wall,
+        "inmem_rows_per_s": round(rows / max(inmem_wall, 1e-9), 1),
+        "inmem_peak_rss_mb": _peak_rss_mb(),
+        "oocore_vs_inmem_ratio": round(inmem_wall / max(stream_wall, 1e-9), 3),
+        "streamed_cols": int(len(streamed)),
+    })
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="out-of-core streaming bench")
+    ap.add_argument("--rows", type=int,
+                    default=int(os.environ.get("BENCH_OOCORE_ROWS", 3_200_000)))
+    ap.add_argument("--parts", type=int,
+                    default=int(os.environ.get("BENCH_OOCORE_PARTS", 32)))
+    ap.add_argument("--chunk-rows", type=int,
+                    default=int(os.environ.get("BENCH_OOCORE_CHUNK", 131_072)))
+    ap.add_argument("--workdir", help="reuse/keep the dataset directory")
+    ap.add_argument("--json", action="store_true")
+    ns = ap.parse_args(argv)
+    rec = run(ns.rows, ns.parts, ns.chunk_rows, ns.workdir)
+    if ns.json:
+        print(json.dumps(rec, sort_keys=True))
+    else:
+        for k in sorted(rec):
+            print(f"{k}: {rec[k]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,6 +88,14 @@ TRACKED_FIELDS: Dict[str, Tuple[str, float]] = {
     "e2e_serve_p50_ms": ("lower", 0.60),
     "e2e_serve_p99_ms": ("lower", 0.60),
     "e2e_serve_cold_start_s": ("lower", 0.60),
+    # out-of-core streaming (round 12): the prefetched whole-table pass
+    # must hold its throughput, its window-bounded RSS ceiling, and its
+    # decode/compute overlap.  ±60% walls (shared box), ±50% on the RSS
+    # ceiling (allocator noise), ±40% on overlap share.
+    "e2e_oocore_wall_s": ("lower", 0.60),
+    "e2e_oocore_rows_per_s": ("higher", 0.60),
+    "e2e_oocore_peak_rss_mb": ("lower", 0.50),
+    "e2e_stream_overlap_pct": ("higher", 0.40),
 }
 BASELINE_WINDOW = 3
 
