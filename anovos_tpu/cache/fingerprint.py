@@ -61,6 +61,16 @@ __all__ = [
 # structure wholesale).  graftcheck GC008 audits node bodies against this
 # list.
 KNOWN_ENV_KNOBS = (
+    # continuum feed knobs (anovos_tpu/continuum): the alert gate changes
+    # what the arrival loop EMITS (obs/continuum_alerts.jsonl + journal
+    # alert_emitted lines), and the poll interval is read inside the
+    # node-reachable watcher — both ride the audited list per the
+    # GC008/GC012 policy (a false invalidation on knobs nobody flips
+    # mid-project is cheap, an unauditable env read is not).  The
+    # continuum node itself is uncacheable (cross-run state), so these
+    # never cost a recompute in practice.
+    "ANOVOS_CONTINUUM_ALERTS",
+    "ANOVOS_CONTINUUM_POLL_S",
     # whole-block fusion (ops/fuse.py): =0 restores the eager glue chains
     "ANOVOS_FUSE_BLOCKS",
     # hardened-ingest policy knobs (data_ingest/guard.py): what happens to

@@ -36,6 +36,28 @@ and a ``chunks_invalidated`` whose ``phase`` names the first histogram
 pass means the binning EDGES drifted (a quarantined source part came
 back, or the persisted model changed) and every histogram partial was
 dropped — not just the chunks downstream of the shifted file.
+The continuum service (round 13, ``anovos_tpu/continuum`` — its own
+``continuum_journal.jsonl`` in the state dir, written through this
+class) adds the partition-arrival events: ``step_begin``/``step_end``
+(one arrival-loop iteration, ``step_end`` with folded/quarantined/
+alert/fold-wall tallies), ``partition_seen`` (a part file classified by
+stat signature — ``status`` ∈ new | changed | retracted | quarantined |
+adopted, the last meaning an orphan partial from a crash window was
+recovered without decode), ``fold_commit`` (one partition's
+sufficient-stat partials durably committed — the npz tmp+rename is the
+durability point, this line the WAL record; a mid-fold kill resumes
+from exactly this frontier with zero re-decoded committed parts),
+``snapshot_commit`` (the fold frontier committed content-addressed into
+the PR 5 cache store — ``fp``), ``model_fitted`` (the drift source
+model fitted from the baseline partitions, with the one-time
+``redecoded_parts`` count), ``family_invalidated`` (a family's basis —
+the drift cutoff matrix, the outlier bounds — changed under the feed,
+so its partials were stripped from every partition to re-fold under the
+new basis: the continuum analogue of ``chunks_invalidated``),
+``state_restored`` (a lost state dir rebuilt from the newest snapshot)
+and ``alert_emitted`` (a threshold-crossing drift/quality/quarantine
+alert appended to ``obs/continuum_alerts.jsonl`` with flight-recorder
+context).
 The journal is append-only ACROSS runs in the same output directory, so
 a killed run's committed frontier is still on disk when ``--resume``
 re-runs the config: resumed nodes hit the cache store (the store commit,

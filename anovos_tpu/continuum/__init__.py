@@ -1,0 +1,45 @@
+"""anovos_tpu.continuum — continuous incremental feature engineering.
+
+The batch pipeline turned into a long-running service: a partition-
+arrival loop over mergeable sufficient statistics.  Every per-partition
+statistic is a monoid (``sufficient.py`` — ``from_chunk`` / ``merge`` /
+``finalize``, associativity and shuffled-arrival parity property-tested),
+the accumulated state persists behind a WAL journal with content-
+addressed snapshots in the PR 5 cache store (``state.py``), the watcher
+folds newly-landed part files through the PR 12 decode pool and
+re-finalizes artifacts in O(new rows) (``watcher.py``), and threshold
+crossings emit structured alerts with flight-recorder context
+(``alerts.py``).  ``python -m anovos_tpu.continuum`` is the CLI
+(``run`` / ``step`` / ``status``); a ``continuous_analysis`` workflow
+config section registers one step as a scheduler node.
+"""
+
+from anovos_tpu.continuum.sufficient import (  # noqa: F401
+    ACCUMULATORS,
+    Accumulator,
+    DriftSpec,
+    FoldContext,
+    PartFrame,
+    register_accumulator,
+)
+from anovos_tpu.continuum.state import ContinuumState  # noqa: F401
+from anovos_tpu.continuum.watcher import (  # noqa: F401
+    ContinuumConfig,
+    run,
+    status,
+    step,
+)
+
+__all__ = [
+    "ACCUMULATORS",
+    "Accumulator",
+    "ContinuumConfig",
+    "ContinuumState",
+    "DriftSpec",
+    "FoldContext",
+    "PartFrame",
+    "register_accumulator",
+    "run",
+    "status",
+    "step",
+]

@@ -1,0 +1,794 @@
+"""The mergeable sufficient-statistics contract (``from_chunk`` /
+``merge`` / ``finalize``).
+
+The batch pipeline already computes every continuum statistic somewhere —
+masked compensated moments (``ops/streaming._chunk_stats`` + Chan
+combination), fixed-edge histogram counts (``ops/drift_kernels``), HLL
+registers (``ops/hll``), min/max bounds, missing/outlier counts,
+categorical value counts — but piecemeal, each fused into its consumer.
+This module lifts them behind ONE explicit contract so the continuum
+service (``anovos_tpu.continuum``) can fold a newly-landed partition in
+O(new rows) and never re-read history:
+
+* ``from_chunk(part, ctx, part_key)`` → a **keyed partial map**
+  ``{part_key: {array name: np.ndarray}}`` — the statistic of ONE
+  partition, a pure function of that partition's rows and the static
+  fold context (never of arrival order or prior state);
+* ``merge(a, b)`` → the monoid operation.  The state type is the keyed
+  partial map and merge is keyed union, which makes it EXACTLY
+  associative and order-insensitive (``merge(a, merge(b, c)) ==
+  merge(merge(a, b), c)`` bitwise, shuffled-partition parity included —
+  property-tested per family in ``tests/test_continuum.py``).  A key
+  collision with different content is a contract violation and raises;
+* ``finalize(state, ctx)`` → the artifact frame.  Families whose
+  numeric combination is bitwise order-sensitive in float (the Chan
+  moment merge) reduce the partials in CANONICAL part-key order with a
+  pairwise tree (the exact ``ops/streaming._pairwise_merge`` shape), so
+  the artifact is a function of the SET of partials alone.  Families
+  whose pairwise ``combine`` is exact (register max, integer count
+  adds, Counter sums) are additionally exactly associative at the
+  combine level — also property-tested.
+
+Why keyed union instead of eager numeric merging: the continuum must
+handle *retracted* and *changed* partitions (PR 10 stat-signature
+identity detects them) — an eagerly-merged max/HLL register cannot
+subtract a partition's contribution, a keyed partial map simply drops
+the key.  The partials are tiny (O(k) floats + O(k·2^p) registers per
+partition), so re-reducing them at finalize is microseconds against the
+decode+fold of one new day.
+
+graftcheck GC015 enforces the contract shape statically: any class that
+defines ``from_chunk`` without a ``merge`` is flagged (a non-mergeable
+accumulator reachable from the continuum fold loop would silently turn
+the incremental service back into O(history)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+__all__ = [
+    "FoldContext",
+    "DriftSpec",
+    "PartFrame",
+    "Accumulator",
+    "MomentsAccumulator",
+    "MissingAccumulator",
+    "HLLAccumulator",
+    "CategoricalAccumulator",
+    "OutlierAccumulator",
+    "DriftTargetAccumulator",
+    "ACCUMULATORS",
+    "register_accumulator",
+    "active_families",
+]
+
+# one partial map: canonical part key -> {array name: np.ndarray}
+PartialMap = Dict[str, Dict[str, np.ndarray]]
+
+_BIG = np.float32(np.finfo(np.float32).max)
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftSpec:
+    """Static drift configuration for a continuum feed.
+
+    ``model_dir`` holds the persisted binning model + source frequency
+    CSVs in EXACTLY the layout ``drift_stability.drift_detector``
+    persists (``attribute_binning`` parquet + ``frequency_counts/<col>/
+    part-00000.csv``) — a model fitted by the PR 12 streaming drift pass
+    is consumed as-is.  When no model exists yet, ``baseline`` (an
+    fnmatch glob over canonical part keys) names the partitions the
+    watcher fits one from; baseline partitions are the SOURCE side and
+    never accumulate target histograms."""
+
+    model_dir: str
+    bin_size: int = 10
+    method_type: str = "PSI"
+    threshold: float = 0.1
+    baseline: str = ""
+
+    def is_baseline(self, part_key: str) -> bool:
+        return bool(self.baseline) and fnmatch.fnmatch(part_key, self.baseline)
+
+
+@dataclasses.dataclass
+class FoldContext:
+    """Everything ``from_chunk`` may depend on besides the partition's
+    own rows.  All fields are static per-feed config (or state derived
+    deterministically from config + the partition SET, like the fitted
+    drift cutoffs) — never arrival order."""
+
+    list_of_cols: object = "all"         # "all" | list of names
+    drop_cols: Tuple[str, ...] = ()
+    hll_p: int = 9                       # precision_for_rsd(0.05)
+    row_bucket: int = 1_000_000          # row-padding hint for the device block
+    outlier_bounds: Optional[Dict[str, Tuple[float, float]]] = None
+    drift: Optional[DriftSpec] = None
+    # fitted interior cutoffs per numeric column (None until the model
+    # exists); loaded from / persisted to ``drift.model_dir``
+    drift_cutoffs: Optional[Dict[str, np.ndarray]] = None
+
+    def keep(self, col: str) -> bool:
+        if col in self.drop_cols:
+            return False
+        return self.list_of_cols == "all" or col in self.list_of_cols
+
+
+class PartFrame:
+    """One decoded partition with a lazily-built, shape-bucketed device
+    block shared by every numeric accumulator (moments, HLL, outliers,
+    drift histograms all read the same (rows_pad, k_pad) upload — built
+    once per fold, not once per family)."""
+
+    def __init__(self, frame: pd.DataFrame, ctx: FoldContext):
+        self.frame = frame
+        self.ctx = ctx
+        self.num_cols = [
+            str(c) for c in frame.columns
+            if ctx.keep(str(c)) and pd.api.types.is_numeric_dtype(frame[c])
+        ]
+        self.cat_cols = [
+            str(c) for c in frame.columns
+            if ctx.keep(str(c)) and not pd.api.types.is_numeric_dtype(frame[c])
+            and (frame[c].dtype == object or str(frame[c].dtype) in ("string", "str"))
+        ]
+        self._block = None
+
+    def device_block(self):
+        """(vals, mask) jnp arrays of shape (rows_pad, k_pad) over
+        ``num_cols`` — padded on both axes (``Runtime.pad_rows`` /
+        ``pad_cols``) so every partition of a feed shares the compiled
+        per-family programs; dead rows/lanes are mask=False."""
+        if self._block is None:
+            import jax.numpy as jnp
+
+            from anovos_tpu.shared.runtime import get_runtime
+
+            rt = get_runtime()
+            rows = len(self.frame)
+            k = len(self.num_cols)
+            rows_pad = rt.pad_rows(max(rows, 1))
+            k_pad = rt.pad_cols(max(k, 1))
+            vals = np.zeros((rows_pad, k_pad), np.float32)
+            mask = np.zeros((rows_pad, k_pad), bool)
+            if k:
+                raw = self.frame[self.num_cols].to_numpy(np.float32, na_value=np.nan)
+                m = ~np.isnan(raw)
+                vals[:rows, :k] = np.where(m, raw, 0)
+                mask[:rows, :k] = m
+            self._block = (jnp.asarray(vals), jnp.asarray(mask))
+        return self._block
+
+
+def _assert_same(key: str, a: Dict[str, np.ndarray], b: Dict[str, np.ndarray]) -> None:
+    if sorted(a) != sorted(b):
+        raise ValueError(f"merge collision on part {key!r}: differing array sets")
+    for name in a:
+        if not np.array_equal(np.asarray(a[name]), np.asarray(b[name])):
+            raise ValueError(
+                f"merge collision on part {key!r}: array {name!r} differs — "
+                "the same partition key was folded with different content")
+
+
+import threading as _threading
+
+ACCUMULATORS: Dict[str, type] = {}
+_REGISTRY_LOCK = _threading.Lock()
+
+
+def register_accumulator(cls: type) -> type:
+    """Register one accumulator family under ``cls.name``.  GC015's
+    notion of a "registered merge" is this registry: every entry's class
+    hierarchy must define both ``from_chunk`` and ``merge``.
+    Registration normally happens at import time; the lock covers
+    embedders registering custom families from worker threads."""
+    if not getattr(cls, "name", ""):
+        raise ValueError(f"accumulator {cls.__name__} has no name")
+    for attr in ("from_chunk", "merge", "finalize"):
+        if not callable(getattr(cls, attr, None)):
+            raise TypeError(f"accumulator {cls.__name__} lacks {attr}()")
+    with _REGISTRY_LOCK:
+        if cls.name in ACCUMULATORS:
+            raise ValueError(f"duplicate accumulator family {cls.name!r}")
+        ACCUMULATORS[cls.name] = cls
+    return cls
+
+
+class Accumulator:
+    """Base contract.  Subclasses implement ``part_stats`` (one
+    partition → partial arrays), ``combine`` (deterministic pairwise
+    numeric combination used by the canonical finalize reduce) and
+    ``finalize``; ``from_chunk``/``merge`` — the monoid itself — are
+    shared here and identical for every family."""
+
+    name: str = ""
+
+    # -- the monoid --------------------------------------------------------
+    @classmethod
+    def from_chunk(cls, part: PartFrame, ctx: FoldContext, part_key: str) -> PartialMap:
+        """The keyed singleton state of one partition."""
+        return {part_key: cls.part_stats(part, ctx)}
+
+    @staticmethod
+    def merge(a: PartialMap, b: PartialMap) -> PartialMap:
+        """Keyed union: exactly associative and order-insensitive.  The
+        same key on both sides must carry identical arrays (folding one
+        partition twice is idempotent; differing content raises)."""
+        out = dict(a)
+        for k, v in b.items():
+            if k in out:
+                _assert_same(k, out[k], v)
+            else:
+                out[k] = v
+        return out
+
+    # -- per-family pieces -------------------------------------------------
+    @classmethod
+    def part_stats(cls, part: PartFrame, ctx: FoldContext) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    @classmethod
+    def combine(cls, x: Dict[str, np.ndarray], y: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    @classmethod
+    def reduce(cls, state: PartialMap) -> Optional[Dict[str, np.ndarray]]:
+        """Pairwise tree reduce in canonical (sorted part-key) order —
+        the same shape as ``ops/streaming._pairwise_merge``, so float
+        families produce one deterministic result for any arrival
+        order."""
+        parts = [state[k] for k in sorted(state)]
+        if not parts:
+            return None
+        while len(parts) > 1:
+            parts = [
+                cls.combine(parts[i], parts[i + 1]) if i + 1 < len(parts) else parts[i]
+                for i in range(0, len(parts), 2)
+            ]
+        return parts[0]
+
+    @classmethod
+    def finalize(cls, state: PartialMap, ctx: FoldContext):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# column-aligned helpers: partials carry their own column list (schema can
+# drift mid-feed), so pairwise combination aligns by NAME over the union
+# ---------------------------------------------------------------------------
+def _cols_of(p: Dict[str, np.ndarray]) -> List[str]:
+    return [str(c) for c in np.asarray(p.get("cols", np.array([], "U1")))]
+
+
+def _aligned(cols: List[str], part_cols: List[str], arr: np.ndarray,
+             fill) -> np.ndarray:
+    """``arr`` (|part_cols|, ...) scattered into (|cols|, ...) with
+    ``fill`` identity rows for absent columns."""
+    arr = np.asarray(arr)
+    out = np.full((len(cols),) + arr.shape[1:], fill, dtype=arr.dtype)
+    pos = {c: i for i, c in enumerate(cols)}
+    for j, c in enumerate(part_cols):
+        out[pos[c]] = arr[j]
+    return out
+
+
+@register_accumulator
+class MomentsAccumulator(Accumulator):
+    """Masked compensated moments + exact min/max/nonzero per numeric
+    column: the ``describe`` family.  Per-partition arrays are exactly
+    ``ops/streaming._chunk_stats``' output (one fused device program per
+    partition, shape-bucketed); ``combine`` is the Chan et al. pairwise
+    combination (``ops/streaming._combine``) applied over the column
+    union — absent columns pass through untouched, so a column that
+    appears mid-feed (schema drift) behaves as all-null before its first
+    partition."""
+
+    name = "moments"
+
+    @classmethod
+    def part_stats(cls, part: PartFrame, ctx: FoldContext) -> Dict[str, np.ndarray]:
+        from anovos_tpu.ops.streaming import _chunk_stats
+
+        k = len(part.num_cols)
+        out = {"cols": np.asarray(part.num_cols, "U"),
+               "rows": np.asarray(len(part.frame), np.int64)}
+        names = ("n", "mean", "M2", "M3", "M4", "min", "max", "nonzero")
+        if not k:
+            for nm in names:
+                out[nm] = np.zeros((0,), np.float32)
+            return out
+        v, m = part.device_block()
+        dev = _chunk_stats(v, m)
+        for nm in names:
+            out[nm] = np.asarray(dev[nm])[:k]
+        return out
+
+    @classmethod
+    def combine(cls, x, y):
+        from anovos_tpu.ops.streaming import _combine
+
+        xc, yc = _cols_of(x), _cols_of(y)
+        only_x = [c for c in xc if c not in set(yc)]
+        only_y = [c for c in yc if c not in set(xc)]
+        both = [c for c in xc if c in set(yc)]
+        fills = {"n": 0.0, "mean": 0.0, "M2": 0.0, "M3": 0.0, "M4": 0.0,
+                 "min": _BIG, "max": -_BIG, "nonzero": 0.0}
+        cols = sorted(set(xc) | set(yc))
+        out = {"cols": np.asarray(cols, "U"),
+               "rows": x["rows"] + y["rows"]}
+        if both:
+            xa = {nm: _take(xc, both, x[nm]) for nm in fills}
+            ya = {nm: _take(yc, both, y[nm]) for nm in fills}
+            merged = _combine(xa, ya)
+        else:
+            merged = {nm: np.zeros((0,), np.float32) for nm in fills}
+        for nm, fill in fills.items():
+            arr = np.full((len(cols),), fill, np.float32)
+            pos = {c: i for i, c in enumerate(cols)}
+            for src_cols, src in ((only_x, x), (only_y, y)):
+                sc = _cols_of(src)
+                for c in src_cols:
+                    arr[pos[c]] = np.asarray(src[nm])[sc.index(c)]
+            for j, c in enumerate(both):
+                arr[pos[c]] = np.asarray(merged[nm])[j]
+            out[nm] = arr
+        return out
+
+    @classmethod
+    def finalize(cls, state: PartialMap, ctx: FoldContext) -> pd.DataFrame:
+        """[attribute, count, mean, stddev, variance, skewness, kurtosis,
+        min, max, nonzero] — the same rounding/finalization policy as
+        ``describe_streaming`` (``ops/reductions.finalize_moments``)."""
+        import jax.numpy as jnp
+
+        from anovos_tpu.ops.reductions import finalize_moments
+
+        agg = cls.reduce(state)
+        if agg is None or not len(_cols_of(agg)):
+            return pd.DataFrame(columns=[
+                "attribute", "count", "mean", "stddev", "variance",
+                "skewness", "kurtosis", "min", "max", "nonzero"])
+        cols = _cols_of(agg)
+        fin = {
+            k: np.asarray(v)
+            for k, v in finalize_moments(
+                jnp.asarray(agg["n"]), jnp.asarray(agg["mean"] * agg["n"]),
+                jnp.asarray(agg["M2"]), jnp.asarray(agg["M3"]),
+                jnp.asarray(agg["M4"]), jnp.asarray(agg["min"]),
+                jnp.asarray(agg["max"]), jnp.asarray(agg["nonzero"]),
+            ).items()
+        }
+        return pd.DataFrame({
+            "attribute": cols,
+            "count": agg["n"].astype(np.int64),
+            "mean": np.round(fin["mean"], 4),
+            "stddev": np.round(fin["stddev"], 4),
+            "variance": np.round(fin["variance"], 4),
+            "skewness": np.round(fin["skewness"], 4),
+            "kurtosis": np.round(fin["kurtosis"], 4),
+            "min": fin["min"],
+            "max": fin["max"],
+            "nonzero": agg["nonzero"].astype(np.int64),
+        })
+
+    # -- per-partition view (stability + alerts read it) -------------------
+    @staticmethod
+    def part_metrics(p: Dict[str, np.ndarray]) -> pd.DataFrame:
+        """[attribute, mean, stddev, kurtosis] of ONE partition's partial
+        — the stability-index metric row (kurtosis carries the reference's
+        +3, ``drift_stability/stability.py``)."""
+        cols = _cols_of(p)
+        n = np.asarray(p["n"], np.float64)
+        m2 = np.asarray(p["M2"], np.float64)
+        m4 = np.asarray(p["M4"], np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            std = np.sqrt(m2 / np.maximum(n - 1.0, 1.0))
+            m2p = m2 / np.maximum(n, 1.0)
+            kurt = np.where(m2p > 0, (m4 / np.maximum(n, 1.0)) / np.maximum(m2p * m2p, 1e-38) - 3.0, np.nan)
+        return pd.DataFrame({
+            "attribute": cols,
+            "mean": np.asarray(p["mean"], np.float64),
+            "stddev": np.where(n > 1, std, np.nan),
+            "kurtosis": kurt + 3.0,
+        })
+
+
+def _take(part_cols: List[str], want: List[str], arr: np.ndarray) -> np.ndarray:
+    idx = [part_cols.index(c) for c in want]
+    return np.asarray(arr)[idx]
+
+
+@register_accumulator
+class MissingAccumulator(Accumulator):
+    """Row and per-column valid counts (every configured column, numeric
+    and categorical).  Missing counts are derived at finalize as
+    ``total_rows − valid``, so a column absent from early partitions
+    (schema drift) correctly counts those partitions' rows as missing."""
+
+    name = "missing"
+
+    @classmethod
+    def part_stats(cls, part: PartFrame, ctx: FoldContext) -> Dict[str, np.ndarray]:
+        cols = [c for c in part.frame.columns if ctx.keep(str(c))]
+        return {
+            "cols": np.asarray([str(c) for c in cols], "U"),
+            "rows": np.asarray(len(part.frame), np.int64),
+            "valid": (part.frame[cols].notna().sum().to_numpy(np.int64)
+                      if cols else np.zeros((0,), np.int64)),
+        }
+
+    @classmethod
+    def combine(cls, x, y):
+        xc, yc = _cols_of(x), _cols_of(y)
+        cols = sorted(set(xc) | set(yc))
+        return {
+            "cols": np.asarray(cols, "U"),
+            "rows": x["rows"] + y["rows"],
+            "valid": (_aligned(cols, xc, x["valid"], 0)
+                      + _aligned(cols, yc, y["valid"], 0)),
+        }
+
+    @classmethod
+    def finalize(cls, state: PartialMap, ctx: FoldContext) -> pd.DataFrame:
+        agg = cls.reduce(state)
+        if agg is None:
+            return pd.DataFrame(columns=["attribute", "missing_count", "missing_pct"])
+        cols = _cols_of(agg)
+        total = int(agg["rows"])
+        missing = total - np.asarray(agg["valid"], np.int64)
+        return pd.DataFrame({
+            "attribute": cols,
+            "missing_count": missing,
+            "missing_pct": np.round(missing / max(total, 1), 4),
+        })
+
+
+@register_accumulator
+class HLLAccumulator(Accumulator):
+    """HyperLogLog registers per numeric column (``ops/hll``).  The
+    register merge — elementwise max — is bitwise associative AND
+    commutative, the textbook mergeable sketch; this class is where that
+    merging now formally lives (previously implicit in the fori_loop
+    carry of ``hll_registers`` and the "mergeable across hosts" note)."""
+
+    name = "hll"
+
+    @classmethod
+    def part_stats(cls, part: PartFrame, ctx: FoldContext) -> Dict[str, np.ndarray]:
+        from anovos_tpu.ops.hll import hll_registers
+
+        k = len(part.num_cols)
+        out = {"cols": np.asarray(part.num_cols, "U"),
+               "p": np.asarray(ctx.hll_p, np.int64)}
+        if not k:
+            out["registers"] = np.zeros((0, 1 << ctx.hll_p), np.int32)
+            return out
+        v, m = part.device_block()
+        out["registers"] = np.asarray(hll_registers(v, m, ctx.hll_p))[:k]
+        return out
+
+    @classmethod
+    def combine(cls, x, y):
+        xc, yc = _cols_of(x), _cols_of(y)
+        cols = sorted(set(xc) | set(yc))
+        return {
+            "cols": np.asarray(cols, "U"),
+            "p": x["p"],
+            "registers": np.maximum(
+                _aligned(cols, xc, x["registers"], 0),
+                _aligned(cols, yc, y["registers"], 0)),
+        }
+
+    @classmethod
+    def finalize(cls, state: PartialMap, ctx: FoldContext) -> pd.DataFrame:
+        from anovos_tpu.ops.hll import hll_estimate
+
+        agg = cls.reduce(state)
+        if agg is None or not len(_cols_of(agg)):
+            return pd.DataFrame(columns=["attribute", "distinct_approx"])
+        return pd.DataFrame({
+            "attribute": _cols_of(agg),
+            "distinct_approx": np.round(hll_estimate(agg["registers"])).astype(np.int64),
+        })
+
+
+@register_accumulator
+class CategoricalAccumulator(Accumulator):
+    """Per-categorical-column value counts (string-keyed, the union-vocab
+    key space drift's LUT remap counts into)."""
+
+    name = "categorical"
+
+    @classmethod
+    def part_stats(cls, part: PartFrame, ctx: FoldContext) -> Dict[str, np.ndarray]:
+        out = {"cols": np.asarray(part.cat_cols, "U")}
+        for j, c in enumerate(part.cat_cols):
+            vc = part.frame[c].dropna().astype(str).value_counts()
+            # sort by value: a partition's partial must not depend on
+            # pandas' count-then-insertion tiebreak ordering
+            vc = vc.sort_index()
+            out[f"cat{j}_v"] = vc.index.to_numpy(dtype="U")
+            out[f"cat{j}_n"] = vc.to_numpy(np.int64)
+        return out
+
+    @staticmethod
+    def _counter(p: Dict[str, np.ndarray], j: int) -> Dict[str, int]:
+        vals = np.asarray(p.get(f"cat{j}_v", np.array([], "U1")))
+        cnts = np.asarray(p.get(f"cat{j}_n", np.array([], np.int64)))
+        return {str(v): int(n) for v, n in zip(vals, cnts)}
+
+    @classmethod
+    def counters(cls, p: Dict[str, np.ndarray]) -> Dict[str, Dict[str, int]]:
+        return {c: cls._counter(p, j) for j, c in enumerate(_cols_of(p))}
+
+    @classmethod
+    def combine(cls, x, y):
+        cx, cy = cls.counters(x), cls.counters(y)
+        cols = sorted(set(cx) | set(cy))
+        out = {"cols": np.asarray(cols, "U")}
+        for j, c in enumerate(cols):
+            cnt: Dict[str, int] = dict(cx.get(c, {}))
+            for v, n in cy.get(c, {}).items():
+                cnt[v] = cnt.get(v, 0) + n
+            keys = sorted(cnt)
+            out[f"cat{j}_v"] = np.asarray(keys, "U")
+            out[f"cat{j}_n"] = np.asarray([cnt[k] for k in keys], np.int64)
+        return out
+
+    @classmethod
+    def finalize(cls, state: PartialMap, ctx: FoldContext) -> pd.DataFrame:
+        """[attribute, distinct, top_value, top_count] — exact distinct
+        counts for categoricals (the Counter IS the exact sketch)."""
+        agg = cls.reduce(state)
+        if agg is None or not len(_cols_of(agg)):
+            return pd.DataFrame(columns=["attribute", "distinct", "top_value", "top_count"])
+        rows = []
+        for c, cnt in sorted(cls.counters(agg).items()):
+            if cnt:
+                top = max(sorted(cnt), key=lambda v: cnt[v])
+                rows.append({"attribute": c, "distinct": len(cnt),
+                             "top_value": top, "top_count": cnt[top]})
+            else:
+                rows.append({"attribute": c, "distinct": 0,
+                             "top_value": "", "top_count": 0})
+        return pd.DataFrame(rows)
+
+
+@register_accumulator
+class OutlierAccumulator(Accumulator):
+    """Outlier counts against PRE-FITTED bounds (the
+    ``outlier_stats_streaming`` contract: fit once on a sample or prior
+    run, count forever) — integer counts, exactly mergeable.  Inactive
+    unless the fold context carries bounds."""
+
+    name = "outlier"
+
+    @classmethod
+    def part_stats(cls, part: PartFrame, ctx: FoldContext) -> Dict[str, np.ndarray]:
+        from anovos_tpu.data_analyzer.quality_checker import _outlier_counts_program
+
+        bounds = ctx.outlier_bounds or {}
+        cols = [c for c in part.num_cols if c in bounds]
+        out = {"cols": np.asarray(cols, "U")}
+        if not cols:
+            out["n_lo"] = np.zeros((0,), np.int64)
+            out["n_hi"] = np.zeros((0,), np.int64)
+            return out
+        v, m = part.device_block()
+        k_pad = int(v.shape[1])
+        lo = np.full((k_pad,), -np.inf, np.float32)
+        hi = np.full((k_pad,), np.inf, np.float32)
+        for j, c in enumerate(part.num_cols):
+            if c in bounds:
+                b = bounds[c]
+                lo[j] = b[0] if b[0] is not None else -np.inf
+                hi[j] = b[1] if b[1] is not None else np.inf
+        n_lo, n_hi = _outlier_counts_program(v, m, lo, hi)
+        n_lo = np.asarray(n_lo)[: len(part.num_cols)]
+        n_hi = np.asarray(n_hi)[: len(part.num_cols)]
+        idx = [part.num_cols.index(c) for c in cols]
+        out["n_lo"] = n_lo[idx].astype(np.int64)
+        out["n_hi"] = n_hi[idx].astype(np.int64)
+        return out
+
+    @classmethod
+    def combine(cls, x, y):
+        xc, yc = _cols_of(x), _cols_of(y)
+        cols = sorted(set(xc) | set(yc))
+        return {
+            "cols": np.asarray(cols, "U"),
+            "n_lo": _aligned(cols, xc, x["n_lo"], 0) + _aligned(cols, yc, y["n_lo"], 0),
+            "n_hi": _aligned(cols, xc, x["n_hi"], 0) + _aligned(cols, yc, y["n_hi"], 0),
+        }
+
+    @classmethod
+    def finalize(cls, state: PartialMap, ctx: FoldContext) -> pd.DataFrame:
+        agg = cls.reduce(state)
+        if agg is None:
+            return pd.DataFrame(columns=["attribute", "lower_outliers", "upper_outliers"])
+        return pd.DataFrame({
+            "attribute": _cols_of(agg),
+            "lower_outliers": np.asarray(agg["n_lo"], np.int64),
+            "upper_outliers": np.asarray(agg["n_hi"], np.int64),
+        })
+
+
+@register_accumulator
+class DriftTargetAccumulator(Accumulator):
+    """Target-side drift ingredients binned over the FIXED persisted
+    model cutoffs: per-column (bin_size,) histogram counts + categorical
+    value counts + live rows.  Fixed edges are what make this a monoid —
+    a re-fit would stale every prior partial (exactly the
+    ``StreamCheckpoint.check_bounds`` hazard), so the cutoffs come from
+    the persisted model and never move.  Baseline partitions (the source
+    side) are excluded by the watcher, not here."""
+
+    name = "drift_target"
+
+    @classmethod
+    def part_stats(cls, part: PartFrame, ctx: FoldContext) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+
+        from anovos_tpu.ops.drift_kernels import binned_histograms
+
+        if ctx.drift is None or ctx.drift_cutoffs is None:
+            raise RuntimeError(
+                "drift_target requires fitted cutoffs in the fold context")
+        bin_size = ctx.drift.bin_size
+        cut_map = ctx.drift_cutoffs
+        cols = [c for c in part.num_cols if c in cut_map]
+        out = {"cols": np.asarray(cols, "U"),
+               "rows": np.asarray(len(part.frame), np.int64)}
+        if cols:
+            v, m = part.device_block()
+            k_pad = int(v.shape[1])
+            cuts = np.full((k_pad, bin_size - 1), np.nan, np.float32)
+            for j, c in enumerate(part.num_cols):
+                if c in cut_map:
+                    cuts[j] = np.asarray(cut_map[c], np.float32)
+            hist = np.asarray(binned_histograms(v, m, jnp.asarray(cuts), bin_size))
+            idx = [part.num_cols.index(c) for c in cols]
+            out["hist"] = hist[idx].astype(np.int64)
+        else:
+            out["hist"] = np.zeros((0, bin_size), np.int64)
+        # categorical target counts ride along so the family is
+        # self-contained (the source side's union vocab joins at finalize)
+        cat = CategoricalAccumulator.part_stats(part, ctx)
+        out["cat_cols"] = cat["cols"]
+        for j in range(len(part.cat_cols)):
+            out[f"cat{j}_v"] = cat[f"cat{j}_v"]
+            out[f"cat{j}_n"] = cat[f"cat{j}_n"]
+        return out
+
+    @classmethod
+    def _cat_counters(cls, p: Dict[str, np.ndarray]) -> Dict[str, Dict[str, int]]:
+        cols = [str(c) for c in np.asarray(p.get("cat_cols", np.array([], "U1")))]
+        out = {}
+        for j, c in enumerate(cols):
+            vals = np.asarray(p.get(f"cat{j}_v", np.array([], "U1")))
+            cnts = np.asarray(p.get(f"cat{j}_n", np.array([], np.int64)))
+            out[c] = {str(v): int(n) for v, n in zip(vals, cnts)}
+        return out
+
+    @classmethod
+    def combine(cls, x, y):
+        xc, yc = _cols_of(x), _cols_of(y)
+        cols = sorted(set(xc) | set(yc))
+        hx, hy = np.asarray(x["hist"]), np.asarray(y["hist"])
+        nb = hx.shape[1] if hx.ndim == 2 and hx.shape[1] else (
+            hy.shape[1] if hy.ndim == 2 and hy.shape[1] else 1)
+        if not hx.size:
+            hx = np.zeros((len(xc), nb), np.int64)
+        if not hy.size:
+            hy = np.zeros((len(yc), nb), np.int64)
+        out = {
+            "cols": np.asarray(cols, "U"),
+            "rows": x["rows"] + y["rows"],
+            "hist": _aligned(cols, xc, hx, 0) + _aligned(cols, yc, hy, 0),
+        }
+        cx, cy = cls._cat_counters(x), cls._cat_counters(y)
+        cat_cols = sorted(set(cx) | set(cy))
+        out["cat_cols"] = np.asarray(cat_cols, "U")
+        for j, c in enumerate(cat_cols):
+            cnt: Dict[str, int] = dict(cx.get(c, {}))
+            for v, n in cy.get(c, {}).items():
+                cnt[v] = cnt.get(v, 0) + n
+            keys = sorted(cnt)
+            out[f"cat{j}_v"] = np.asarray(keys, "U")
+            out[f"cat{j}_n"] = np.asarray([cnt[k] for k in keys], np.int64)
+        return out
+
+    @classmethod
+    def freqs(cls, p: Dict[str, np.ndarray], ctx: FoldContext):
+        """(freq_p, freq_q) of ONE partial (a single partition's or the
+        canonical reduce's) against the persisted source model — the
+        ``pre_existing_source`` union semantics of ``drift_detector``:
+        per categorical column, vocab = persisted source values ∪ this
+        partial's observed values, source probability 0 for the unseen.
+        Shared by the cumulative finalize and the per-arrival alert
+        evaluation, so the two cannot disagree on normalization."""
+        num_fp, cat_smaps = _load_source_freqs(ctx)
+        rows = max(int(p["rows"]), 1)
+        freq_p: Dict[str, np.ndarray] = {}
+        freq_q: Dict[str, np.ndarray] = {}
+        for j, c in enumerate(_cols_of(p)):
+            if c in num_fp:
+                freq_p[c] = num_fp[c]
+                freq_q[c] = np.asarray(p["hist"])[j].astype(np.float64) / rows
+        for c, cnt in cls._cat_counters(p).items():
+            smap = cat_smaps.get(c)
+            if smap is None:
+                continue
+            uni = sorted(set(smap) | set(cnt))
+            freq_p[c] = np.array([smap.get(v, 0.0) for v in uni])
+            freq_q[c] = np.array([cnt.get(v, 0) for v in uni], np.float64) / rows
+        return freq_p, freq_q
+
+    @classmethod
+    def finalize(cls, state: PartialMap, ctx: FoldContext) -> pd.DataFrame:
+        """The cumulative drift frame [attribute, <methods…>, flagged]
+        against the persisted source model — ``drift_detector``'s
+        ``_metrics_frame`` tail, byte-compatible with the batch path."""
+        from anovos_tpu.drift_stability.drift_detector import _metrics_frame
+        from anovos_tpu.drift_stability.validations import check_distance_method
+
+        if ctx.drift is None:
+            return pd.DataFrame(columns=["attribute", "flagged"])
+        methods = check_distance_method(ctx.drift.method_type)
+        agg = cls.reduce(state)
+        if agg is None:
+            return pd.DataFrame(columns=["attribute"] + methods + ["flagged"])
+        freq_p, freq_q = cls.freqs(agg, ctx)
+        cols = sorted(set(freq_p) & set(freq_q))
+        return _metrics_frame(freq_p, freq_q, cols, methods, ctx.drift.threshold)
+
+
+def _load_source_freqs(ctx: FoldContext):
+    """(numeric freq_p per column, categorical source probability map per
+    column) from the persisted model — through
+    ``drift_detector.load_frequency_map``, the ONE parser of the
+    frequency-counts layout (shared with the in-memory
+    ``pre_existing_source`` branch and the streaming variant)."""
+    import os
+
+    from anovos_tpu.drift_stability.drift_detector import load_frequency_map
+
+    num_fp: Dict[str, np.ndarray] = {}
+    cat_smaps: Dict[str, Dict[str, float]] = {}
+    if ctx.drift is None:
+        return num_fp, cat_smaps
+    base = os.path.join(ctx.drift.model_dir, "frequency_counts")
+    if not os.path.isdir(base):
+        return num_fp, cat_smaps
+    bin_size = ctx.drift.bin_size
+    num_cols = set(ctx.drift_cutoffs or {})
+    for c in sorted(os.listdir(base)):
+        smap = load_frequency_map(ctx.drift.model_dir, c)
+        if smap is None:
+            continue
+        if c in num_cols:
+            num_fp[c] = np.array([smap.get(str(k), 0.0) for k in range(1, bin_size + 1)])
+        else:
+            cat_smaps[c] = smap
+    return num_fp, cat_smaps
+
+
+def active_families(ctx: FoldContext, part_key: str) -> List[str]:
+    """The accumulator families one partition folds into under ``ctx``:
+    the always-on base set, outliers when bounds exist, and the drift
+    target family when cutoffs exist and the partition is not on the
+    baseline (source) side."""
+    fams = ["moments", "missing", "hll", "categorical"]
+    if ctx.outlier_bounds:
+        fams.append("outlier")
+    if (ctx.drift is not None and ctx.drift_cutoffs is not None
+            and not ctx.drift.is_baseline(part_key)):
+        fams.append("drift_target")
+    return fams

@@ -7,12 +7,19 @@ scaler stats) or CSV (encoders) directories like the reference's, written
 via pandas/pyarrow.
 
 ``load_model_df`` memoizes parsed model frames behind a stat-signature
-check (path + size + mtime_ns of every part file): the batch pipeline loads
-each model at most a handful of times, but the online-serving apply path
-(``anovos_tpu.serving``) re-applies the same fitted models on every request
-batch — without the cache each micro-batch would pay one parquet/CSV read
-per transformer on the hot path.  A rewritten artifact re-stamps its files,
-invalidating the entry; callers receive a fresh DataFrame each call, so
+check (path + size + mtime_ns + a content digest of each part file's
+FOOTER): the batch pipeline loads each model at most a handful of times,
+but the online-serving apply path (``anovos_tpu.serving``) re-applies the
+same fitted models on every request batch — without the cache each
+micro-batch would pay one parquet/CSV read per transformer on the hot
+path.  A rewritten artifact re-stamps its files, invalidating the entry;
+the footer digest closes the SAME-mtime rewrite hole (tar-extracted
+artifacts restore their recorded mtimes, and coarse-granularity clocks
+can land a rewrite in the original stamp — size+mtime alone then serves
+the STALE model): parquet rewrites always move the footer (row-group
+offsets/stats), CSV rewrites move the trailing rows, and hashing the
+last 4 KiB costs one page read against the full-file parse it saves.
+Callers receive a fresh DataFrame each call, so
 column-level mutation cannot poison the cache.  CAVEAT: ``copy()`` does
 not deep-copy the Python objects INSIDE object cells (e.g. binning's
 ``parameters`` lists) — callers must not mutate cell contents in place
@@ -22,6 +29,7 @@ not deep-copy the Python objects INSIDE object cells (e.g. binning's
 from __future__ import annotations
 
 import glob
+import hashlib
 import os
 import shutil
 import threading
@@ -30,8 +38,9 @@ from typing import Dict, Optional, Tuple
 import pandas as pd
 
 _CACHE_LOCK = threading.Lock()
-_CACHE: Dict[Tuple[str, str], Tuple[Tuple[Tuple[str, int, int], ...], pd.DataFrame]] = {}
+_CACHE: Dict[Tuple[str, str], Tuple[tuple, pd.DataFrame]] = {}
 _CACHE_MAX = 256  # model tables are tiny; bound is a leak guard, not a budget
+_FOOTER_BYTES = 4096  # tail window hashed into the memo key
 
 
 def save_model_df(df: pd.DataFrame, model_path: str, name: str, fmt: str = "parquet") -> None:
@@ -52,12 +61,23 @@ def _part_files(path: str, fmt: str) -> list:
     return files
 
 
-def _stat_sig(files) -> Optional[Tuple[Tuple[str, int, int], ...]]:
+def _footer_digest(path: str, size: int) -> str:
+    """Digest of the file's last ``_FOOTER_BYTES`` — the part of a model
+    artifact a rewrite cannot leave untouched (parquet footers carry
+    row-group offsets, CSV tails carry the data)."""
+    with open(path, "rb") as f:
+        if size > _FOOTER_BYTES:
+            f.seek(size - _FOOTER_BYTES)
+        return hashlib.sha256(f.read(_FOOTER_BYTES)).hexdigest()[:16]
+
+
+def _stat_sig(files) -> Optional[tuple]:
     out = []
     try:
         for f in files:
             st = os.stat(f)
-            out.append((f, st.st_size, st.st_mtime_ns))
+            out.append((f, st.st_size, st.st_mtime_ns,
+                        _footer_digest(f, st.st_size)))
     except OSError:
         return None
     return tuple(out)

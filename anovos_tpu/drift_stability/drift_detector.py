@@ -35,6 +35,32 @@ logger = logging.getLogger(__name__)
 _SMOOTH = 0.0001
 
 
+def load_frequency_map(model_dir: str, col: str) -> Optional[Dict[str, float]]:
+    """{key: probability} from one column's persisted source-frequency
+    CSV (``<model_dir>/frequency_counts/<col>/part-00000.csv``), or None
+    when absent.  THE read path for the persisted drift model — shared by
+    the in-memory ``pre_existing_source`` branch, the streaming variant,
+    and the continuum feed, so the on-disk format has exactly one parser
+    (keys kept verbatim as strings; pandas numeric inference would mangle
+    "01" vs "1" vocab keys)."""
+    path = os.path.join(model_dir, "frequency_counts", col, "part-00000.csv")
+    if not os.path.exists(path):
+        return None
+    f = pd.read_csv(path, dtype=str)
+    kcol = f.columns[0]
+    return dict(zip(f[kcol].astype(str), f["p"].astype(float)))
+
+
+def save_frequency_map(model_dir: str, col: str, keys, p) -> None:
+    """Persist one column's source frequencies — the write half of
+    :func:`load_frequency_map`, byte-compatible with every prior round's
+    model layout."""
+    d = os.path.join(model_dir, "frequency_counts", col)
+    os.makedirs(d, exist_ok=True)
+    pd.DataFrame({col: keys, "p": p}).to_csv(
+        os.path.join(d, "part-00000.csv"), index=False)
+
+
 def _freqs_to_metrics(p: np.ndarray, q: np.ndarray, methods: List[str]) -> dict:
     """Vectorized drift metrics over (k, nb) frequency arrays with the
     reference's 0→0.0001 smoothing (:266-271)."""
@@ -156,14 +182,11 @@ def statistics(
     freq_p: Dict[str, np.ndarray] = {}
     if pre_existing_source:
         for c in cols:
-            path = os.path.join(model_dir, "frequency_counts", c, "part-00000.csv")
-            if not os.path.exists(path):
+            smap = load_frequency_map(model_dir, c)
+            if smap is None:
                 # e.g. a column the fit run dropped (all-null in source)
                 warnings.warn(f"drift statistics: no persisted source frequencies for {c}; skipping")
                 continue
-            f = pd.read_csv(path, dtype=str)
-            kcol = f.columns[0]
-            smap = dict(zip(f[kcol].astype(str), f["p"].astype(float)))
             if c in num_cols_eff:
                 freq_p[c] = np.array([smap.get(str(k), 0.0) for k in range(1, bin_size + 1)])
             elif c in cat_cols:
@@ -233,14 +256,10 @@ def statistics(
             freq_p[c] = src_cat[j][: len(union_vocabs[c])] / max(idf_source.nrows, 1)
         if source_save:
             for c in num_cols_eff + cat_cols:
-                d = os.path.join(model_dir, "frequency_counts", c)
-                os.makedirs(d, exist_ok=True)
                 keys = (
                     list(range(1, bin_size + 1)) if c in num_cols_eff else list(union_vocabs[c])
                 )
-                pd.DataFrame({c: keys, "p": freq_p[c]}).to_csv(
-                    os.path.join(d, "part-00000.csv"), index=False
-                )
+                save_frequency_map(model_dir, c, keys, freq_p[c])
 
     odf = _metrics_frame(freq_p, freq_q, cols, methods, threshold)
     if print_impact:
@@ -653,14 +672,11 @@ def statistics_streaming(
     freq_q: Dict[str, np.ndarray] = {}
     if pre_existing_source:
         for c in cols:
-            path = os.path.join(model_dir, "frequency_counts", c, "part-00000.csv")
-            if not os.path.exists(path):
+            smap = load_frequency_map(model_dir, c)
+            if smap is None:
                 warnings.warn(
                     f"drift statistics: no persisted source frequencies for {c}; skipping")
                 continue
-            f = pd.read_csv(path, dtype=str)
-            kcol = f.columns[0]
-            smap = dict(zip(f[kcol].astype(str), f["p"].astype(float)))
             if c in num_cols_eff:
                 freq_p[c] = np.array([smap.get(str(k), 0.0) for k in range(1, bin_size + 1)])
             elif c in cat_cols:
@@ -689,15 +705,11 @@ def statistics_streaming(
                 np.float32) / max(src_rows, 1)
         if source_save:
             for c in num_cols_eff + cat_cols:
-                d = os.path.join(model_dir, "frequency_counts", c)
-                os.makedirs(d, exist_ok=True)
                 keys = (
                     list(range(1, bin_size + 1)) if c in num_cols_eff
                     else list(union_vocabs[c])
                 )
-                pd.DataFrame({c: keys, "p": freq_p[c]}).to_csv(
-                    os.path.join(d, "part-00000.csv"), index=False
-                )
+                save_frequency_map(model_dir, c, keys, freq_p[c])
 
     for i, c in enumerate(num_cols_eff):
         freq_q[c] = tgt_num[i] / max(count_target, 1)

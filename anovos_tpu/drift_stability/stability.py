@@ -100,6 +100,29 @@ def stability_index_computation(
             os.path.join(appended_metric_path, "part-00000.csv"), index=False
         )
 
+    odf = stability_frame_from_history(
+        hist, cols=cols, metric_weightages=metric_weightages,
+        threshold=threshold, binary_cols=binary_cols)
+    if print_impact:
+        logger.info(odf.to_string(index=False))
+    return odf
+
+
+def stability_frame_from_history(
+    hist: pd.DataFrame,
+    cols: Optional[List[str]] = None,
+    metric_weightages: dict = {"mean": 0.5, "stddev": 0.3, "kurtosis": 0.2},
+    threshold: float = 1,
+    binary_cols: Union[str, List[str]] = [],
+) -> pd.DataFrame:
+    """The CV→SI tail over an [idx, attribute, mean, stddev, kurtosis]
+    metric history — extracted so the batch path above and the continuum
+    feed (``anovos_tpu.continuum`` appends one run index per partition
+    arrival) score history with ONE arithmetic."""
+    if isinstance(binary_cols, str):
+        binary_cols = [x.strip() for x in binary_cols.split("|") if x.strip()]
+    if cols is None:
+        cols = list(dict.fromkeys(hist["attribute"].astype(str))) if len(hist) else []
     si_fn = compute_si(metric_weightages)
     rows = []
     for c in cols:
@@ -135,10 +158,7 @@ def stability_index_computation(
                 "flagged": 1 if (si is None or si < threshold) else 0,
             }
         )
-    odf = pd.DataFrame(rows)
-    if print_impact:
-        logger.info(odf.to_string(index=False))
-    return odf
+    return pd.DataFrame(rows)
 
 
 def feature_stability_estimation(

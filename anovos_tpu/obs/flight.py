@@ -47,7 +47,8 @@ from typing import List, Optional
 
 logger = logging.getLogger("anovos_tpu.obs.flight")
 
-__all__ = ["configure", "enabled", "record", "dump", "dump_paths", "reset"]
+__all__ = ["configure", "enabled", "record", "dump", "dump_paths", "reset",
+           "snapshot_events"]
 
 FLIGHTREC_VERSION = 1
 _DEFAULT_EVENTS = 256
@@ -115,6 +116,14 @@ def dump_paths() -> List[str]:
     """Dump files written since the last :func:`configure`."""
     with _LOCK:
         return list(_DUMPS)
+
+
+def snapshot_events() -> List[dict]:
+    """The current event ring, oldest first (empty when disarmed).  The
+    read-only accessor consumers that ATTACH context — the continuum
+    alert stream — use instead of triggering a full postmortem dump."""
+    with _LOCK:
+        return list(_RING) if _RING is not None else []
 
 
 def _safe_name(node: str) -> str:

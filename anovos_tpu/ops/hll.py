@@ -10,7 +10,12 @@ bias corrections (small-range linear counting, large-range log).
 
 Memory is O(k · 2^p) independent of rows — the point of the sketch: distinct
 counting for tables whose sort would not fit HBM, and mergeable across hosts
-(take elementwise max of registers).
+(take elementwise max of registers).  That register merge is now a formal
+part of the continuum sufficient-statistics contract
+(``anovos_tpu.continuum.sufficient.HLLAccumulator``) with an
+associativity/order-insensitivity property test; ``hll_registers`` itself
+rides ``obs.timed`` so its dispatch wall books like every other ops entry
+point (the former GC010 baseline exemption is retired).
 """
 
 from __future__ import annotations
@@ -22,6 +27,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from anovos_tpu.obs import timed
 
 
 def precision_for_rsd(rsd: float) -> int:
@@ -40,6 +47,7 @@ def precision_for_rsd(rsd: float) -> int:
     return max(4, min(16, p))
 
 
+@timed("ops.hll_registers")
 def hll_registers(X: jax.Array, M: jax.Array, p: int) -> jax.Array:
     """Per-column HLL registers with O(k·2^p + chunk·k·2^p) working memory.
 

@@ -641,10 +641,12 @@ def main(
     ingest_guard.reset()
     auth_key = _auth_key(auth_key_val)
     stream_cfg = all_configs.get("streaming_analysis")
-    if all_configs.get("input_dataset") is None and stream_cfg:
-        # out-of-core mode: the dataset never materializes as a Table —
-        # every registered node is a streaming_analysis node reading its
-        # part files through the prefetch pipeline
+    if all_configs.get("input_dataset") is None and (
+            stream_cfg or all_configs.get("continuous_analysis")):
+        # out-of-core / continuum mode: the dataset never materializes as
+        # a Table — every registered node reads its own part files
+        # through the prefetch pipeline (streaming passes, or the
+        # continuum arrival loop folding newly-landed partitions)
         df = None
     else:
         with get_tracer().span("input_dataset/ETL", cat="node"):
@@ -1169,6 +1171,36 @@ def main(
                                             "dataset_fp": s_fp,
                                             "source_fp": dataset_fingerprint(
                                                 {"read_dataset": {"file_path": dr_src}})})
+                continue
+
+            if key == "continuous_analysis" and args is not None:
+                # one continuum arrival-loop step as a scheduler node
+                # (anovos_tpu.continuum): scan the feed directory, fold
+                # newly-landed partitions through the prefetch pool, re-
+                # finalize the incremental artifacts and re-render only
+                # the affected report sections.  Deliberately UNCACHEABLE
+                # (cache_slice=None): the node's output is a function of
+                # cross-run state (the fold frontier), which the node
+                # fingerprint cannot see.  The long-running loop is the
+                # `python -m anovos_tpu.continuum run` CLI; this node is
+                # the one-shot fold for workflow-driven deployments.
+                c_args = dict(args)
+
+                def _continuum_step(c_args=c_args):
+                    from anovos_tpu.continuum.watcher import ContinuumConfig
+                    from anovos_tpu.continuum.watcher import step as continuum_step
+
+                    base = report_input_path or (write_main or {}).get("file_path") or "."
+                    summary = continuum_step(
+                        ContinuumConfig.from_dict(c_args, base_dir=base))
+                    logger.info(
+                        "continuous_analysis: folded=%d quarantined=%d "
+                        "alerts=%d partitions=%d",
+                        len(summary["folded"]), len(summary["quarantined"]),
+                        summary["alerts"], summary["partitions"])
+                pipe.aside("continuous_analysis/step", _continuum_step,
+                           timed="continuous_analysis",
+                           placement="device")
                 continue
 
             if key == "report_preprocessing" and args is not None:

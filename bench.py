@@ -457,6 +457,11 @@ def e2e_cold_warm() -> dict:
             result.update(e2e_oocore())
         except Exception as e:  # oocore section must never sink the headline
             result["e2e_oocore_error"] = str(e)[-200:]
+    if os.environ.get("BENCH_CONTINUUM", "1") == "1":
+        try:
+            result.update(e2e_continuum())
+        except Exception as e:  # continuum section must never sink the headline
+            result["e2e_continuum_error"] = str(e)[-200:]
     return result
 
 
@@ -536,6 +541,44 @@ def e2e_oocore() -> dict:
             f"streaming rows/s fell to {ratio}x of the in-memory path "
             "(acceptance floor 0.8x)")
         print("bench: " + out["e2e_oocore_error"], file=sys.stderr)
+    return out
+
+
+def e2e_continuum() -> dict:
+    """Continuous feature engineering trajectory (anovos_tpu.continuum,
+    round 13): run the ``tools/continuum_bench`` 30-day simulated feed
+    (schema drift mid-month, one corrupt day, a distribution shift) in a
+    fresh process and lift the per-day incremental fold wall, its ratio
+    to a from-scratch batch run over the union, and the alert count into
+    the round record.  Byte parity between the two legs is the hard
+    gate; a violation lands as ``e2e_continuum_error``.
+    ``BENCH_CONTINUUM=0`` skips; BENCH_CONTINUUM_DAYS/ROWS resize."""
+    env = {**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS") or "cpu"}
+    for k in ("ANOVOS_TPU_CHAOS", "ANOVOS_TPU_CACHE", "XLA_FLAGS"):
+        env.pop(k, None)
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.continuum_bench", "--json"],
+        capture_output=True, text=True, env=env, timeout=E2E_TIMEOUT,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    out: dict = {}
+    rec = _last_json_line(p.stdout)
+    if rec is None:
+        out["e2e_continuum_error"] = (
+            f"continuum bench produced no result (rc={p.returncode}): "
+            + (p.stderr or p.stdout)[-160:])
+        return out
+    out["e2e_continuum_fold_s"] = rec.get("e2e_continuum_fold_s")
+    out["e2e_continuum_vs_batch_ratio"] = rec.get("e2e_continuum_vs_batch_ratio")
+    out["e2e_continuum_alerts"] = rec.get("e2e_continuum_alerts")
+    out["e2e_continuum_day30_vs_day2"] = rec.get("continuum_day30_vs_day2")
+    out["e2e_continuum_parity"] = rec.get("continuum_parity")
+    if not rec.get("ok"):
+        out["e2e_continuum_error"] = (
+            f"continuum gate failed: parity={rec.get('continuum_parity')} "
+            f"quarantined={rec.get('continuum_quarantined')} "
+            f"alerts={rec.get('e2e_continuum_alerts')}")
+        print("bench: " + out["e2e_continuum_error"], file=sys.stderr)
     return out
 
 

@@ -261,6 +261,14 @@ def bench_e2e() -> dict:
         "serve_cold_start_s": r.get("e2e_serve_cold_start_s"),
         "serve_parity": r.get("e2e_serve_parity"),
         "serve_error": r.get("e2e_serve_error"),
+        # continuum feed (bench.e2e_continuum, round 13): per-day
+        # incremental fold wall vs the from-scratch batch run, parity,
+        # and the shift-day alert count
+        "continuum_fold_s": r.get("e2e_continuum_fold_s"),
+        "continuum_vs_batch_ratio": r.get("e2e_continuum_vs_batch_ratio"),
+        "continuum_alerts": r.get("e2e_continuum_alerts"),
+        "continuum_parity": r.get("e2e_continuum_parity"),
+        "continuum_error": r.get("e2e_continuum_error"),
     }
 
 

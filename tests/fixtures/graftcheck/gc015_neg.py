@@ -1,0 +1,72 @@
+"""GC015 negative fixture: the sanctioned accumulator shapes — merge in
+the class body, merge through a local base, or the imported
+``Accumulator`` contract base (which owns both halves)."""
+
+import numpy as np
+
+from anovos_tpu.continuum.sufficient import Accumulator
+
+
+class CountAccumulator:
+    """Both halves in the body: a complete monoid."""
+
+    name = "count"
+
+    @classmethod
+    def from_chunk(cls, part, ctx, part_key):
+        return {part_key: {"n": np.asarray(len(part), np.int64)}}
+
+    @staticmethod
+    def merge(a, b):
+        return {**a, **b}
+
+    @classmethod
+    def finalize(cls, state, ctx):
+        return sum(int(p["n"]) for p in state.values())
+
+
+class LocalBase:
+    @staticmethod
+    def merge(a, b):
+        return {**a, **b}
+
+
+class SumAccumulator(LocalBase):
+    """merge inherited from a local base."""
+
+    name = "sum"
+
+    @classmethod
+    def from_chunk(cls, part, ctx, part_key):
+        return {part_key: {"s": part.sum().to_numpy()}}
+
+    @classmethod
+    def finalize(cls, state, ctx):
+        return state
+
+
+class MinMaxAccumulator(Accumulator):
+    """The registered contract base carries from_chunk AND merge; the
+    family only adds its per-partition pieces."""
+
+    name = "minmax"
+
+    @classmethod
+    def part_stats(cls, part, ctx):
+        return {"min": part.min().to_numpy(), "max": part.max().to_numpy()}
+
+    @classmethod
+    def combine(cls, x, y):
+        return {"min": np.minimum(x["min"], y["min"]),
+                "max": np.maximum(x["max"], y["max"])}
+
+    @classmethod
+    def finalize(cls, state, ctx):
+        return cls.reduce(state)
+
+
+class NotAnAccumulator:
+    """Neither method: out of scope."""
+
+    def transform(self, df):
+        return df

@@ -1,0 +1,31 @@
+"""GC015 positive fixture: accumulators with a ``from_chunk`` and no
+``merge`` anywhere in their local hierarchy — the continuum fold loop
+could ingest their partials but never combine or retract them."""
+
+import numpy as np
+
+
+class RunningQuantileAccumulator:
+    """from_chunk but no merge: the sketch cannot fold."""
+
+    name = "running_quantile"
+
+    @classmethod
+    def from_chunk(cls, part, ctx, part_key):
+        return {part_key: {"values": np.sort(part.to_numpy())}}
+
+    @classmethod
+    def finalize(cls, state, ctx):
+        return state
+
+
+class TopKBase:
+    """A base that also lacks merge — inheriting it does not help."""
+
+    def finalize(self, state, ctx):
+        return state
+
+
+class TopKCounts(TopKBase):
+    def from_chunk(self, part, ctx, part_key):
+        return {part_key: {"top": part.head(10)}}

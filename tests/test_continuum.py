@@ -1,0 +1,592 @@
+"""anovos_tpu.continuum — the partition-arrival loop over mergeable
+sufficient statistics (round 13).
+
+Pins the subsystem's contract from the monoid up: exact associativity /
+order-insensitivity of every accumulator family's ``merge``, byte parity
+between a shuffled incremental feed and a from-scratch batch run over
+the union (schema drift + a corrupt day + a distribution shift planted),
+mid-fold kill + resume from the WAL frontier with zero re-decoded
+committed parts, snapshot restore through the PR 5 cache store, the
+affected-sections-only report re-render, the per-arrival alert stream
+with flight-recorder context, and the ``continuous_analysis`` workflow
+node.  The ``model_io`` same-mtime-rewrite regression (this round's
+memo-key fix) rides along at the bottom.
+"""
+
+import hashlib
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+from collections import Counter
+
+import numpy as np
+import pandas as pd
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from anovos_tpu.continuum.sufficient import (  # noqa: E402
+    ACCUMULATORS,
+    DriftSpec,
+    FoldContext,
+    PartFrame,
+)
+from anovos_tpu.continuum.state import ContinuumState, part_signature  # noqa: E402
+from anovos_tpu.continuum.watcher import (  # noqa: E402
+    ContinuumConfig,
+    poll_seconds,
+    status,
+    step,
+)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def _tree_hash(root, exclude=("obs",)) -> str:
+    h = hashlib.sha256()
+    root = pathlib.Path(root)
+    for p in sorted(root.rglob("*")):
+        if p.is_file() and not any(part in exclude for part in p.parts):
+            h.update(str(p.relative_to(root)).encode())
+            h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+def _day_frame(rng, rows=300, shift=0.0, extra=False) -> pd.DataFrame:
+    df = pd.DataFrame({
+        "a": rng.normal(10.0 + shift, 2.0, rows),
+        "b": rng.exponential(5.0, rows),
+        "cat": rng.choice(["x", "y", "z"], rows),
+    })
+    if extra:
+        df["extra"] = rng.normal(0.0, 1.0, rows)
+    return df
+
+
+def _write_feed(root, days, corrupt=(), rng_seed=7):
+    """days: {day number: kwargs for _day_frame}; corrupt: day numbers
+    whose parquet becomes garbage bytes."""
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(rng_seed)
+    for i, kw in sorted(days.items()):
+        path = os.path.join(root, f"day-{i:02d}.parquet")
+        _day_frame(rng, **kw).to_parquet(path, index=False)
+        if i in corrupt:
+            with open(path, "wb") as f:
+                f.write(b"NOTPARQUET" * 120)
+
+
+def _cfg(workdir, tag, feed_dir=None, drift=True, **extra) -> ContinuumConfig:
+    spec = {
+        "dataset_path": feed_dir or os.path.join(workdir, tag, "feed"),
+        "state_dir": os.path.join(workdir, tag, "state"),
+        "output_path": os.path.join(workdir, tag, "out"),
+        **extra,
+    }
+    if drift:
+        spec["drift"] = {"baseline": "day-01*", "threshold": 0.25}
+    return ContinuumConfig.from_dict(spec, base_dir=str(workdir))
+
+
+def _parts_from_frames(frames, ctx, family):
+    return {
+        key: ACCUMULATORS[family].from_chunk(PartFrame(df, ctx), ctx, key)
+        for key, df in frames.items()
+    }
+
+
+def _maps_equal(a, b) -> bool:
+    if sorted(a) != sorted(b):
+        return False
+    for k in a:
+        if sorted(a[k]) != sorted(b[k]):
+            return False
+        for name in a[k]:
+            if not np.array_equal(np.asarray(a[k][name]), np.asarray(b[k][name])):
+                return False
+    return True
+
+
+def _partials_equal(x, y) -> bool:
+    if sorted(x) != sorted(y):
+        return False
+    return all(np.array_equal(np.asarray(x[n]), np.asarray(y[n])) for n in x)
+
+
+# ---------------------------------------------------------------------------
+# the monoid: associativity + order-insensitivity, per family
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def fold_ctx(tmp_path_factory):
+    """A context with every family active: outlier bounds + fitted drift
+    cutoffs (a tiny persisted model so drift_target and the source-freq
+    read path both run)."""
+    root = tmp_path_factory.mktemp("ctx")
+    model_dir = os.path.join(str(root), "drift_model")
+    cuts = {"a": np.linspace(5.0, 15.0, 9), "b": np.linspace(0.5, 20.0, 9)}
+    from anovos_tpu.data_transformer.model_io import save_model_df
+
+    save_model_df(
+        pd.DataFrame({"attribute": list(cuts),
+                      "parameters": [list(map(float, v)) for v in cuts.values()]}),
+        model_dir, "attribute_binning")
+    for c, keys in (("a", list(range(1, 11))), ("b", list(range(1, 11))),
+                    ("cat", ["x", "y", "z"])):
+        d = os.path.join(model_dir, "frequency_counts", c)
+        os.makedirs(d, exist_ok=True)
+        n = len(keys)
+        pd.DataFrame({c: keys, "p": [1.0 / n] * n}).to_csv(
+            os.path.join(d, "part-00000.csv"), index=False)
+    return FoldContext(
+        hll_p=8,
+        outlier_bounds={"a": (5.0, 15.0), "b": (0.0, 20.0)},
+        drift=DriftSpec(model_dir=model_dir, baseline="day-01*"),
+        drift_cutoffs=cuts,
+    )
+
+
+@pytest.fixture(scope="module")
+def three_frames():
+    rng = np.random.default_rng(11)
+    return {
+        "p1": _day_frame(rng, rows=200),
+        "p2": _day_frame(rng, rows=150, shift=3.0),
+        "p3": _day_frame(rng, rows=250, extra=True),  # schema drift
+    }
+
+
+@pytest.mark.parametrize("family", sorted(ACCUMULATORS))
+def test_merge_is_associative_and_order_insensitive(family, fold_ctx, three_frames):
+    """merge(a, merge(b, c)) == merge(merge(a, b), c) EXACTLY, and every
+    permutation yields the same state — the monoid law the whole
+    incremental service rests on."""
+    acc = ACCUMULATORS[family]
+    parts = _parts_from_frames(three_frames, fold_ctx, family)
+    a, b, c = parts["p1"], parts["p2"], parts["p3"]
+    left = acc.merge(acc.merge(a, b), c)
+    right = acc.merge(a, acc.merge(b, c))
+    assert _maps_equal(left, right)
+    shuffled = acc.merge(c, acc.merge(a, b))
+    assert _maps_equal(left, shuffled)
+    # idempotent on the same key, and a content collision raises
+    assert _maps_equal(acc.merge(left, a), left)
+    with pytest.raises(ValueError):
+        acc.merge(left, {"p1": b["p2"]})
+
+
+@pytest.mark.parametrize("family", ["missing", "hll", "categorical",
+                                    "outlier", "drift_target"])
+def test_exact_families_combine_associative(family, fold_ctx, three_frames):
+    """The integer/register families' pairwise ``combine`` is itself
+    bitwise associative (float moments rely on the canonical reduce
+    instead — covered by the shuffled-parity tests)."""
+    acc = ACCUMULATORS[family]
+    parts = _parts_from_frames(three_frames, fold_ctx, family)
+    x, y, z = (parts[k][k2] for k, k2 in
+               (("p1", "p1"), ("p2", "p2"), ("p3", "p3")))
+    assert _partials_equal(acc.combine(acc.combine(x, y), z),
+                           acc.combine(x, acc.combine(y, z)))
+    assert _partials_equal(acc.combine(x, y), acc.combine(y, x))
+
+
+@pytest.mark.parametrize("family", sorted(ACCUMULATORS))
+def test_finalize_invariant_under_fold_order(family, fold_ctx, three_frames):
+    """finalize over any arrival order is byte-identical (the canonical
+    sorted-key reduce makes even the float moment family exact)."""
+    acc = ACCUMULATORS[family]
+    parts = _parts_from_frames(three_frames, fold_ctx, family)
+    orders = (("p1", "p2", "p3"), ("p3", "p1", "p2"), ("p2", "p3", "p1"))
+    outs = []
+    for order in orders:
+        state = {}
+        for k in order:
+            state = acc.merge(state, parts[k])
+        outs.append(ACCUMULATORS[family].finalize(state, fold_ctx))
+    ref = outs[0].to_csv(index=False)
+    assert all(o.to_csv(index=False) == ref for o in outs[1:])
+
+
+def test_hll_register_merge_matches_concat(fold_ctx):
+    """Register max of per-part sketches == the sketch of the
+    concatenation — the mergeable-sketch law, exact (satellite: HLL
+    merging lifted into the contract)."""
+    from anovos_tpu.ops.hll import hll_registers
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    a = rng.normal(0, 1, (500, 3)).astype(np.float32)
+    b = rng.normal(2, 1, (300, 3)).astype(np.float32)
+    p = 8
+    ra = np.asarray(hll_registers(jnp.asarray(a), jnp.ones(a.shape, bool), p))
+    rb = np.asarray(hll_registers(jnp.asarray(b), jnp.ones(b.shape, bool), p))
+    rc = np.asarray(hll_registers(jnp.asarray(np.vstack([a, b])),
+                                  jnp.ones((800, 3), bool), p))
+    assert np.array_equal(np.maximum(ra, rb), rc)
+
+
+def test_retraction_removes_contribution(fold_ctx, three_frames):
+    """Keyed-union state subtracts a retracted partition exactly — the
+    capability eager max/register merging cannot provide."""
+    acc = ACCUMULATORS["moments"]
+    parts = _parts_from_frames(three_frames, fold_ctx, "moments")
+    full = acc.merge(acc.merge(parts["p1"], parts["p2"]), parts["p3"])
+    without = dict(full)
+    without.pop("p2")
+    direct = acc.merge(parts["p1"], parts["p3"])
+    assert (acc.finalize(without, fold_ctx).to_csv(index=False)
+            == acc.finalize(direct, fold_ctx).to_csv(index=False))
+
+
+# ---------------------------------------------------------------------------
+# state: scan / adopt / snapshot-restore
+# ---------------------------------------------------------------------------
+def test_scan_classifies_new_changed_retracted(tmp_path):
+    feed = os.path.join(str(tmp_path), "feed")
+    _write_feed(feed, {1: {}, 2: {}, 3: {}})
+    cfg = _cfg(str(tmp_path), "t", feed_dir=feed, drift=False)
+    step(cfg)
+    # change day-02 (new signature), retract day-03, land day-04
+    rng = np.random.default_rng(99)
+    _day_frame(rng, rows=123).to_parquet(os.path.join(feed, "day-02.parquet"),
+                                         index=False)
+    os.unlink(os.path.join(feed, "day-03.parquet"))
+    _day_frame(rng, rows=50).to_parquet(os.path.join(feed, "day-04.parquet"),
+                                        index=False)
+    s = step(cfg)
+    assert s["scan"]["changed"] == ["day-02.parquet"]
+    assert s["scan"]["retracted"] == ["day-03.parquet"]
+    assert s["scan"]["new"] == ["day-04.parquet"]
+    assert s["partitions"] == 3 and s["rows"] == 300 + 123 + 50
+
+
+def test_orphan_npz_adopted_without_decode(tmp_path):
+    """Crash window between the npz rename and the manifest flush: the
+    orphan partial's embedded meta recovers it with zero decode."""
+    feed = os.path.join(str(tmp_path), "feed")
+    _write_feed(feed, {1: {}, 2: {}})
+    cfg = _cfg(str(tmp_path), "t", feed_dir=feed, drift=False)
+    step(cfg)
+    # simulate the crash: drop day-02 from the manifest, keep its npz
+    mpath = os.path.join(cfg.state_dir, "state_manifest.json")
+    with open(mpath) as f:
+        doc = json.load(f)
+    doc["parts"].pop("day-02.parquet")
+    with open(mpath, "w") as f:
+        json.dump(doc, f)
+    s = step(cfg)
+    assert s["folded"] == []  # adopted, never re-decoded
+    recs = [json.loads(l) for l in
+            open(os.path.join(cfg.state_dir, "continuum_journal.jsonl"))]
+    assert any(r.get("event") == "partition_seen" and r.get("status") == "adopted"
+               and r.get("part") == "day-02.parquet" for r in recs)
+    fc = Counter(r["part"] for r in recs if r.get("event") == "fold_commit")
+    assert fc["day-02.parquet"] == 1
+
+
+def test_snapshot_restore_from_store(tmp_path):
+    """A lost state dir rebuilds from the newest content-addressed
+    snapshot; the re-finalized artifacts are byte-identical."""
+    feed = os.path.join(str(tmp_path), "feed")
+    _write_feed(feed, {1: {}, 2: {}, 3: {}})
+    cache = os.path.join(str(tmp_path), "snapstore")
+    cfg = _cfg(str(tmp_path), "t", feed_dir=feed, drift=False, cache_dir=cache)
+    step(cfg)
+    ref = _tree_hash(cfg.output_path)
+    shutil.rmtree(cfg.state_dir)
+    shutil.rmtree(cfg.output_path)
+    s = step(cfg)
+    assert s["folded"] == []  # every partition restored, none re-decoded
+    assert s["partitions"] == 3
+    assert _tree_hash(cfg.output_path) == ref
+    recs = [json.loads(l) for l in
+            open(os.path.join(cfg.state_dir, "continuum_journal.jsonl"))]
+    assert any(r.get("event") == "state_restored" for r in recs)
+
+
+def _write_drift_model(model_dir, lo, hi):
+    """A tiny persisted drift model (attribute_binning + frequencies)."""
+    from anovos_tpu.data_transformer.model_io import save_model_df
+    from anovos_tpu.drift_stability.drift_detector import save_frequency_map
+
+    cuts = {"a": np.linspace(lo, hi, 9), "b": np.linspace(0.5, 20.0, 9)}
+    save_model_df(
+        pd.DataFrame({"attribute": list(cuts),
+                      "parameters": [list(map(float, v)) for v in cuts.values()]}),
+        model_dir, "attribute_binning")
+    for c in ("a", "b"):
+        save_frequency_map(model_dir, c, list(range(1, 11)), [0.1] * 10)
+    save_frequency_map(model_dir, "cat", ["x", "y", "z"], [1 / 3] * 3)
+
+
+def test_swapped_drift_model_invalidates_and_refolds(tmp_path):
+    """A swapped persisted model (new cutoffs, same path) must NOT merge
+    with histograms binned over the old edges: the family basis changes,
+    partials strip (``family_invalidated`` WAL), every partition
+    re-folds, and artifacts equal a fresh run against the new model."""
+    work = str(tmp_path)
+    feed = os.path.join(work, "feed")
+    _write_feed(feed, {1: {}, 2: {}})
+    model = os.path.join(work, "modelA")
+    _write_drift_model(model, 5.0, 15.0)
+    cfg = _cfg(work, "t", feed_dir=feed, drift=False)
+    cfg.drift = {"model_path": model}
+    step(cfg)
+    # swap the model in place: different cutoff range
+    shutil.rmtree(model)
+    _write_drift_model(model, 0.0, 30.0)
+    s = step(cfg)
+    assert s["refolded"] == ["day-01.parquet", "day-02.parquet"]
+    recs = [json.loads(l) for l in
+            open(os.path.join(cfg.state_dir, "continuum_journal.jsonl"))]
+    assert any(r.get("event") == "family_invalidated"
+               and r.get("family") == "drift_target" for r in recs)
+    # fresh leg straight against model B must agree byte-for-byte
+    ref = _cfg(work, "ref", feed_dir=feed, drift=False)
+    ref.drift = {"model_path": model}
+    step(ref)
+    assert (open(os.path.join(cfg.output_path, "continuum_drift.csv")).read()
+            == open(os.path.join(ref.output_path, "continuum_drift.csv")).read())
+
+
+def test_foreign_config_orphans_not_adopted(tmp_path):
+    """A feed-config change starts the state fresh — the old config's
+    partial npzs must NOT be adopted (their embedded config_sig
+    differs); every partition re-folds under the new config."""
+    feed = os.path.join(str(tmp_path), "feed")
+    _write_feed(feed, {1: {}, 2: {}})
+    cfg = _cfg(str(tmp_path), "t", feed_dir=feed, drift=False)
+    step(cfg)
+    cfg2 = _cfg(str(tmp_path), "t", feed_dir=feed, drift=False, hll_rsd=0.02)
+    assert cfg2.config_sig() != cfg.config_sig()
+    s = step(cfg2)
+    assert sorted(s["folded"]) == ["day-01.parquet", "day-02.parquet"]
+    recs = [json.loads(l) for l in
+            open(os.path.join(cfg2.state_dir, "continuum_journal.jsonl"))]
+    assert not any(r.get("status") == "adopted" for r in recs
+                   if r.get("event") == "partition_seen")
+
+
+# ---------------------------------------------------------------------------
+# the headline gate: incremental == from-scratch batch, faults planted
+# ---------------------------------------------------------------------------
+def test_incremental_matches_batch_with_planted_faults(tmp_path):
+    """Shuffled day-by-day arrivals (schema drift day 3, corrupt day 4,
+    distribution shift day 5) vs ONE step over the union from empty
+    state: byte-identical artifact trees (obs/ excluded), the corrupt
+    day quarantined on both legs, and the shift day's drift alert
+    carrying flight-recorder context."""
+    work = str(tmp_path)
+    src = os.path.join(work, "alldays")
+    _write_feed(src, {1: {}, 2: {}, 3: {"extra": True},
+                      4: {}, 5: {"shift": 5.0}, 6: {}}, corrupt=(4,))
+    from anovos_tpu.data_ingest import guard
+
+    inc = _cfg(work, "inc")
+    os.makedirs(inc.dataset_path)
+    guard.reset()
+    alerts_by_day = {}
+    for i in (1, 3, 2, 4, 6, 5):  # shuffled arrival order
+        shutil.copy2(os.path.join(src, f"day-{i:02d}.parquet"),
+                     os.path.join(inc.dataset_path, f"day-{i:02d}.parquet"))
+        alerts_by_day[i] = step(inc)["alerts"]
+    bat = _cfg(work, "bat", feed_dir=src)
+    guard.reset()
+    sb = step(bat)
+    assert _tree_hash(inc.output_path) == _tree_hash(bat.output_path)
+    assert sb["quarantined"] == ["day-04.parquet"]
+    assert status(inc)["quarantined"] == ["day-04.parquet"]
+    assert alerts_by_day[4] >= 1  # the quarantine alert
+    assert alerts_by_day[5] >= 1  # the shift-day drift alert
+    alines = [json.loads(l) for l in open(os.path.join(
+        inc.output_path, "obs", "continuum_alerts.jsonl"))]
+    drift_alerts = [a for a in alines if a["kind"] == "drift"
+                    and a["partition"] == "day-05.parquet"]
+    assert drift_alerts, alines
+    assert drift_alerts[0]["value"] > drift_alerts[0]["threshold"]
+    assert drift_alerts[0]["flight"], "alert carries no flight-recorder context"
+    assert any(a["kind"] == "quarantine" and a["partition"] == "day-04.parquet"
+               for a in alines)
+
+
+def test_fixed_corrupt_day_refolds(tmp_path):
+    """A corrupt day is remembered by signature — and a REWRITTEN (fixed)
+    day re-attempts and folds."""
+    feed = os.path.join(str(tmp_path), "feed")
+    _write_feed(feed, {1: {}, 2: {}}, corrupt=(2,))
+    cfg = _cfg(str(tmp_path), "t", feed_dir=feed, drift=False)
+    s = step(cfg)
+    assert s["quarantined"] == ["day-02.parquet"]
+    s = step(cfg)  # unchanged corrupt part: not re-attempted
+    assert s["quarantined"] == [] and s["folded"] == []
+    assert s["scan"]["quarantined"] == ["day-02.parquet"]
+    rng = np.random.default_rng(1)
+    _day_frame(rng, rows=77).to_parquet(
+        os.path.join(feed, "day-02.parquet"), index=False)
+    s = step(cfg)
+    assert s["folded"] == ["day-02.parquet"]
+    assert status(cfg)["quarantined"] == []
+
+
+# ---------------------------------------------------------------------------
+# mid-fold kill + resume (fresh-process CLI, chaos-injected abort)
+# ---------------------------------------------------------------------------
+def _run_cli(args, chaos=None, cwd=REPO):
+    env = {**os.environ, "PYTHONPATH": REPO}
+    env.pop("ANOVOS_TPU_CHAOS", None)
+    if chaos:
+        env["ANOVOS_TPU_CHAOS"] = chaos
+    return subprocess.run(
+        [sys.executable, "-m", "anovos_tpu.continuum", *args],
+        env=env, cwd=cwd, capture_output=True, text=True, timeout=300)
+
+
+def test_midfold_kill_and_resume_no_redecode(tmp_path):
+    """Kill the step between a partition's ``fold_commit`` and the
+    ``snapshot_commit`` (chaos exc at the post-commit site), restart:
+    the journal frontier replays to the same golden tree hash and NO
+    committed part is decoded twice (fold_commit count stays 1)."""
+    work = str(tmp_path)
+    feed = os.path.join(work, "feed")
+    _write_feed(feed, {i: {} for i in range(1, 5)})
+
+    def cli(tag, chaos=None):
+        return _run_cli(["step", "--json", "--dataset", feed,
+                         "--state-dir", os.path.join(work, tag, "state"),
+                         "--output", os.path.join(work, tag, "out")],
+                        chaos=chaos)
+
+    r = cli("ref")
+    assert r.returncode == 0, r.stderr[-2000:]
+    r = cli("crash", chaos="seed=1;exc@continuum:fold_committed:day-02*:n=1")
+    assert r.returncode != 0  # the injected mid-fold abort
+    r = cli("crash")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert (_tree_hash(os.path.join(work, "ref", "out"))
+            == _tree_hash(os.path.join(work, "crash", "out")))
+    recs = [json.loads(l) for l in open(
+        os.path.join(work, "crash", "state", "continuum_journal.jsonl"))]
+    fc = Counter(r["part"] for r in recs if r.get("event") == "fold_commit")
+    assert fc and all(v == 1 for v in fc.values()), fc
+    assert sum(1 for r in recs if r.get("event") == "snapshot_commit") == 1
+
+
+# ---------------------------------------------------------------------------
+# report re-render, alerts knob, poll knob, workflow node, CLI status
+# ---------------------------------------------------------------------------
+def test_report_rerenders_only_affected_sections(tmp_path):
+    feed = os.path.join(str(tmp_path), "feed")
+    _write_feed(feed, {1: {}, 2: {}})
+    cfg = _cfg(str(tmp_path), "t", feed_dir=feed, drift=False)
+    s1 = step(cfg)
+    assert "stats" in s1["sections_rendered"] and not s1["sections_reused"]
+    rng = np.random.default_rng(2)
+    _day_frame(rng, rows=100).to_parquet(
+        os.path.join(feed, "day-03.parquet"), index=False)
+    s2 = step(cfg)
+    # missing stays all-zero → its fragment digest is unchanged → reused
+    assert "missing" in s2["sections_reused"]
+    assert "stats" in s2["sections_rendered"]
+    s3 = step(cfg)  # no arrivals: nothing recomputes, nothing re-renders
+    assert s3["folded"] == [] and s3["sections_rendered"] == []
+    assert os.path.exists(os.path.join(cfg.output_path, "continuum_report.html"))
+
+
+def test_alerts_knob_disables_emission(tmp_path, monkeypatch):
+    from anovos_tpu.continuum import alerts as alerts_mod
+
+    monkeypatch.setenv("ANOVOS_CONTINUUM_ALERTS", "0")
+    out = alerts_mod.emit([{"kind": "drift", "partition": "p"}],
+                          str(tmp_path), None)
+    assert out == []
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           "continuum_alerts.jsonl"))
+
+
+def test_poll_seconds_env_override(monkeypatch):
+    assert poll_seconds(30.0) == 30.0
+    monkeypatch.setenv("ANOVOS_CONTINUUM_POLL_S", "2.5")
+    assert poll_seconds(30.0) == 2.5
+    monkeypatch.setenv("ANOVOS_CONTINUUM_POLL_S", "junk")
+    assert poll_seconds(30.0) == 30.0
+
+
+def test_continuum_knobs_registered():
+    from anovos_tpu.cache.fingerprint import KNOWN_ENV_KNOBS
+
+    assert "ANOVOS_CONTINUUM_POLL_S" in KNOWN_ENV_KNOBS
+    assert "ANOVOS_CONTINUUM_ALERTS" in KNOWN_ENV_KNOBS
+
+
+def test_workflow_continuous_analysis_node(tmp_path, monkeypatch):
+    """A continuous_analysis config section runs one continuum step as a
+    scheduler node (no input_dataset needed — continuum mode skips ETL)."""
+    from anovos_tpu import workflow
+
+    work = str(tmp_path)
+    feed = os.path.join(work, "feed")
+    _write_feed(feed, {1: {}, 2: {}})
+    monkeypatch.chdir(work)
+    workflow.main({
+        "continuous_analysis": {
+            "dataset_path": feed,
+            "state_dir": os.path.join(work, "state"),
+            "output_path": os.path.join(work, "out"),
+        },
+        "report_preprocessing": {"master_path": os.path.join(work, "rep")},
+    }, "local")
+    assert os.path.exists(os.path.join(work, "out", "continuum_stats.csv"))
+    assert os.path.exists(os.path.join(work, "out", "continuum_report.html"))
+    summary = workflow.LAST_RUN_SUMMARY
+    assert "continuous_analysis/step" in summary.get("nodes", {})
+
+
+def test_cli_status_and_run_loop(tmp_path):
+    work = str(tmp_path)
+    feed = os.path.join(work, "feed")
+    _write_feed(feed, {1: {}})
+    r = _run_cli(["run", "--json", "--max-iterations", "1", "--poll", "0",
+                  "--dataset", feed,
+                  "--state-dir", os.path.join(work, "state"),
+                  "--output", os.path.join(work, "out")])
+    assert r.returncode == 0, r.stderr[-2000:]
+    doc = json.loads(r.stdout.strip().splitlines()[-1])
+    assert doc["iterations"] == 1
+    r = _run_cli(["status", "--json", "--dataset", feed,
+                  "--state-dir", os.path.join(work, "state"),
+                  "--output", os.path.join(work, "out")])
+    assert r.returncode == 0, r.stderr[-2000:]
+    st = json.loads(r.stdout.strip().splitlines()[-1])
+    assert st["partitions"] == 1 and st["last_snapshot"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: model_io memo must not serve a stale frame on a same-mtime,
+# same-size rewrite (footer digest now rides the key)
+# ---------------------------------------------------------------------------
+def test_model_io_same_mtime_same_size_rewrite_invalidates(tmp_path):
+    from anovos_tpu.data_transformer.model_io import load_model_df, save_model_df
+
+    root = str(tmp_path)
+    df1 = pd.DataFrame({"attribute": ["a"], "parameters": ["AAAA"]})
+    save_model_df(df1, root, "m", fmt="csv")
+    path = os.path.join(root, "m", "part-00000.csv")
+    st = os.stat(path)
+    got = load_model_df(root, "m", fmt="csv")
+    assert got["parameters"].iloc[0] == "AAAA"  # memo populated
+    # same-size rewrite with the original mtime restored (the
+    # tar-extract / coarse-clock hole): bytes differ, stat sig without
+    # the footer digest would NOT
+    df2 = pd.DataFrame({"attribute": ["a"], "parameters": ["BBBB"]})
+    save_model_df(df2, root, "m", fmt="csv")
+    assert os.path.getsize(path) == st.st_size
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns))
+    assert os.stat(path).st_mtime_ns == st.st_mtime_ns
+    got = load_model_df(root, "m", fmt="csv")
+    assert got["parameters"].iloc[0] == "BBBB", "stale memo served"

@@ -101,6 +101,15 @@ SCENARIOS = {
     # already-staged chunks — so the chaos wall stays well under the
     # synchronous penalty, with byte-identical results.
     "slowread-stream": "seed=7;slowread@io:*part-0000[0-5].parquet:secs=0.6:n=99",
+    # the CONTINUUM scenario (no chaos spec — the faults are PHYSICAL,
+    # baked into the 30-day feed by tools/continuum_bench.build_feed_30d:
+    # schema drift at day 15, garbage bytes at day 20, a distribution
+    # shift at day 25).  Gates: the incremental day-by-day leg and a
+    # from-scratch batch leg over the union produce byte-identical
+    # artifact trees (obs/ excluded), the corrupt day is quarantined on
+    # BOTH legs, and the shift day fires a drift alert carrying
+    # flight-recorder context.
+    "feed-30d": "",
 }
 
 # how many synthetic input part files a scenario's dataset is split into
@@ -691,6 +700,72 @@ def run_slowread_stream(workdir: str) -> dict:
     return result
 
 
+def run_feed_30d(workdir: str) -> dict:
+    """The continuum byte-parity gate (no workflow run, no chaos spec —
+    the 30-day feed's faults are physical).  Incremental leg: one
+    ``continuum.step`` per arriving day; batch leg: one step over the
+    whole union from empty state.  See ``tools/continuum_bench`` for the
+    feed layout; this gate reuses its builder and its legs so the bench
+    and the gate cannot drift apart."""
+    import json as _json
+
+    from tools import continuum_bench
+
+    result = {"scenario": "feed-30d", "spec": ""}
+    try:
+        r = continuum_bench.run(days=30, rows_per_day=500, workdir=workdir)
+    except Exception as e:
+        result["ok"] = False
+        result["error"] = f"{type(e).__name__}: {e}"
+        return result
+    result.update({k: v for k, v in r.items() if k != "workdir"})
+    result["parity"] = r["continuum_parity"]
+    # the shift-day alert must carry flight-recorder context: re-read the
+    # emitted stream (the incremental leg's obs/ subtree)
+    alerts_path = os.path.join(workdir, "inc", "out", "obs",
+                               "continuum_alerts.jsonl")
+    shift_alerts = []
+    if os.path.exists(alerts_path):
+        with open(alerts_path) as f:
+            for line in f:
+                try:
+                    rec = _json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") == "drift":
+                    shift_alerts.append(rec)
+    with_context = [a for a in shift_alerts if a.get("flight")]
+    result["drift_alerts"] = len(shift_alerts)
+    result["drift_alerts_with_flight_context"] = len(with_context)
+    quarantine_ok = (r["continuum_quarantined"] == ["day-20.parquet"]
+                     and r["continuum_batch_quarantined"] == ["day-20.parquet"])
+    history_flat = r["continuum_day30_vs_day2"] <= 2.0
+    result["ok"] = bool(
+        r["continuum_parity"] and quarantine_ok and history_flat
+        and r["continuum_shift_alert_day"] is not None
+        and with_context)
+    if not result["ok"]:
+        reasons = []
+        if not r["continuum_parity"]:
+            reasons.append("incremental artifacts differ from the "
+                           "from-scratch batch run over the union")
+        if not quarantine_ok:
+            reasons.append(
+                f"quarantine mismatch: inc={r['continuum_quarantined']} "
+                f"batch={r['continuum_batch_quarantined']} (want day-20 on both)")
+        if not history_flat:
+            reasons.append(
+                f"day-30 fold {r['continuum_day30_fold_s']}s is "
+                f"{r['continuum_day30_vs_day2']}x day-2 — fold wall grew "
+                "with history length")
+        if r["continuum_shift_alert_day"] is None:
+            reasons.append("no drift alert fired on/after the shift day")
+        elif not with_context:
+            reasons.append("drift alerts carry no flight-recorder context")
+        result["error"] = "; ".join(reasons)
+    return result
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="run a config under a chaos scenario; exit nonzero "
@@ -730,6 +805,10 @@ def main(argv=None) -> int:
     elif ns.scenario == "slowread-stream":
         # streaming-ingest scenario: the bound is the pool-absorption gate
         result = run_slowread_stream(workdir)
+    elif ns.scenario == "feed-30d":
+        # continuum scenario: incremental-vs-batch byte parity over the
+        # 30-day feed with the corrupt day quarantined on both legs
+        result = run_feed_30d(workdir)
     else:
         result = run_scenario(ns.scenario, workdir, config=cfg, spec=ns.spec,
                               node_timeout=ns.node_timeout)

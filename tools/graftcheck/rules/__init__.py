@@ -15,4 +15,5 @@ from tools.graftcheck.rules import (  # noqa: F401  (import = registration)
     gc012_unguarded_io,
     gc013_serving_request_path,
     gc014_sync_decode,
+    gc015_nonmergeable_accumulator,
 )

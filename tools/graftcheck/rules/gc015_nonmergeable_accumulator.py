@@ -1,0 +1,118 @@
+"""GC015 — non-mergeable accumulator in continuum-reachable code.
+
+The continuum service (``anovos_tpu/continuum``) stays O(new rows) per
+partition arrival ONLY because every per-partition statistic is a
+mergeable monoid: ``from_chunk`` produces a keyed partial, ``merge``
+folds partials associatively and order-insensitively, ``finalize``
+derives the artifact.  An accumulator that grows a ``from_chunk`` but no
+``merge`` silently breaks that contract — the only way to combine its
+state is to recompute from raw rows, which turns the incremental fold
+back into an O(history) batch job the first time a partition changes or
+retracts, with no test failing until a 30-day feed times out.
+
+This rule pins the contract statically:
+
+* **scan scope** — class definitions anywhere under ``anovos_tpu/``
+  (the continuum package is the natural home, but an accumulator
+  defined next to its kernels in ``ops/`` is just as reachable from the
+  fold loop);
+* **flagged** — a class whose body defines ``from_chunk`` (function,
+  ``classmethod``/``staticmethod`` alike) without defining or inheriting
+  a ``merge`` in the same file's class hierarchy.  Inheritance is
+  resolved by LOCAL base name (the
+  ``anovos_tpu.continuum.sufficient.Accumulator`` pattern: the base owns
+  ``from_chunk``/``merge``, families add ``part_stats``/``combine``) —
+  a base imported from another module is trusted to carry ``merge``
+  only when it resolves to the registered ``Accumulator`` contract
+  (named ``Accumulator`` or ``*Accumulator``);
+* **not flagged** — classes with both methods, or with neither (a
+  ``from_chunk``-free class is not an accumulator).
+
+Anything else needs a per-line ``# graftcheck: disable=GC015`` with a
+justifying comment or a baseline entry.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set
+
+from tools.graftcheck.registry import FileContext, Rule, register
+
+_MSG = (
+    "accumulator class {cls!r} defines from_chunk but no merge — a "
+    "non-mergeable accumulator reachable from the continuum fold loop "
+    "turns the O(new rows) incremental service back into O(history); "
+    "define merge(a, b) (associative, order-insensitive) or inherit the "
+    "anovos_tpu.continuum.sufficient.Accumulator contract"
+)
+
+
+def _method_names(cls: ast.ClassDef) -> Set[str]:
+    out = set()
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _base_names(cls: ast.ClassDef):
+    for b in cls.bases:
+        if isinstance(b, ast.Name):
+            yield b.id
+        elif isinstance(b, ast.Attribute):
+            yield b.attr
+
+
+@register
+class NonMergeableAccumulatorRule(Rule):
+    id = "GC015"
+    title = "accumulator with from_chunk but no registered merge"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("anovos_tpu/") or "gc015" in relpath
+
+    def check(self, ctx: FileContext):
+        classes: Dict[str, ast.ClassDef] = {
+            node.name: node for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.ClassDef)
+        }
+
+        def has_merge(cls: ast.ClassDef, seen: Optional[Set[str]] = None) -> bool:
+            seen = seen or set()
+            if cls.name in seen:
+                return False
+            seen.add(cls.name)
+            if "merge" in _method_names(cls):
+                return True
+            for base in _base_names(cls):
+                local = classes.get(base)
+                if local is not None and has_merge(local, seen):
+                    return True
+                # an imported base is trusted only when it names the
+                # registered contract (Accumulator / FooAccumulator)
+                if local is None and base.endswith("Accumulator"):
+                    return True
+            return False
+
+        def has_from_chunk(cls: ast.ClassDef, seen: Optional[Set[str]] = None) -> bool:
+            seen = seen or set()
+            if cls.name in seen:
+                return False
+            seen.add(cls.name)
+            if "from_chunk" in _method_names(cls):
+                return True
+            return any(
+                classes.get(b) is not None and has_from_chunk(classes[b], seen)
+                for b in _base_names(cls)
+            )
+
+        for name, cls in sorted(classes.items()):
+            if "from_chunk" not in _method_names(cls):
+                continue
+            if not has_merge(cls):
+                yield ctx.finding(self.id, cls, _MSG.format(cls=name))

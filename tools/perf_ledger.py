@@ -96,6 +96,14 @@ TRACKED_FIELDS: Dict[str, Tuple[str, float]] = {
     "e2e_oocore_rows_per_s": ("higher", 0.60),
     "e2e_oocore_peak_rss_mb": ("lower", 0.50),
     "e2e_stream_overlap_pct": ("higher", 0.40),
+    # continuum feed (round 13): per-day incremental fold wall and its
+    # ratio to a from-scratch batch run (tiny walls on a shared box →
+    # wide ±60% bands); the alert count is a correctness level — dropping
+    # to zero from the expected shift-day alerts is a regression, so it
+    # rides "higher" with the same generous band.
+    "e2e_continuum_fold_s": ("lower", 0.60),
+    "e2e_continuum_vs_batch_ratio": ("lower", 0.60),
+    "e2e_continuum_alerts": ("higher", 0.60),
 }
 BASELINE_WINDOW = 3
 
