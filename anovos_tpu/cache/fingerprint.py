@@ -42,9 +42,11 @@ __all__ = [
 # Environment variables whose value changes node ARTIFACTS.  Pure
 # performance/telemetry knobs (worker counts, timeouts, trace paths, probe
 # budgets, and the obs knobs ANOVOS_TPU_DEVPROF / ANOVOS_TPU_FLIGHTREC /
-# ANOVOS_PERF_LEDGER — their outputs live under the parity-excluded obs/
-# subtree) deliberately stay off the list — they must NOT invalidate the
-# cache.
+# ANOVOS_PERF_LEDGER / ANOVOS_TPU_TELEMETRY / ANOVOS_TPU_TRACE_ROTATE /
+# ANOVOS_TPU_SLO_ERROR_BUDGET — the live telemetry plane and trace
+# rotation only READ run state, and their outputs live under the
+# parity-excluded obs/ subtree) deliberately stay off the list — they
+# must NOT invalidate the cache.
 # The serving knobs (ANOVOS_SERVE_BATCH_WINDOW_MS, ANOVOS_SERVE_MAX_BATCH,
 # ANOVOS_SERVE_BF16) are a deliberate exemption too: they are read only by
 # anovos_tpu/serving/, which never executes as a scheduler node — no node

@@ -244,9 +244,15 @@ def _decode_parts(cfg: ContinuumConfig, state: ContinuumState,
     pool = (DecodePool(files, cfg.file_type, dict(cfg.file_configs or {}),
                        ctl, stats=stats, journal=state.journal)
             if ctl.workers > 0 else None)
+    from anovos_tpu.obs import telemetry
+
     try:
         for fi, (key, f) in enumerate(zip(keys, files)):
             sig = part_signature(f)
+            # decode is the longest phase of a catch-up step: keep the
+            # service loop's heartbeat (if one is registered) fresh per
+            # part so /healthz never pages a watcher that is busy decoding
+            telemetry.refresh_heartbeat("continuum_watcher")
             try:
                 if pool is not None:
                     frames[key] = pool.fetch(fi, f)
@@ -485,14 +491,27 @@ def step(cfg: ContinuumConfig) -> dict:
     quarantined: List[str] = []
     model_fitted = False
     root = os.path.abspath(cfg.dataset_path)
+    # live telemetry: the fold backlog is scrapeable the moment the scan
+    # lands (mid-fold reads see the arrivals still pending)
+    backlog_gauge = get_metrics().gauge(
+        "continuum_fold_backlog",
+        "arrived partitions not yet folded into the continuum state")
+    backlog_gauge.set(float(len(to_fold)))
 
     def _fold_batch(keys: List[str]) -> None:
+        from anovos_tpu.obs import telemetry
+
         frames, bad = _decode_parts(cfg, state, keys)
         quarantined.extend(bad)
         for key in sorted(frames):
             path = os.path.join(root, key)
             state.fold_part(key, path, frames[key], part_signature(path) or "gone")
             folded.append(key)
+            # keepalive through a long catch-up fold: refresh the SERVICE
+            # loop's heartbeat (if one is registered — one-shot steps
+            # never register) per committed partition, so a 30-partition
+            # burst does not page /healthz stale mid-fold
+            telemetry.refresh_heartbeat("continuum_watcher")
 
     t_fold0 = time.monotonic()
     if (ctx.drift is not None and ctx.drift_cutoffs is None
@@ -532,14 +551,21 @@ def step(cfg: ContinuumConfig) -> dict:
         if not set(active_families(ctx, k)) <= set(
             state.parts[k].get("families", [])))
     if pending:
+        from anovos_tpu.obs import telemetry
+
         re_frames, _bad = _decode_parts(cfg, state, pending)
         for key in sorted(re_frames):
             path = os.path.join(root, key)
             state.fold_part(key, path, re_frames[key],
                             part_signature(path) or "gone")
             refolded.append(key)
+            # a basis swap refolds the WHOLE history — same keepalive as
+            # the arrival fold loop
+            telemetry.refresh_heartbeat("continuum_watcher")
 
     fold_wall_s = round(time.monotonic() - t_fold0, 4)
+    backlog_gauge.set(float(max(
+        len(to_fold) - len(folded) - len(quarantined), 0)))
 
     # re-finalize + re-render only when something moved
     arts: Dict[str, pd.DataFrame] = {}
@@ -550,6 +576,14 @@ def step(cfg: ContinuumConfig) -> dict:
             os.path.join(cfg.output_path, "continuum_report.html")):
         arts = _finalize_artifacts(cfg, state, ctx)
         _write_artifacts(cfg.output_path, arts)
+        if folded or refolded:
+            # arrival→artifact lag: scan detection of this step's
+            # arrivals through the re-finalized artifacts on disk
+            get_metrics().gauge(
+                "continuum_arrival_artifact_lag_seconds",
+                "wall from arrival detection to re-finalized artifacts "
+                "for the last folding step"
+            ).set(round(time.monotonic() - t0, 4))
         from anovos_tpu.data_report.continuum_report import render_report
 
         render = render_report(
@@ -607,18 +641,53 @@ def run(cfg: ContinuumConfig, max_iterations: Optional[int] = None,
     """The long-running service loop: a :func:`step` every poll interval
     (``ANOVOS_CONTINUUM_POLL_S`` overrides the config) until
     ``max_iterations`` or the ``stop_file`` appears."""
+    from anovos_tpu.obs import telemetry
+    from anovos_tpu.obs.tracing import maybe_rotator
+
     interval = poll_seconds(cfg.poll_s)
+    # the long-running surface owns the telemetry plane + trace rotation
+    # for its lifetime (both off by default: zero threads, no files)
+    tele = telemetry.acquire(context="continuum")
+    rotator = maybe_rotator(cfg.output_path)
     out = []
     i = 0
-    while True:
-        out.append(step(cfg))
-        i += 1
-        if max_iterations is not None and i >= max_iterations:
-            break
-        if stop_file and os.path.exists(stop_file):
-            logger.info("stop file %s present — continuum loop exiting", stop_file)
-            break
-        time.sleep(interval)
+    clean_exit = False
+    try:
+        while True:
+            # the heartbeat belongs to THIS loop, not step(): a one-shot
+            # `step` CLI call or the workflow's continuous_analysis node
+            # must not register a beat nothing will ever refresh (it
+            # would flip /healthz stale on a perfectly healthy batch
+            # run).  Beat BEFORE the step (so the first long catch-up is
+            # already covered — the fold loop refreshes it per committed
+            # partition) and again after; /healthz goes stale (then
+            # unhealthy) when the loop stops beating — a killed watcher
+            # is visible without anyone instrumenting the death path.
+            telemetry.beat("continuum_watcher", interval_s=interval)
+            out.append(step(cfg))
+            telemetry.beat("continuum_watcher", interval_s=interval)
+            i += 1
+            if max_iterations is not None and i >= max_iterations:
+                clean_exit = True
+                break
+            if stop_file and os.path.exists(stop_file):
+                logger.info("stop file %s present — continuum loop exiting",
+                            stop_file)
+                clean_exit = True
+                break
+            time.sleep(interval)
+    finally:
+        if rotator is not None:
+            rotator.close()
+        if clean_exit:
+            # an INTENTIONALLY-stopped loop must not page anyone: without
+            # this a process that outlives the loop would flip /healthz
+            # stale ⇒ degraded ⇒ unhealthy for a watcher that exited
+            # cleanly.  A loop that DIES (exception) deliberately keeps
+            # its beat so it goes stale and /healthz pages — that is the
+            # whole point of the heartbeat.
+            telemetry.clear_heartbeat("continuum_watcher")
+        telemetry.release(tele)
     return out
 
 

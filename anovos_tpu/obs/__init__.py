@@ -37,7 +37,7 @@ Recording is always on at negligible cost; trace-file export is gated by
 the flight recorder by ``ANOVOS_TPU_FLIGHTREC``.
 """
 
-from anovos_tpu.obs import compile_census, devprof, flight
+from anovos_tpu.obs import compile_census, devprof, flight, telemetry
 from anovos_tpu.obs.manifest import (
     MANIFEST_VERSION,
     build_manifest,
@@ -59,8 +59,11 @@ from anovos_tpu.obs.metrics import (
 from anovos_tpu.obs.timed import timed
 from anovos_tpu.obs.tracing import (
     Span,
+    TraceRotator,
     Tracer,
     get_tracer,
+    maybe_rotator,
+    rotation_spec,
     span,
     trace_destination,
     write_chrome_trace,
@@ -70,6 +73,7 @@ __all__ = [
     "compile_census",
     "devprof",
     "flight",
+    "telemetry",
     "memory_by_device",
     "MANIFEST_VERSION",
     "build_manifest",
@@ -86,8 +90,11 @@ __all__ = [
     "record_device_memory",
     "timed",
     "Span",
+    "TraceRotator",
     "Tracer",
     "get_tracer",
+    "maybe_rotator",
+    "rotation_spec",
     "span",
     "trace_destination",
     "write_chrome_trace",

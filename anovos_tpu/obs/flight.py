@@ -47,8 +47,8 @@ from typing import List, Optional
 
 logger = logging.getLogger("anovos_tpu.obs.flight")
 
-__all__ = ["configure", "enabled", "record", "dump", "dump_paths", "reset",
-           "snapshot_events"]
+__all__ = ["build_snapshot", "configure", "enabled", "record", "dump",
+           "dump_paths", "reset", "snapshot_events"]
 
 FLIGHTREC_VERSION = 1
 _DEFAULT_EVENTS = 256
@@ -150,67 +150,90 @@ def _span_tail() -> List[dict]:
         return []
 
 
+def build_snapshot(trigger: str, node: str = "",
+                   inflight: Optional[List[dict]] = None,
+                   queue_depth: Optional[int] = None,
+                   rendezvous_holders: Optional[List[str]] = None,
+                   extra: Optional[dict] = None,
+                   events: Optional[List[dict]] = None) -> dict:
+    """Assemble the flight-recorder snapshot document — ONE code path
+    shared by the crash-time :func:`dump` and the live ``/statusz``
+    endpoint (``obs.telemetry``), so the view an operator scrapes on
+    demand is byte-for-byte the view a postmortem would have frozen:
+    in-flight nodes with live devprof tallies and last device op, the
+    ready-queue depth, per-device HBM, the event-ring tail, the span
+    tail, and a full metrics snapshot.
+
+    ``inflight`` entries carry each node's executor ``lane`` and leased
+    ``devices`` (multi-device DAG execution), and ``rendezvous_holders``
+    names the node(s) holding the collective rendezvous lane — together
+    they are the evidence a rendezvous-deadlock postmortem needs: WHICH
+    collective was in flight, on which chips.  Works with the recorder
+    disarmed (the event ring is simply empty)."""
+    from anovos_tpu.obs import devprof
+    from anovos_tpu.obs.metrics import get_metrics, memory_by_device
+
+    if events is None:
+        events = snapshot_events()
+    active = devprof.active_frames()
+    inflight_out = []
+    for entry in (inflight or []):
+        name = entry.get("node", "")
+        live = active.get(name)
+        if live:
+            entry = {**entry, "devprof": live}
+        inflight_out.append(entry)
+    backend = None
+    try:
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            backend = jax.default_backend()
+    except Exception:
+        pass
+    doc = {
+        "flightrec_version": FLIGHTREC_VERSION,
+        "trigger": trigger,
+        "node": node,
+        "t_unix": round(time.time(), 3),
+        "pid": os.getpid(),
+        "backend": backend,
+        "inflight": inflight_out,
+        "queue_depth": queue_depth,
+        "rendezvous_holders": list(rendezvous_holders or []),
+        "hbm": {
+            dev: {k: stats.get(k) for k in
+                  ("bytes_in_use", "peak_bytes_in_use") if k in stats}
+            for dev, stats in memory_by_device().items()
+        },
+        "events": events,
+        "spans_tail": _span_tail(),
+        "devprof_finished": devprof.results(),
+        "metrics": get_metrics().snapshot(),
+    }
+    if extra:
+        doc["extra"] = extra
+    return doc
+
+
 def dump(trigger: str, node: str = "", inflight: Optional[List[dict]] = None,
          queue_depth: Optional[int] = None,
          rendezvous_holders: Optional[List[str]] = None,
          extra: Optional[dict] = None) -> Optional[str]:
     """Write the postmortem for ``trigger`` (one of the four classes in
     the module doc).  Returns the path, or None when disarmed/failed —
-    a flight recorder must never take the run down with it.
-
-    ``inflight`` entries carry each node's executor ``lane`` and leased
-    ``devices`` (multi-device DAG execution), and ``rendezvous_holders``
-    names the node(s) holding the collective rendezvous lane — together
-    they are the evidence a rendezvous-deadlock postmortem needs: WHICH
-    collective was in flight, on which chips."""
+    a flight recorder must never take the run down with it."""
     with _LOCK:
         ring, out_dir = _RING, _DIR
         events = list(ring) if ring is not None else []
     if ring is None or out_dir is None:
         return None
     try:
-        from anovos_tpu.obs import devprof
-        from anovos_tpu.obs.metrics import get_metrics, memory_by_device
-
-        active = devprof.active_frames()
-        inflight_out = []
-        for entry in (inflight or []):
-            name = entry.get("node", "")
-            live = active.get(name)
-            if live:
-                entry = {**entry, "devprof": live}
-            inflight_out.append(entry)
-        backend = None
-        try:
-            import sys
-
-            jax = sys.modules.get("jax")
-            if jax is not None:
-                backend = jax.default_backend()
-        except Exception:
-            pass
-        doc = {
-            "flightrec_version": FLIGHTREC_VERSION,
-            "trigger": trigger,
-            "node": node,
-            "t_unix": round(time.time(), 3),
-            "pid": os.getpid(),
-            "backend": backend,
-            "inflight": inflight_out,
-            "queue_depth": queue_depth,
-            "rendezvous_holders": list(rendezvous_holders or []),
-            "hbm": {
-                dev: {k: stats.get(k) for k in
-                      ("bytes_in_use", "peak_bytes_in_use") if k in stats}
-                for dev, stats in memory_by_device().items()
-            },
-            "events": events,
-            "spans_tail": _span_tail(),
-            "devprof_finished": devprof.results(),
-            "metrics": get_metrics().snapshot(),
-        }
-        if extra:
-            doc["extra"] = extra
+        doc = build_snapshot(trigger, node=node, inflight=inflight,
+                             queue_depth=queue_depth,
+                             rendezvous_holders=rendezvous_holders,
+                             extra=extra, events=events)
         os.makedirs(out_dir, exist_ok=True)
         # never overwrite an earlier dump for the same node THIS run: an
         # escalation-time snapshot must survive the later fatal/abandon

@@ -47,8 +47,20 @@ def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus exposition-format label-value escaping (backslash,
+    double-quote, newline) — a label value carrying any of the three must
+    round-trip through a scraper, not corrupt the line protocol."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(h: str) -> str:
+    """HELP text escaping per the exposition format (backslash, newline)."""
+    return h.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _label_str(key: Tuple[Tuple[str, str], ...]) -> str:
-    return ",".join(f'{k}="{v}"' for k, v in key)
+    return ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
 
 
 class _Instrument:
@@ -70,6 +82,15 @@ class _Instrument:
         """``[(labels_dict, value), …]`` snapshot for programmatic readers."""
         with self._lock:
             return [(dict(k), self._export(v)) for k, v in sorted(self._series.items())]
+
+    def remove(self, **labels) -> bool:
+        """Drop one label series (True if it existed).  For LIVE-state
+        gauges whose subject can disappear (a cleared heartbeat, a closed
+        server): without removal the last value scrapes as frozen-fresh
+        forever.  Counters should never use this — their contract is
+        monotonic."""
+        with self._lock:
+            return self._series.pop(_label_key(labels), None) is not None
 
     def _export(self, v):
         return v
@@ -173,6 +194,12 @@ class MetricsRegistry:
                 )
             return inst
 
+    def peek(self, name: str) -> Optional[_Instrument]:
+        """The registered instrument, or None — NEVER creates (cleanup
+        paths must not mint empty families into the snapshot)."""
+        with self._lock:
+            return self._metrics.get(name)
+
     def counter(self, name: str, help: str = "") -> Counter:
         return self._get(name, Counter, help)
 
@@ -198,7 +225,7 @@ class MetricsRegistry:
         lines: List[str] = []
         for name, m in sorted(self.snapshot().items()):
             if m["help"]:
-                lines.append(f"# HELP {name} {m['help']}")
+                lines.append(f"# HELP {name} {_escape_help(m['help'])}")
             lines.append(f"# TYPE {name} {m['type']}")
             for labels, v in m["series"].items():
                 lbl = "{" + labels + "}" if labels else ""

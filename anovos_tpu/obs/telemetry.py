@@ -1,0 +1,686 @@
+"""The live telemetry plane: an embedded, pull-based HTTP endpoint for
+the long-running services.
+
+PRs 11–13 turned a batch pipeline into services (the micro-batching
+feature server, the continuum partition-arrival watcher, streaming-only
+workflow runs) but every observability surface stayed batch-shaped: the
+manifest is written once at exit, p50/p99/QPS exist only as end-of-smoke
+numbers, and the flight recorder speaks only at crash time.  This module
+is the missing pull plane — stdlib-only, off by default, and strictly
+read-only:
+
+* ``/metrics`` — Prometheus text-format exposition of the process-wide
+  :class:`~anovos_tpu.obs.metrics.MetricsRegistry` (deterministic family
+  and label ordering, spec-correct escaping) plus live families rendered
+  at scrape time: serving rolling-window p50/p99/QPS/error-budget burn,
+  continuum heartbeat age / fold backlog / arrival→artifact lag,
+  scheduler in-flight and ready-queue depth, per-device HBM.
+* ``/healthz`` — machine-readable health folding the degradation
+  registry, quarantine counts, backend wedge/failover state and
+  heartbeat staleness into ``ok | degraded | unhealthy`` with reasons
+  (HTTP 200 for ok/degraded, 503 for unhealthy).
+* ``/statusz`` — the flight-recorder snapshot served live on demand:
+  the SAME document :func:`anovos_tpu.obs.flight.build_snapshot` dumps
+  at crash time (in-flight nodes with live devprof tallies, event-ring
+  tail, span tail, metrics), without waiting for a postmortem trigger.
+
+``ANOVOS_TPU_TELEMETRY=<port>`` enables the server (``0``/unset = off:
+zero new threads, byte-identical artifacts).  The listener binds
+127.0.0.1 only — this is an operator/scraper plane, not a public
+surface.  A bind conflict degrades loudly (one warning +
+``telemetry_bind_failures_total``) and never crashes the run.
+
+Components integrate through three small registries, all lock-scoped so
+a scrape can never stall the batcher or the scheduler:
+
+* :func:`register_provider` — named callbacks (``statusz`` → JSON
+  fragment, ``metrics`` → live gauges set at scrape time, ``health`` →
+  ``(status, reasons)`` fragment).  Every callback is invoked OUTSIDE
+  component locks on the scrape thread and reads racily by design (the
+  flight-dump precedent).
+* :func:`beat` — service heartbeats; ``/healthz`` folds staleness.
+* :class:`RollingWindow` — sliding-window latency/error accounting the
+  serving plane books each request into (p50/p99/QPS/error-budget burn
+  over trailing windows, not end-of-run aggregates).
+
+Like the other obs knobs (``ANOVOS_TPU_DEVPROF``,
+``ANOVOS_TPU_FLIGHTREC``), ``ANOVOS_TPU_TELEMETRY`` is deliberately OFF
+``fingerprint.KNOWN_ENV_KNOBS``: pure telemetry, parity-excluded.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("anovos_tpu.obs.telemetry")
+
+__all__ = [
+    "RollingWindow",
+    "TelemetryServer",
+    "acquire",
+    "release",
+    "register_provider",
+    "unregister_provider",
+    "beat",
+    "clear_heartbeat",
+    "refresh_heartbeat",
+    "heartbeat_ages",
+    "health",
+    "render_metrics",
+    "statusz_doc",
+    "telemetry_port",
+    "error_budget",
+]
+
+_DEFAULT_WINDOWS = (60.0, 300.0)
+_WINDOW_RING = 65536           # samples kept per rolling window ring
+_DEFAULT_ERROR_BUDGET = 0.01   # 1% — SLO error budget for burn-rate math
+
+_LOCK = threading.Lock()
+_START_LOCK = threading.Lock()  # serializes listener creation (acquire)
+_PROVIDERS: Dict[str, Dict[str, Callable]] = {}
+_HEARTBEATS: Dict[str, dict] = {}
+_SERVER: "Optional[TelemetryServer]" = None
+_REFS = 0
+
+
+# ---------------------------------------------------------------------------
+# knob parsing
+# ---------------------------------------------------------------------------
+
+def telemetry_port() -> Optional[int]:
+    """``ANOVOS_TPU_TELEMETRY`` resolved to a port, or None when off.
+
+    ``0``/unset/garbage all mean off (a malformed value warns — a typo'd
+    port must not silently disable the plane an operator asked for)."""
+    raw = os.environ.get("ANOVOS_TPU_TELEMETRY", "").strip()
+    if not raw or raw in ("0", "false", "off"):
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        logger.warning("ANOVOS_TPU_TELEMETRY=%r is not a port; telemetry off", raw)
+        return None
+    if not (0 < port < 65536):
+        logger.warning("ANOVOS_TPU_TELEMETRY=%r out of range; telemetry off", raw)
+        return None
+    return port
+
+
+def error_budget() -> float:
+    """``ANOVOS_TPU_SLO_ERROR_BUDGET`` (fraction of requests allowed to
+    fail; default 1%) — the denominator of the burn-rate families."""
+    raw = os.environ.get("ANOVOS_TPU_SLO_ERROR_BUDGET", "")
+    if raw:
+        try:
+            v = float(raw)
+        except ValueError:
+            logger.warning("ANOVOS_TPU_SLO_ERROR_BUDGET=%r invalid; using %s",
+                           raw, _DEFAULT_ERROR_BUDGET)
+        else:
+            if 0 < v <= 1:
+                return v
+            # out-of-range must warn too ("2" meaning 2% would otherwise
+            # silently tighten the burn math to the 1% default)
+            logger.warning(
+                "ANOVOS_TPU_SLO_ERROR_BUDGET=%r out of range (0, 1]; "
+                "using %s", raw, _DEFAULT_ERROR_BUDGET)
+    return _DEFAULT_ERROR_BUDGET
+
+
+# ---------------------------------------------------------------------------
+# provider + heartbeat registries
+# ---------------------------------------------------------------------------
+
+def register_provider(name: str, statusz: Optional[Callable] = None,
+                      metrics: Optional[Callable] = None,
+                      health: Optional[Callable] = None) -> None:
+    """Register a component's live callbacks (latest registration wins).
+
+    ``statusz()`` → JSON-able dict for ``/statusz``; ``metrics(reg)``
+    sets live gauges on the registry at scrape time; ``health()`` →
+    ``(status, [reasons])`` folded into ``/healthz``.  Registering is
+    cheap and safe with telemetry off (one dict insert, no threads)."""
+    entry = {k: v for k, v in
+             (("statusz", statusz), ("metrics", metrics), ("health", health))
+             if v is not None}
+    with _LOCK:
+        _PROVIDERS[name] = entry
+
+
+def unregister_provider(name: str) -> None:
+    with _LOCK:
+        _PROVIDERS.pop(name, None)
+
+
+def _providers() -> Dict[str, Dict[str, Callable]]:
+    with _LOCK:
+        return dict(_PROVIDERS)
+
+
+def beat(name: str, interval_s: float = 30.0,
+         stale_after_s: Optional[float] = None) -> None:
+    """Record a service heartbeat.  ``/healthz`` reports the beat as
+    stale (degraded) past ``stale_after_s`` (default 3× the expected
+    interval) and unhealthy past 3× that again — a killed watcher flips
+    health without anyone instrumenting the death path."""
+    stale = float(stale_after_s if stale_after_s is not None
+                  else max(3.0 * interval_s, 1.0))
+    with _LOCK:
+        _HEARTBEATS[name] = {
+            "t_mono": time.monotonic(),
+            "t_unix": round(time.time(), 3),
+            "interval_s": float(interval_s),
+            "stale_after_s": stale,
+        }
+
+
+def refresh_heartbeat(name: str) -> None:
+    """Re-beat ``name`` ONLY if it is already registered — the mid-work
+    keepalive for long steps (a fold chewing through a 30-partition
+    catch-up refreshes the watcher's beat per partition, so /healthz
+    never pages for a service that is healthy and busy), without letting
+    one-shot callers of the same code path register a beat nothing will
+    ever clear."""
+    with _LOCK:
+        hb = _HEARTBEATS.get(name)
+        if hb is not None:
+            hb["t_mono"] = time.monotonic()
+            hb["t_unix"] = round(time.time(), 3)
+
+
+def clear_heartbeat(name: Optional[str] = None) -> None:
+    """Drop one heartbeat (or all — tests / service shutdown), including
+    its scrape-time gauge series: a heartbeat_age_seconds left behind
+    would scrape as frozen-fresh forever for a service that stopped."""
+    with _LOCK:
+        dropped = list(_HEARTBEATS) if name is None else (
+            [name] if name in _HEARTBEATS else [])
+        if name is None:
+            _HEARTBEATS.clear()
+        else:
+            _HEARTBEATS.pop(name, None)
+    if not dropped:
+        return
+    from anovos_tpu.obs.metrics import get_metrics
+
+    reg = get_metrics()
+    for fam in ("heartbeat_age_seconds", "heartbeat_stale"):
+        inst = reg.peek(fam)  # never CREATE an empty family on cleanup
+        if inst is None:
+            continue
+        for n in dropped:
+            inst.remove(name=n)
+
+
+def heartbeat_ages(now: Optional[float] = None) -> Dict[str, dict]:
+    """``{name: {age_s, interval_s, stale_after_s, stale, last_unix}}``."""
+    now = time.monotonic() if now is None else now
+    with _LOCK:
+        beats = {k: dict(v) for k, v in _HEARTBEATS.items()}
+    out: Dict[str, dict] = {}
+    for name, hb in sorted(beats.items()):
+        age = max(0.0, now - hb["t_mono"])
+        out[name] = {
+            "age_s": round(age, 3),
+            "interval_s": hb["interval_s"],
+            "stale_after_s": hb["stale_after_s"],
+            "stale": age > hb["stale_after_s"],
+            "last_unix": hb["t_unix"],
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rolling SLO windows
+# ---------------------------------------------------------------------------
+
+class RollingWindow:
+    """Sliding-window latency/error accounting for a request plane.
+
+    ``observe()`` appends ``(t, latency_s, ok)`` to a bounded ring; the
+    summary derives, PER trailing window, p50/p99 latency, QPS over the
+    effective window (clipped to the observed history so a 5 s smoke
+    under a 60 s window reports its real rate, not 1/12th of it), the
+    error rate, and the error-budget burn rate (error rate ÷ budget —
+    1.0 means burning exactly at the SLO budget).  Thread-safe; both
+    entry points take one short lock."""
+
+    def __init__(self, windows: Tuple[float, ...] = _DEFAULT_WINDOWS,
+                 maxlen: int = _WINDOW_RING, budget: Optional[float] = None):
+        self.windows = tuple(sorted(float(w) for w in windows))
+        self.budget = budget
+        self._ring: "deque[Tuple[float, float, bool]]" = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self._t_first: Optional[float] = None
+
+    def observe(self, latency_s: float, ok: bool = True,
+                now: Optional[float] = None) -> None:
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            if self._t_first is None:
+                self._t_first = t
+            self._ring.append((t, float(latency_s), bool(ok)))
+
+    @staticmethod
+    def _pct(sorted_vals: List[float], p: float) -> Optional[float]:
+        if not sorted_vals:
+            return None
+        i = min(int(p * (len(sorted_vals) - 1)), len(sorted_vals) - 1)
+        return round(sorted_vals[i] * 1000.0, 3)
+
+    def summary(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """``{"60s": {count, p50_ms, p99_ms, qps, error_rate,
+        error_budget_burn}, ...}`` over each trailing window."""
+        t = time.monotonic() if now is None else now
+        budget = self.budget if self.budget is not None else error_budget()
+        with self._lock:
+            samples = list(self._ring)
+            t_first = self._t_first
+        out: Dict[str, dict] = {}
+        ring_full = len(samples) == self._ring.maxlen
+        for w in self.windows:
+            cut = t - w
+            lat = sorted(s[1] for s in samples if s[0] >= cut)
+            errors = sum(1 for s in samples if s[0] >= cut and not s[2])
+            n = len(lat)
+            # effective window: never longer than the observed history
+            # (a fresh server reports its true rate) and, when the ring
+            # has evicted, never older than the oldest RETAINED sample —
+            # otherwise a server sustaining more than ring/window QPS
+            # would divide a clamped count by the full window and report
+            # a silently deflated rate
+            start = t_first
+            if ring_full and samples:
+                start = samples[0][0]
+            eff = w
+            if start is not None:
+                eff = min(w, max(t - start, 1e-9))
+            err_rate = (errors / n) if n else 0.0
+            out[f"{int(w)}s"] = {
+                "count": n,
+                "errors": errors,
+                "p50_ms": self._pct(lat, 0.50),
+                "p99_ms": self._pct(lat, 0.99),
+                "qps": round(n / eff, 3) if n else 0.0,
+                "error_rate": round(err_rate, 6),
+                "error_budget_burn": round(err_rate / budget, 4),
+            }
+        return out
+
+
+# ---------------------------------------------------------------------------
+# /healthz
+# ---------------------------------------------------------------------------
+
+_STATUS_RANK = {"ok": 0, "degraded": 1, "unhealthy": 2}
+
+
+def health(now: Optional[float] = None) -> dict:
+    """The machine-readable health document: worst-of fold over the
+    degradation registry, quarantine counts, backend wedge/failover
+    state, heartbeat staleness, and every registered provider's health
+    fragment.  Read-only and never raises — a failing probe section
+    becomes a reason, not an exception."""
+    status = "ok"
+    reasons: List[str] = []
+
+    def worsen(new: str, why: str) -> None:
+        nonlocal status
+        if _STATUS_RANK[new] > _STATUS_RANK[status]:
+            status = new
+        reasons.append(why)
+
+    doc: dict = {"t_unix": round(time.time(), 3), "pid": os.getpid()}
+
+    # degraded sections (resilience registry)
+    try:
+        from anovos_tpu.resilience.policy import degraded_sections
+
+        degraded = degraded_sections()
+        doc["degraded_sections"] = degraded
+        for node, why in sorted(degraded.items()):
+            worsen("degraded", f"degraded section {node}: {why}")
+    except Exception as e:
+        worsen("degraded", f"health probe degraded_sections failed: "
+                           f"{type(e).__name__}: {e}")
+
+    # quarantined ingest parts
+    try:
+        from anovos_tpu.data_ingest import guard
+
+        q = guard.summary()
+        doc["quarantine"] = {"parts": q["parts"], "rows_lost": q["rows_lost"]}
+        if q["parts"]:
+            worsen("degraded",
+                   f"{q['parts']} ingest part(s) quarantined "
+                   f"({q['rows_lost']} rows lost)")
+    except Exception as e:
+        worsen("degraded", f"health probe quarantine failed: "
+                           f"{type(e).__name__}: {e}")
+
+    # backend wedge / failover state
+    try:
+        from anovos_tpu.resilience import chaos
+        from anovos_tpu.resilience.failover import failover_count
+
+        wedged = chaos.backend_wedged()
+        flips = failover_count()
+        doc["backend"] = {"wedged": wedged, "failovers": flips}
+        if wedged:
+            worsen("unhealthy", "backend wedged (dispatch unresponsive)")
+        elif flips:
+            worsen("degraded", f"backend failed over to CPU {flips}x this run")
+    except Exception as e:
+        worsen("degraded", f"health probe backend failed: "
+                           f"{type(e).__name__}: {e}")
+
+    # heartbeats (continuum watcher et al.)
+    beats = heartbeat_ages(now=now)
+    doc["heartbeats"] = beats
+    for name, hb in beats.items():
+        if hb["age_s"] > 3.0 * hb["stale_after_s"]:
+            worsen("unhealthy",
+                   f"heartbeat {name} silent {hb['age_s']}s "
+                   f"(stale after {hb['stale_after_s']}s)")
+        elif hb["stale"]:
+            worsen("degraded",
+                   f"heartbeat {name} stale: {hb['age_s']}s since last beat "
+                   f"(expected every {hb['interval_s']}s)")
+
+    # provider fragments (serving: failed batches, …)
+    for name, prov in sorted(_providers().items()):
+        fn = prov.get("health")
+        if fn is None:
+            continue
+        try:
+            st, why = fn()
+            for w in (why or []):
+                worsen(st, w)
+            if not why and st != "ok":
+                worsen(st, f"provider {name} reports {st}")
+        except Exception as e:
+            worsen("degraded", f"health provider {name} failed: "
+                               f"{type(e).__name__}: {e}")
+
+    doc["status"] = status
+    doc["reasons"] = reasons
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# /metrics
+# ---------------------------------------------------------------------------
+
+def _collect_live(reg) -> None:
+    """Set the scrape-time gauge families (device HBM, heartbeat ages,
+    provider gauges).  Each section is independent; a failing collector
+    costs its family, never the scrape."""
+    from anovos_tpu.obs.metrics import record_device_memory
+
+    record_device_memory(reg)  # never raises
+    for name, hb in heartbeat_ages().items():
+        reg.gauge("heartbeat_age_seconds",
+                  "seconds since the named service heartbeat last beat"
+                  ).set(hb["age_s"], name=name)
+        reg.gauge("heartbeat_stale",
+                  "1 when the named heartbeat is past its staleness bound"
+                  ).set(1.0 if hb["stale"] else 0.0, name=name)
+    for name, prov in sorted(_providers().items()):
+        fn = prov.get("metrics")
+        if fn is None:
+            continue
+        try:
+            fn(reg)
+        except Exception:
+            logger.exception("telemetry metrics provider %r failed", name)
+
+
+def render_metrics() -> str:
+    """The ``/metrics`` body: live families collected, then the whole
+    registry rendered in the Prometheus text format (sorted families,
+    sorted series, escaped labels — byte-deterministic for a fixed
+    registry state)."""
+    from anovos_tpu.obs.metrics import get_metrics
+
+    reg = get_metrics()
+    _collect_live(reg)
+    return reg.expose_text()
+
+
+# ---------------------------------------------------------------------------
+# /statusz
+# ---------------------------------------------------------------------------
+
+def statusz_doc() -> dict:
+    """The live flight-recorder snapshot: the scheduler provider's racy
+    in-flight/ready view threaded through the SAME
+    :func:`anovos_tpu.obs.flight.build_snapshot` code path the crash
+    dumps use, plus every other provider's statusz fragment."""
+    from anovos_tpu.obs import flight
+
+    provs = _providers()
+    sched: dict = {}
+    fn = (provs.get("scheduler") or {}).get("statusz")
+    if fn is not None:
+        try:
+            sched = fn() or {}
+        except Exception as e:
+            sched = {"error": f"{type(e).__name__}: {e}"}
+    doc = flight.build_snapshot(
+        "statusz",
+        inflight=sched.get("inflight"),
+        queue_depth=sched.get("queue_depth"),
+        rendezvous_holders=sched.get("rendezvous_holders"),
+    )
+    extras: Dict[str, object] = {}
+    for name, prov in sorted(provs.items()):
+        if name == "scheduler":
+            continue
+        sfn = prov.get("statusz")
+        if sfn is None:
+            continue
+        try:
+            extras[name] = sfn()
+        except Exception as e:
+            extras[name] = {"error": f"{type(e).__name__}: {e}"}
+    doc["providers"] = extras
+    doc["heartbeats"] = heartbeat_ages()
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# the HTTP server
+# ---------------------------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "anovos-telemetry/1"
+    # HTTP/1.1 + Content-Length ⇒ keep-alive: a scraper reuses one
+    # connection (and one handler thread) across scrapes instead of
+    # paying TCP setup + thread spawn per request
+    protocol_version = "HTTP/1.1"
+    # headers and body flush as separate writes; without TCP_NODELAY the
+    # second segment sits behind a delayed ACK (~40ms) on every keep-
+    # alive scrape
+    disable_nagle_algorithm = True
+
+    def log_message(self, fmt, *args):  # route access logs off stderr
+        logger.debug("telemetry: " + fmt, *args)
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        from anovos_tpu.obs.metrics import get_metrics
+
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        # the endpoint LABEL is drawn from the closed route set, never the
+        # raw request path — a scanner probing random URLs must not mint
+        # one metric series per probe (the exact GC016 failure mode)
+        endpoint = path if path in ("/", "/metrics", "/healthz", "/statusz") \
+            else "other"
+        reg = get_metrics()
+        t0 = time.perf_counter()
+        try:
+            if path == "/metrics":
+                body = render_metrics().encode()
+                code, ctype = 200, "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/healthz":
+                doc = health()
+                body = (json.dumps(doc, sort_keys=True) + "\n").encode()
+                code = 503 if doc["status"] == "unhealthy" else 200
+                ctype = "application/json"
+            elif path == "/statusz":
+                body = (json.dumps(statusz_doc(), sort_keys=True, default=str)
+                        + "\n").encode()
+                code, ctype = 200, "application/json"
+            elif path == "/":
+                body = (b"anovos-tpu telemetry\n"
+                        b"/metrics  prometheus exposition\n"
+                        b"/healthz  ok|degraded|unhealthy + reasons\n"
+                        b"/statusz  live flight-recorder snapshot\n")
+                code, ctype = 200, "text/plain; charset=utf-8"
+            else:
+                body = b"not found\n"
+                code, ctype = 404, "text/plain; charset=utf-8"
+        except Exception as e:
+            logger.exception("telemetry handler for %s failed", path)
+            body = (json.dumps({"error": f"{type(e).__name__}: {e}"})
+                    + "\n").encode()
+            code, ctype = 500, "application/json"
+            reg.counter("telemetry_scrape_errors_total",
+                        "telemetry requests that failed server-side"
+                        ).inc(endpoint=endpoint)
+        reg.counter("telemetry_scrapes_total",
+                    "telemetry endpoint requests served"
+                    ).inc(endpoint=endpoint)
+        reg.histogram("telemetry_scrape_seconds",
+                      "telemetry request handling wall"
+                      ).observe(time.perf_counter() - t0, endpoint=endpoint)
+        try:
+            self._send(code, body, ctype)
+        except (BrokenPipeError, ConnectionResetError):
+            logger.debug("telemetry client for %s went away mid-response", path)
+
+
+class TelemetryServer:
+    """One embedded HTTP listener on a daemon thread (127.0.0.1 only).
+
+    ``port=0`` asks the OS for an ephemeral port (tests, the chaos
+    harness); the bound port is on ``.port`` either way."""
+
+    def __init__(self, port: int):
+        self._requested = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    def start(self) -> "TelemetryServer":
+        httpd = ThreadingHTTPServer(("127.0.0.1", self._requested), _Handler)
+        httpd.daemon_threads = True
+        self._httpd = httpd
+        self.port = httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            name="anovos-telemetry", daemon=True)
+        self._thread.start()
+        logger.info("telemetry plane listening on http://127.0.0.1:%d "
+                    "(/metrics /healthz /statusz)", self.port)
+        return self
+
+    def stop(self) -> None:
+        httpd, thread = self._httpd, self._thread
+        self._httpd = self._thread = None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# module singleton (refcounted: any of the three surfaces may hold it)
+# ---------------------------------------------------------------------------
+
+def acquire(context: str = "", port: Optional[int] = None
+            ) -> Optional[TelemetryServer]:
+    """Start (or join) the process-wide telemetry server.
+
+    ``port=None`` reads ``ANOVOS_TPU_TELEMETRY`` — off (the default)
+    returns None with ZERO side effects: no thread, no socket.  An
+    explicit ``port`` overrides (``0`` = OS-assigned ephemeral).  A bind
+    conflict warns once, books ``telemetry_bind_failures_total``, and
+    returns None — telemetry must never take the run down.  Pair every
+    acquire with :func:`release`; the listener stops when the last
+    holder releases.
+
+    Creation is serialized by ``_START_LOCK``: two surfaces acquiring
+    concurrently on a FIXED port must share one listener, not have the
+    loser mis-read the in-process winner's bind as an external conflict."""
+    global _SERVER, _REFS
+    if port is None:
+        port = telemetry_port()
+        if port is None:
+            return None
+    with _START_LOCK:
+        with _LOCK:
+            if _SERVER is not None:
+                _REFS += 1
+                return _SERVER
+        server = TelemetryServer(port)
+        try:
+            server.start()
+        except OSError as e:
+            logger.warning(
+                "telemetry plane could not bind 127.0.0.1:%s (%s) — "
+                "continuing WITHOUT live telemetry (%s)",
+                port, e, context or "unnamed surface")
+            from anovos_tpu.obs.metrics import get_metrics
+
+            get_metrics().counter(
+                "telemetry_bind_failures_total",
+                "telemetry listeners that failed to bind (run continued)",
+            ).inc()
+            return None
+        with _LOCK:
+            _SERVER = server
+            _REFS = 1
+        return server
+
+
+def release(server: Optional[TelemetryServer]) -> None:
+    """Release one :func:`acquire` hold (None-safe).  The listener stops
+    when the final holder releases.  The stop happens under
+    ``_START_LOCK`` so a concurrent :func:`acquire` on the same fixed
+    port waits for the socket to actually close instead of mis-reading
+    the half-closed listener as an external bind conflict."""
+    global _SERVER, _REFS
+    if server is None:
+        return
+    with _START_LOCK:
+        with _LOCK:
+            if server is not _SERVER:
+                return  # already stopped / superseded
+            _REFS -= 1
+            if _REFS > 0:
+                return
+            _SERVER = None
+            _REFS = 0
+        server.stop()
+
+
+def current() -> Optional[TelemetryServer]:
+    """The live server, if any (tests / status lines)."""
+    with _LOCK:
+        return _SERVER

@@ -22,17 +22,23 @@ checked against the scheduler's reported wall time.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
+
+logger = logging.getLogger("anovos_tpu.obs.tracing")
 
 __all__ = [
     "Span",
+    "TraceRotator",
     "Tracer",
     "get_tracer",
+    "maybe_rotator",
+    "rotation_spec",
     "span",
     "trace_destination",
     "write_chrome_trace",
@@ -89,6 +95,7 @@ class Tracer:
                 buffer = _DEFAULT_BUFFER
         self._spans: "deque[Span]" = deque(maxlen=max(buffer, 1))
         self._dropped = 0
+        self._warned_wrap = False
         self._lock = threading.Lock()
         self._local = threading.local()
         # one epoch per tracer: chrome ts fields are offsets from it, so a
@@ -130,15 +137,42 @@ class Tracer:
                           0, th.name, th.ident or 0, attrs))
 
     def _record(self, sp: Span) -> None:
+        dropped = warn = False
         with self._lock:
             if len(self._spans) == self._spans.maxlen:
                 self._dropped += 1
+                dropped = True
+                if not self._warned_wrap:
+                    self._warned_wrap = warn = True
             self._spans.append(sp)
+        if dropped:
+            # ring overflow is no longer silent: a long-running service
+            # that outgrows the buffer books every evicted span (and warns
+            # ONCE) so /metrics shows the loss instead of the trace simply
+            # missing its first hours.  Only the overflow regime pays the
+            # counter; the steady-state record path is unchanged.
+            from anovos_tpu.obs.metrics import get_metrics
+
+            get_metrics().counter(
+                "trace_spans_dropped_total",
+                "spans evicted from the tracer ring at maxlen (raise "
+                "ANOVOS_TPU_TRACE_BUFFER or enable ANOVOS_TPU_TRACE_ROTATE)",
+            ).inc()
+            if warn:
+                logger.warning(
+                    "tracer ring wrapped at maxlen=%d — older spans are being "
+                    "dropped; raise ANOVOS_TPU_TRACE_BUFFER or set "
+                    "ANOVOS_TPU_TRACE_ROTATE to export-and-clear segments",
+                    self._spans.maxlen)
 
     # -- reading / lifecycle --------------------------------------------
     def snapshot(self) -> List[Span]:
         with self._lock:
             return list(self._spans)
+
+    def span_count(self) -> int:
+        with self._lock:
+            return len(self._spans)
 
     @property
     def dropped(self) -> int:
@@ -150,7 +184,41 @@ class Tracer:
         with self._lock:
             self._spans.clear()
             self._dropped = 0
+            self._warned_wrap = False
             self._epoch_ns = time.perf_counter_ns()
+
+    def drain(self) -> List[Span]:
+        """Atomically copy-and-clear the ring WITHOUT re-basing the epoch
+        — rotation's primitive: successive drains partition one
+        uninterrupted timeline, so the union of exported segments equals
+        what a single unbounded export would have held."""
+        with self._lock:
+            out = list(self._spans)
+            self._spans.clear()
+        return out
+
+    def requeue(self, spans: List[Span]) -> None:
+        """Put drained spans back at the FRONT of the ring (a failed
+        segment export must not lose them).  If front + current exceed
+        the bound, the oldest spans fall off — the same eviction the
+        ring would have applied anyway."""
+        with self._lock:
+            merged = list(spans) + list(self._spans)
+            self._spans.clear()
+            overflow = len(merged) - (self._spans.maxlen or len(merged))
+            if overflow > 0:
+                self._dropped += overflow
+            self._spans.extend(merged[-(self._spans.maxlen or len(merged)):])
+        if overflow > 0:
+            # same visibility contract as _record: span loss — here from
+            # persistently-failing segment exports — must show on /metrics
+            from anovos_tpu.obs.metrics import get_metrics
+
+            get_metrics().counter(
+                "trace_spans_dropped_total",
+                "spans evicted from the tracer ring at maxlen (raise "
+                "ANOVOS_TPU_TRACE_BUFFER or enable ANOVOS_TPU_TRACE_ROTATE)",
+            ).inc(overflow)
 
     # -- export ----------------------------------------------------------
     def to_chrome(self, spans: Optional[Iterable[Span]] = None) -> dict:
@@ -241,3 +309,153 @@ def trace_destination(default_dir: str = ".") -> Optional[str]:
 def write_chrome_trace(path: str) -> str:
     """Export the process-wide tracer's buffer to ``path``."""
     return _TRACER.export(path)
+
+
+# ---------------------------------------------------------------------------
+# trace segment rotation (ANOVOS_TPU_TRACE_ROTATE)
+# ---------------------------------------------------------------------------
+
+def rotation_spec() -> Optional[Tuple[str, float]]:
+    """``ANOVOS_TPU_TRACE_ROTATE`` parsed to ``("secs", s)`` /
+    ``("spans", n)``, or None when off.
+
+    A value with an ``s`` suffix rotates on wall time (``"30s"``,
+    ``"1.5s"``); a bare integer rotates when the ring holds that many
+    spans (``"100000"``).  ``0``/unset/garbage → off (garbage warns)."""
+    raw = os.environ.get("ANOVOS_TPU_TRACE_ROTATE", "").strip().lower()
+    if not raw or raw in ("0", "false", "off"):
+        return None
+    try:
+        if raw.endswith("s") and raw[:-1]:
+            secs = float(raw[:-1])
+            return ("secs", secs) if secs > 0 else None
+        n = int(raw)
+        return ("spans", float(n)) if n > 0 else None
+    except ValueError:
+        logger.warning("ANOVOS_TPU_TRACE_ROTATE=%r is neither '<secs>s' nor "
+                       "a span count; rotation off", raw)
+        return None
+
+
+class TraceRotator:
+    """Periodic export-and-clear of the tracer ring into numbered Chrome-
+    trace segments — a week-long service run keeps a COMPLETE,
+    bounded-on-disk trace instead of only the ring's last ~200k spans.
+
+    Segments land next to the configured export path (``trace.json`` →
+    ``trace_0001.json``, ``trace_0002.json``, …); the drain preserves the
+    tracer epoch, so segments share one timeline and their union equals
+    an uninterrupted export.  When ``submit`` is provided (the run's
+    :class:`AsyncArtifactWriter`), segment writes ride the async queue;
+    otherwise they are written on the rotator's own daemon thread —
+    either way the traced threads never block on a segment write."""
+
+    def __init__(self, dest: str, tracer: Optional[Tracer] = None,
+                 spec: Optional[Tuple[str, float]] = None,
+                 submit=None):
+        self.dest = dest
+        self.tracer = tracer or get_tracer()
+        self.spec = spec if spec is not None else rotation_spec()
+        self.submit = submit
+        self.segments: List[str] = []
+        self._n = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    @property
+    def active(self) -> bool:
+        return self.spec is not None
+
+    def segment_path(self, n: int) -> str:
+        base = self.dest[:-5] if self.dest.endswith(".json") else self.dest
+        return f"{base}_{n:04d}.json"
+
+    def start(self) -> "TraceRotator":
+        if not self.active or self._thread is not None:
+            return self
+        kind, val = self.spec
+        poll = min(1.0, val / 4.0) if kind == "secs" else 0.25
+        self._thread = threading.Thread(
+            target=self._loop, args=(max(poll, 0.05),),
+            name="anovos-trace-rotator", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self, poll: float) -> None:
+        while not self._stop.wait(poll):
+            try:
+                self.maybe_rotate()
+            except Exception:
+                logger.exception("trace segment export failed; spans "
+                                 "requeued into the ring, retrying next period")
+
+    def _due(self) -> bool:
+        kind, val = self.spec
+        if kind == "secs":
+            return time.monotonic() - self._last >= val
+        return self.tracer.span_count() >= val
+
+    def maybe_rotate(self, force: bool = False) -> Optional[str]:
+        """Export-and-clear one segment when due (or ``force``); returns
+        the segment path, or None when nothing rotated.  A failed direct
+        export requeues the drained spans and records no segment — spans
+        are never lost and ``segments`` never names a phantom file."""
+        if not self.active:
+            return None
+        with self._lock:
+            if not force and not self._due():
+                return None
+            self._last = time.monotonic()
+            spans = self.tracer.drain()
+            if not spans:
+                return None
+            self._n += 1
+            n = self._n
+            path = self.segment_path(n)
+        if self.submit is not None:
+            # ONE constant writer key for every segment: a per-segment key
+            # would mint a fresh artifact_writes_total series per rotation
+            # — the unbounded-label-cardinality leak GC016 polices — and
+            # the writer's pending list handles repeated keys fine.  A
+            # queued write's failure surfaces at the writer's drain.
+            self.submit("obs:trace_seg", self.tracer.export, path, spans)
+        else:
+            try:
+                self.tracer.export(path, spans)
+            except Exception:
+                with self._lock:
+                    self._n -= 1
+                self.tracer.requeue(spans)
+                raise
+        with self._lock:
+            self.segments.append(path)
+        return path
+
+    def close(self) -> List[str]:
+        """Stop the timer thread and flush the final segment; returns all
+        segment paths written.  Idempotent."""
+        if not self.active:
+            return []
+        self._stop.set()
+        thread = self._thread
+        self._thread = None
+        if thread is not None:
+            thread.join(timeout=10)
+        self.maybe_rotate(force=True)
+        return list(self.segments)
+
+
+def maybe_rotator(default_dir: str, submit=None,
+                  tracer: Optional[Tracer] = None) -> Optional[TraceRotator]:
+    """A started :class:`TraceRotator` when ``ANOVOS_TPU_TRACE_ROTATE``
+    is set, else None (zero threads).  Rotation implies export: with
+    ``ANOVOS_TPU_TRACE`` also set its path anchors the segment names,
+    otherwise segments default under ``<default_dir>/obs/``."""
+    spec = rotation_spec()
+    if spec is None:
+        return None
+    dest = trace_destination(default_dir) or os.path.join(
+        default_dir, "obs", "trace.json")
+    return TraceRotator(dest, tracer=tracer, spec=spec, submit=submit).start()

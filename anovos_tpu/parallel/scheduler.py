@@ -358,12 +358,33 @@ class DagScheduler:
         self._devprof_drain = (
             mode == "sequential"
             or os.environ.get("ANOVOS_TPU_DEVPROF", "") == "full")
-        if mode == "sequential":
-            workers = 1
-            self._run_sequential()
-        else:
-            workers = min(max_workers or default_workers(), max(len(self._nodes), 1))
-            self._run_concurrent(workers, node_timeout)
+        # live telemetry plane (obs.telemetry): /statusz and the executor
+        # depth gauges read this scheduler's racy live view for the run's
+        # duration.  Registration is one dict insert — free with the
+        # telemetry server off, and never touches the scheduler cv on.
+        from anovos_tpu.obs import telemetry
+
+        telemetry.register_provider("scheduler", statusz=self.live_state,
+                                    metrics=self._telemetry_gauges)
+        try:
+            if mode == "sequential":
+                workers = 1
+                self._run_sequential()
+            else:
+                workers = min(max_workers or default_workers(),
+                              max(len(self._nodes), 1))
+                self._run_concurrent(workers, node_timeout)
+        finally:
+            telemetry.unregister_provider("scheduler")
+            # drop the depth gauges with the provider: a finished run's
+            # last scraped values must not expose as live forever
+            from anovos_tpu.obs.metrics import get_metrics
+
+            for fam in ("scheduler_inflight_nodes",
+                        "scheduler_ready_queue_depth"):
+                inst = get_metrics().peek(fam)
+                if inst is not None:
+                    inst.remove()
         return self._summary(time.monotonic() - t0, mode, workers)
 
     # -- lanes (collective-aware multi-device execution) -------------------
@@ -646,6 +667,57 @@ class DagScheduler:
         except Exception:
             return {}
 
+    def live_state(self) -> dict:
+        """The racy live view of the executor — in-flight nodes (state,
+        attempts, elapsed wall, lane, leased devices), ready-queue depth
+        and rendezvous holders.  ONE assembly shared by the crash-time
+        flight dump and the live ``/statusz`` telemetry provider; it
+        reads the running/ready views without the scheduler cv by design
+        (a snapshot races the pool, and must never stall it)."""
+        now = time.monotonic()
+        inflight = []
+        for n in list(self._running.values()):
+            lease = n.lease  # racy read by design
+            inflight.append({
+                "node": n.name,
+                "state": n.state,
+                "attempts": n.attempts,
+                "escalated": n.escalated,
+                "elapsed_s": round(now - n.attempt_start, 3)
+                if n.attempt_start else None,
+                "thread": n.thread,
+                # which lane this node occupies and which chips it
+                # holds — a rendezvous deadlock postmortem must show
+                # WHICH collective was in flight on which devices
+                "lane": (lease.kind if lease is not None
+                         else n.placement.describe()),
+                "devices": (lease.device_labels() if lease is not None
+                            else list(n.devices)),
+                "deps": [d.name for d in n.deps],
+            })
+        try:
+            queue_depth = len(self._ready_view) if self._ready_view is not None else 0
+        except Exception:
+            queue_depth = None
+        lanes = self._lanes
+        return {
+            "inflight": inflight,
+            "queue_depth": queue_depth,
+            "rendezvous_holders": (lanes.collective_holders()
+                                   if lanes is not None else []),
+        }
+
+    def _telemetry_gauges(self, reg) -> None:
+        """Scrape-time executor depth gauges (the ``/metrics`` live
+        families): how stuffed is the pool, how deep is the ready queue."""
+        state = self.live_state()
+        reg.gauge("scheduler_inflight_nodes",
+                  "nodes currently executing in the DAG scheduler"
+                  ).set(float(len(state["inflight"])))
+        reg.gauge("scheduler_ready_queue_depth",
+                  "nodes ready to run but not yet claimed by a worker"
+                  ).set(float(state["queue_depth"] or 0))
+
     def _flight_dump(self, trigger: str, node: Optional[Node] = None,
                      extra: Optional[dict] = None) -> None:
         """Postmortem hook (obs.flight): no-op unless workflow.main armed
@@ -656,36 +728,11 @@ class DagScheduler:
 
             if not flight.enabled():
                 return
-            now = time.monotonic()
-            inflight = []
-            for n in list(self._running.values()):
-                lease = n.lease  # racy read by design
-                inflight.append({
-                    "node": n.name,
-                    "state": n.state,
-                    "attempts": n.attempts,
-                    "escalated": n.escalated,
-                    "elapsed_s": round(now - n.attempt_start, 3)
-                    if n.attempt_start else None,
-                    "thread": n.thread,
-                    # which lane this node occupies and which chips it
-                    # holds — a rendezvous deadlock postmortem must show
-                    # WHICH collective was in flight on which devices
-                    "lane": (lease.kind if lease is not None
-                             else n.placement.describe()),
-                    "devices": (lease.device_labels() if lease is not None
-                                else list(n.devices)),
-                    "deps": [d.name for d in n.deps],
-                })
-            try:
-                queue_depth = len(self._ready_view) if self._ready_view is not None else 0
-            except Exception:
-                queue_depth = None
-            lanes = self._lanes
+            state = self.live_state()
             flight.dump(trigger, node=node.name if node is not None else "",
-                        inflight=inflight, queue_depth=queue_depth,
-                        rendezvous_holders=(lanes.collective_holders()
-                                            if lanes is not None else []),
+                        inflight=state["inflight"],
+                        queue_depth=state["queue_depth"],
+                        rendezvous_holders=state["rendezvous_holders"],
                         extra=extra)
         except Exception:
             logger.exception("flight-recorder dump (%s) failed", trigger)

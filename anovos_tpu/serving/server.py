@@ -47,6 +47,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 import pandas as pd
 
+from anovos_tpu.obs.telemetry import RollingWindow
 from anovos_tpu.serving.program import ApplyProgram
 
 logger = logging.getLogger("anovos_tpu.serving.server")
@@ -170,7 +171,7 @@ def frame_to_payload(df: pd.DataFrame) -> Dict[str, list]:
 
 
 class _Pending:
-    __slots__ = ("frame", "rows", "event", "response", "t0")
+    __slots__ = ("frame", "rows", "event", "response", "t0", "booked")
 
     def __init__(self, frame: pd.DataFrame, t0: float):
         self.frame = frame
@@ -178,6 +179,10 @@ class _Pending:
         self.event = threading.Event()
         self.response: Optional[dict] = None
         self.t0 = t0
+        # one-request-one-SLO-sample: whichever side (client timeout or
+        # batcher completion) claims this flag FIRST — under the server
+        # lock — books the request; the other side must not
+        self.booked = False
 
 
 class FeatureServer:
@@ -212,6 +217,15 @@ class FeatureServer:
         self._failed = 0
         self._t_started: Optional[float] = None
         self.cold_start_s: Optional[float] = None
+        # live telemetry plane: rolling SLO windows over the request
+        # stream (p50/p99/QPS/error-budget burn at scrape time, not
+        # end-of-run aggregates), the in-flight batch view /statusz
+        # reads, and the last fatal batch /healthz names
+        self.rolling = RollingWindow()
+        self._inflight_batch: Optional[dict] = None
+        self._last_fatal: Optional[dict] = None
+        self._telemetry = None
+        self._rotator = None
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, warm: bool = True) -> "FeatureServer":
@@ -226,6 +240,22 @@ class FeatureServer:
 
             if not flight.enabled():
                 flight.configure(os.path.join(self.obs_dir, "obs"))
+        # live telemetry plane: join/start the embedded HTTP listener
+        # (ANOVOS_TPU_TELEMETRY; off = None, zero threads) and register
+        # the serving provider either way — a workflow-owned listener can
+        # then scrape this server too
+        from anovos_tpu.obs import telemetry
+        from anovos_tpu.obs.tracing import maybe_rotator
+
+        self._telemetry = telemetry.acquire(context="serving")
+        telemetry.register_provider(
+            "serving", statusz=self._statusz_fragment,
+            metrics=self._telemetry_gauges, health=self._health_fragment)
+        # trace segment rotation (off by default): a long-lived server's
+        # apply spans rotate to disk instead of silently aging out of the
+        # tracer ring
+        if self.obs_dir:
+            self._rotator = maybe_rotator(self.obs_dir)
         if warm:
             self.program.warm(self.max_batch)
         self._stop.clear()
@@ -248,6 +278,14 @@ class FeatureServer:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        from anovos_tpu.obs import telemetry
+
+        telemetry.unregister_provider("serving")
+        telemetry.release(self._telemetry)
+        self._telemetry = None
+        if self._rotator is not None:
+            self._rotator.close()
+            self._rotator = None
 
     # -- client API ---------------------------------------------------------
     def serve(self, payload: dict, timeout_s: float = 120.0) -> dict:
@@ -269,6 +307,35 @@ class FeatureServer:
         pending = _Pending(frame, t0)
         self._queue.put(pending)
         if not pending.event.wait(timeout_s):
+            # a timeout is a client-visible FAILURE: it must burn error
+            # budget in the rolling windows, or a wedged apply that times
+            # every request out would scrape as a perfectly healthy
+            # server.  The booking claim is decided UNDER the lock so the
+            # batcher completing at the same instant cannot also book
+            # this request — one request, one SLO sample.
+            with self._lock:
+                claimed = not pending.booked
+                pending.booked = True
+            if claimed:
+                elapsed = time.perf_counter() - t0
+                # timeouts COUNT toward the latency tail: a wedged apply
+                # that strands every client at timeout_s IS the p99, and
+                # the serve-fault bounded-p99 gate must see it
+                with self._lock:
+                    self._latencies.append(elapsed)
+                self.rolling.observe(elapsed, ok=False)
+                get_metrics().histogram(
+                    "serve_request_seconds",
+                    "request wall from validation to response"
+                ).observe(elapsed)
+                get_metrics().counter(
+                    "serve_requests_timeout_total",
+                    "requests that timed out awaiting their batch").inc()
+                return _error("timeout", f"no response within {timeout_s}s")
+            # the batch finished in the same instant: its response is valid
+            pending.event.wait(5.0)
+            if pending.response is not None:
+                return pending.response
             return _error("timeout", f"no response within {timeout_s}s")
         return pending.response  # type: ignore[return-value]
 
@@ -332,23 +399,31 @@ class FeatureServer:
         big = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
         bucket = self.program.bucket_rows(n, self.max_batch)
         padded = self.program.pad_frame(big, bucket)
+        with self._lock:
+            self._inflight_batch = {"rows": n, "requests": len(batch),
+                                    "bucket": bucket,
+                                    "since_unix": round(time.time(), 3)}
         out: Optional[pd.DataFrame] = None
         last: Optional[BaseException] = None
-        for attempt in (1, 2):
-            try:
-                with get_tracer().span("serving/apply", cat="serve",
-                                       rows=n, bucket=bucket,
-                                       requests=len(batch), attempt=attempt), \
-                        devprof.node_bracket("serving/apply"):
-                    chaos_point("serve:apply")
-                    out = self.program.apply_frame(padded)
-                break
-            except Exception as e:
-                last = e
-                logger.warning(
-                    "serving apply attempt %d failed (%s: %s) — %s",
-                    attempt, type(e).__name__, e,
-                    "retrying" if attempt == 1 else "batch is fatal")
+        try:
+            for attempt in (1, 2):
+                try:
+                    with get_tracer().span("serving/apply", cat="serve",
+                                           rows=n, bucket=bucket,
+                                           requests=len(batch), attempt=attempt), \
+                            devprof.node_bracket("serving/apply"):
+                        chaos_point("serve:apply")
+                        out = self.program.apply_frame(padded)
+                    break
+                except Exception as e:
+                    last = e
+                    logger.warning(
+                        "serving apply attempt %d failed (%s: %s) — %s",
+                        attempt, type(e).__name__, e,
+                        "retrying" if attempt == 1 else "batch is fatal")
+        finally:
+            with self._lock:
+                self._inflight_batch = None
         reg.counter("serve_batches_total",
                     "micro-batches dispatched through the apply program"
                     ).inc()
@@ -361,6 +436,14 @@ class FeatureServer:
             # safe), then structured errors — the server keeps serving
             with self._lock:
                 self._failed += 1
+                # /healthz names the failed batch until the server dies:
+                # a fatal apply is a degraded serving plane even after
+                # the loop moves on
+                self._last_fatal = {
+                    "rows": n, "requests": len(batch),
+                    "error": f"{type(last).__name__}: {str(last)[:300]}",
+                    "t_unix": round(time.time(), 3),
+                }
             reg.counter(
                 "serve_batches_failed_total",
                 "micro-batches whose apply failed after retry (every request "
@@ -378,12 +461,19 @@ class FeatureServer:
                     f"{type(last).__name__}: {str(last)[:300]}")
                 # failed requests COUNT toward the latency tail: a wedged
                 # apply that burns 60s before erroring is p99, and the
-                # serve-fault chaos gate's bounded-p99 check reads it here
+                # serve-fault chaos gate's bounded-p99 check reads it
+                # here.  A request whose client already timed out (and
+                # claimed the booking) is not sampled twice.
                 with self._lock:
-                    self._latencies.append(now - p.t0)
-                reg.histogram("serve_request_seconds",
-                              "request wall from validation to response"
-                              ).observe(now - p.t0)
+                    claimed = not p.booked
+                    p.booked = True
+                    if claimed:
+                        self._latencies.append(now - p.t0)
+                if claimed:
+                    self.rolling.observe(now - p.t0, ok=False)
+                    reg.histogram("serve_request_seconds",
+                                  "request wall from validation to response"
+                                  ).observe(now - p.t0)
                 p.event.set()
             return
         offset = 0
@@ -394,12 +484,75 @@ class FeatureServer:
             p.response = {"rows": p.rows, "columns": frame_to_payload(part)}
             latency = now - p.t0
             with self._lock:
-                self._served += 1
-                self._latencies.append(latency)
-            reg.histogram("serve_request_seconds",
-                          "request wall from validation to response"
-                          ).observe(latency)
+                claimed = not p.booked
+                p.booked = True
+                if claimed:
+                    self._served += 1
+                    self._latencies.append(latency)
+            if claimed:
+                self.rolling.observe(latency, ok=True)
+                reg.histogram("serve_request_seconds",
+                              "request wall from validation to response"
+                              ).observe(latency)
             p.event.set()
+
+    # -- telemetry provider callbacks (obs.telemetry; scrape thread) --------
+    def _statusz_fragment(self) -> dict:
+        """The serving section of ``/statusz``: end-of-run stats plus the
+        live rolling windows, the in-flight batch and the last fatal."""
+        with self._lock:
+            inflight = dict(self._inflight_batch) if self._inflight_batch else None
+            last_fatal = dict(self._last_fatal) if self._last_fatal else None
+        return {
+            **self.stats(),
+            "rolling": self.rolling.summary(),
+            "inflight_batch": inflight,
+            "last_fatal": last_fatal,
+            "queue_depth": self._queue.qsize(),
+        }
+
+    def _telemetry_gauges(self, reg) -> None:
+        """The ``/metrics`` live serving families: rolling-window
+        p50/p99/QPS/error-budget burn (sliding over the latency ring, not
+        end-of-run aggregates) + queue depth, set at scrape time."""
+        for window, s in self.rolling.summary().items():
+            # an EMPTY window removes its latency series rather than
+            # leaving the last burst's p99 scraping as frozen-fresh for
+            # hours (qps/burn honestly read 0 and stay)
+            if s["p50_ms"] is not None:
+                reg.gauge("serve_rolling_p50_ms",
+                          "rolling-window p50 request latency"
+                          ).set(s["p50_ms"], window=window)
+                reg.gauge("serve_rolling_p99_ms",
+                          "rolling-window p99 request latency"
+                          ).set(s["p99_ms"], window=window)
+            else:
+                for fam in ("serve_rolling_p50_ms", "serve_rolling_p99_ms"):
+                    inst = reg.peek(fam)  # never MINT a family on cleanup
+                    if inst is not None:
+                        inst.remove(window=window)
+            reg.gauge("serve_rolling_qps",
+                      "rolling-window sustained requests per second"
+                      ).set(s["qps"], window=window)
+            reg.gauge("serve_rolling_error_budget_burn",
+                      "rolling-window error rate over the SLO error budget "
+                      "(1.0 = burning exactly at budget)"
+                      ).set(s["error_budget_burn"], window=window)
+        reg.gauge("serve_queue_depth",
+                  "requests accepted but not yet batched"
+                  ).set(float(self._queue.qsize()))
+
+    def _health_fragment(self):
+        """``/healthz`` fold: a fatal micro-batch degrades the serving
+        plane, with the batch named in the reason."""
+        with self._lock:
+            lf = dict(self._last_fatal) if self._last_fatal else None
+        if lf is None:
+            return ("ok", [])
+        return ("degraded", [
+            f"serving: micro-batch of {lf['rows']} row(s) "
+            f"({lf['requests']} request(s)) failed after retry: {lf['error']}"
+        ])
 
     # -- stats --------------------------------------------------------------
     def stats(self) -> dict:

@@ -78,8 +78,10 @@ from anovos_tpu.obs import (
     flight,
     get_metrics,
     get_tracer,
+    maybe_rotator,
     record_cache_stats,
     record_device_memory,
+    telemetry,
     trace_destination,
     write_chrome_trace,
     write_manifest,
@@ -1286,6 +1288,16 @@ def main(
             # quarantines are in the manifest + registry regardless)
             ingest_guard.set_journal(journal)
 
+        # live telemetry plane + trace segment rotation, both off by
+        # default (ANOVOS_TPU_TELEMETRY / ANOVOS_TPU_TRACE_ROTATE unset
+        # ⇒ zero new threads, byte-identical artifacts).  Rotation rides
+        # the async artifact writer so a segment export never blocks the
+        # traced threads; its destination anchors on the trace path.
+        # Acquired IMMEDIATELY before the try whose finally releases them
+        # — an exception in between would leak the listener refcount and
+        # drop the final segment flush.
+        telemetry_handle = telemetry.acquire(context="workflow")
+        trace_rotator = maybe_rotator(obs_dir, submit=writer.submit)
         run_err = None
         try:
             summary = sched.run(mode=mode)
@@ -1332,6 +1344,13 @@ def main(
             run_err = e
             raise
         finally:
+            if trace_rotator is not None:
+                # final segment flush goes through the writer: rotate
+                # BEFORE close() so the submit still has a live queue
+                try:
+                    trace_rotator.close()
+                except Exception:
+                    logger.exception("trace rotator close failed")
             try:
                 writer.close()  # drain: surface any queued-write failure
             except Exception as close_err:
@@ -1366,9 +1385,11 @@ def main(
                                 stats["before_bytes"], stats["after_bytes"])
                     except Exception:
                         logger.exception("cache gc failed; store left as-is")
-            if trace_dest:
+            if trace_dest and trace_rotator is None:
                 # export even on failure: the trace of an aborted run is
-                # exactly what the post-mortem needs
+                # exactly what the post-mortem needs.  With rotation
+                # active the rotator's final flush above already drained
+                # the ring into its last numbered segment.
                 try:
                     out_path = write_chrome_trace(os.path.abspath(trace_dest))
                     logger.info(
@@ -1376,6 +1397,10 @@ def main(
                         "(ui.perfetto.dev) or chrome://tracing", out_path)
                 except Exception:
                     logger.exception("chrome trace export to %s failed", trace_dest)
+            elif trace_rotator is not None and trace_rotator.segments:
+                logger.info("chrome trace rotated into %d segment(s) next to %s",
+                            len(trace_rotator.segments), trace_rotator.dest)
+            telemetry.release(telemetry_handle)
         LAST_MANIFEST_PATH = manifest_path
         try:  # remote run_types publish the manifest next to the staged stats
             obs_store.push(manifest_path, os.path.join(obs_base, "obs"))

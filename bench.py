@@ -500,6 +500,55 @@ def e2e_serving() -> dict:
             f"serving smoke gate failed: parity={rec.get('serve_parity_ok')} "
             f"errors={rec.get('serve_errors')}")
         print("bench: " + out["e2e_serve_error"], file=sys.stderr)
+    out.update(e2e_telemetry())
+    return out
+
+
+def e2e_telemetry() -> dict:
+    """Telemetry-plane overhead (round 14): the serving smoke's
+    ``--telemetry`` mode runs the warm concurrent-client load twice in
+    ONE process — leg A with the plane off, leg B with the embedded HTTP
+    server live and two scrapers hammering ``/metrics``/``/healthz``
+    throughout — and reports the A/B wall delta as
+    ``e2e_telemetry_overhead_pct`` plus the scrape latency tail as
+    ``e2e_scrape_p99_ms``.  The acceptance bar is overhead < 1%; ≥ 1%
+    warns, ≥ 3% (far outside shared-box noise) lands as
+    ``e2e_telemetry_error``."""
+    env = {**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS") or "cpu"}
+    for k in ("ANOVOS_TPU_CHAOS", "ANOVOS_TPU_CACHE", "XLA_FLAGS",
+              "ANOVOS_TPU_TELEMETRY"):
+        env.pop(k, None)
+    p = subprocess.run(
+        [sys.executable, "-m", "anovos_tpu.serving", "smoke", "--telemetry",
+         "--rows", "2000", "--clients", "4", "--requests", "25", "--json"],
+        capture_output=True, text=True, env=env, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    out: dict = {}
+    rec = _last_json_line(p.stdout)
+    if rec is None:
+        out["e2e_telemetry_error"] = (
+            f"telemetry smoke produced no result (rc={p.returncode}): "
+            + (p.stderr or p.stdout)[-160:])
+        return out
+    out["e2e_telemetry_overhead_pct"] = rec.get("telemetry_overhead_pct")
+    out["e2e_scrape_p99_ms"] = rec.get("scrape_p99_ms")
+    out["e2e_scrape_count"] = rec.get("scrape_count")
+    out["e2e_scrape_failures"] = rec.get("scrape_failures")
+    out["e2e_healthz_status"] = rec.get("healthz_status")
+    overhead = rec.get("telemetry_overhead_pct")
+    if rec.get("scrape_failures") or rec.get("healthz_status") != "ok":
+        out["e2e_telemetry_error"] = (
+            f"telemetry leg unhealthy: scrape_failures="
+            f"{rec.get('scrape_failures')} healthz={rec.get('healthz_status')}")
+        print("bench: " + out["e2e_telemetry_error"], file=sys.stderr)
+    elif isinstance(overhead, (int, float)) and overhead >= 3.0:
+        out["e2e_telemetry_error"] = (
+            f"telemetry overhead {overhead}% is far outside the <1% budget")
+        print("bench: " + out["e2e_telemetry_error"], file=sys.stderr)
+    elif isinstance(overhead, (int, float)) and overhead >= 1.0:
+        print(f"bench: telemetry overhead {overhead}% exceeds the 1% budget "
+              "(shared-box noise band; watch the ledger trend)", file=sys.stderr)
     return out
 
 

@@ -269,6 +269,13 @@ def bench_e2e() -> dict:
         "continuum_alerts": r.get("e2e_continuum_alerts"),
         "continuum_parity": r.get("e2e_continuum_parity"),
         "continuum_error": r.get("e2e_continuum_error"),
+        # live telemetry plane (bench.e2e_telemetry, round 14): A/B warm
+        # wall overhead of the embedded HTTP plane under scrape load,
+        # and the scrape latency tail
+        "telemetry_overhead_pct": r.get("e2e_telemetry_overhead_pct"),
+        "scrape_p99_ms": r.get("e2e_scrape_p99_ms"),
+        "scrape_failures": r.get("e2e_scrape_failures"),
+        "telemetry_error": r.get("e2e_telemetry_error"),
     }
 
 

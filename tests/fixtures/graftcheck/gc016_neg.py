@@ -1,0 +1,24 @@
+"""GC016 negative fixture: labels from small closed sets (enum-ish
+kinds, bounded DAG node names, device labels, window names) and
+label-free observations — none of these grow series unboundedly."""
+
+from anovos_tpu.obs import get_metrics
+
+
+def record_outcome(kind, node_name, device_label):
+    reg = get_metrics()
+    # literal label values: a closed set of one each
+    reg.counter("batches_total", "batches").inc(outcome="ok")
+    reg.gauge("rolling_qps", "rolling qps").set(12.5, window="60s")
+    # enum-ish variables: kinds, bounded node names, device labels
+    reg.counter("faults_total", "fault injections").inc(kind=kind)
+    reg.histogram("node_wall_seconds", "node wall").observe(0.25, node=node_name)
+    reg.gauge("bytes_in_use", "device memory").set(1024.0, device=device_label)
+
+
+def record_plain(reg_rows):
+    # label-free observations are always fine
+    get_metrics().counter("rows_total", "rows").inc(reg_rows)
+    # histogram bucket config is not a label
+    get_metrics().histogram("batch_rows", "rows/batch",
+                            buckets=(1, 8, 64)).observe(reg_rows)

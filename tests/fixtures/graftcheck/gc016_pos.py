@@ -1,0 +1,27 @@
+"""GC016 positive fixture: metric labels carrying per-request /
+per-path / per-entity values — one series per observation, forever."""
+
+import os
+
+from anovos_tpu.obs import get_metrics
+
+
+def serve_one(request_id, payload_path):
+    reg = get_metrics()
+    # per-request id as a label: a new series every single request
+    reg.counter("requests_total", "served requests").inc(request=request_id)
+    # path-derived label value under an innocuous label name
+    reg.counter("reads_total", "part reads").inc(
+        source=os.path.basename(payload_path))
+
+
+def account_rows(frame):
+    counter = get_metrics().counter("rows_seen_total", "rows accounted")
+    for col in frame.columns:
+        # per-column label over an unbounded vocabulary
+        counter.inc(len(frame), column=str(col))
+
+
+def dynamic_labels(labels):
+    # **kwargs label splat: cardinality is unverifiable statically
+    get_metrics().gauge("depth", "queue depth").set(1.0, **labels)

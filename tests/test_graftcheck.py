@@ -27,7 +27,7 @@ FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftcheck")
 PKG = os.path.join(REPO, "anovos_tpu")
 RULE_IDS = ["GC001", "GC002", "GC003", "GC004", "GC005", "GC006", "GC007",
             "GC008", "GC009", "GC010", "GC011", "GC012", "GC013", "GC014",
-            "GC015"]
+            "GC015", "GC016"]
 
 
 # -- the gate: repo scan is clean against the committed baseline ----------
@@ -120,7 +120,7 @@ def test_expected_positive_counts():
     expected = {"GC001": 5, "GC002": 4, "GC003": 6, "GC004": 3,
                 "GC005": 4, "GC006": 4, "GC007": 2, "GC008": 4, "GC009": 4,
                 "GC010": 4, "GC011": 5, "GC012": 4, "GC013": 4, "GC014": 4,
-                "GC015": 2}
+                "GC015": 2, "GC016": 4}
     for rule_id, n in expected.items():
         path = os.path.join(FIXTURES, f"{rule_id.lower()}_pos.py")
         hits = [f for f in scan([path]) if f.rule == rule_id]

@@ -1,0 +1,605 @@
+"""The live telemetry plane (round 14): ``/metrics`` exposition
+determinism + escaping, endpoint lifecycle (off ⇒ zero threads,
+bind-conflict ⇒ loud degrade), rolling-window SLO math against a
+synthetic latency stream, trace segment rotation completeness (union of
+segments == uninterrupted export), tracer-ring overflow accounting, and
+the ``/healthz`` fold — degradation registry, serving fatal batches, and
+the continuum watcher heartbeat going stale when the loop stops beating.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+import sys  # noqa: E402
+
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from anovos_tpu.obs import telemetry  # noqa: E402
+from anovos_tpu.obs.metrics import MetricsRegistry, get_metrics  # noqa: E402
+from anovos_tpu.obs.tracing import (  # noqa: E402
+    Tracer,
+    TraceRotator,
+    rotation_spec,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry_state(monkeypatch):
+    """Every test starts with no heartbeats, no providers, no degraded
+    sections, and the telemetry env knob unset."""
+    from anovos_tpu.resilience.policy import reset_degraded
+
+    monkeypatch.delenv("ANOVOS_TPU_TELEMETRY", raising=False)
+    monkeypatch.delenv("ANOVOS_TPU_TRACE_ROTATE", raising=False)
+    telemetry.clear_heartbeat()
+    reset_degraded()
+    yield
+    for name in list(telemetry._providers()):
+        telemetry.unregister_provider(name)
+    telemetry.clear_heartbeat()
+    reset_degraded()
+    srv = telemetry.current()
+    if srv is not None:  # a failed test must not leak the listener
+        telemetry.release(srv)
+
+
+def _get(port, path, timeout=10):
+    """(status, body) — 4xx/5xx are still served responses."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# exposition format
+# ---------------------------------------------------------------------------
+
+def test_exposition_double_render_byte_identical():
+    reg = MetricsRegistry()
+    reg.counter("b_total", "counter").inc(2, kind="x")
+    reg.counter("a_total", "other").inc(1)
+    reg.gauge("g", "gauge").set(1.5, device="cpu:0")
+    reg.histogram("h_seconds", "hist").observe(0.02, node="n1")
+    assert reg.expose_text() == reg.expose_text()
+    # families render sorted regardless of registration order
+    lines = [ln for ln in reg.expose_text().splitlines()
+             if ln.startswith("# TYPE")]
+    names = [ln.split()[2] for ln in lines]
+    assert names == sorted(names)
+
+
+def test_exposition_label_escaping_newline_quote_backslash():
+    reg = MetricsRegistry()
+    reg.counter("c_total", "help with\nnewline and \\ slash").inc(
+        1, lbl='va"l\nue\\x')
+    text = reg.expose_text()
+    # the exposition stays line-oriented: no raw newline leaks out of a
+    # label value or help string
+    for line in text.splitlines():
+        assert "\n" not in line
+    assert 'lbl="va\\"l\\nue\\\\x"' in text
+    assert "# HELP c_total help with\\nnewline and \\\\ slash" in text
+
+
+def test_counter_monotonic_across_scrapes():
+    srv = telemetry.acquire("test", port=0)
+    try:
+        get_metrics().counter("tel_test_total", "t").inc(3)
+
+        def value(body):
+            for line in body.splitlines():
+                if line.startswith("tel_test_total"):
+                    return float(line.rsplit(" ", 1)[1])
+            return None
+
+        _, b1 = _get(srv.port, "/metrics")
+        get_metrics().counter("tel_test_total", "t").inc(2)
+        _, b2 = _get(srv.port, "/metrics")
+        assert value(b1) == 3.0 and value(b2) == 5.0
+        # the scrape counter itself is monotonic scrape-over-scrape
+        def scrapes(body):
+            for line in body.splitlines():
+                if line.startswith('telemetry_scrapes_total{endpoint="/metrics"}'):
+                    return float(line.rsplit(" ", 1)[1])
+            return 0.0
+        assert scrapes(b2) > scrapes(b1)
+    finally:
+        telemetry.release(srv)
+
+
+# ---------------------------------------------------------------------------
+# endpoint lifecycle
+# ---------------------------------------------------------------------------
+
+def test_telemetry_off_means_no_thread():
+    before = {t.name for t in threading.enumerate()}
+    assert telemetry.telemetry_port() is None
+    assert telemetry.acquire("test") is None
+    after = {t.name for t in threading.enumerate()}
+    assert "anovos-telemetry" not in after
+    assert after == before
+
+
+def test_env_port_zero_is_off(monkeypatch):
+    monkeypatch.setenv("ANOVOS_TPU_TELEMETRY", "0")
+    assert telemetry.telemetry_port() is None
+    monkeypatch.setenv("ANOVOS_TPU_TELEMETRY", "not-a-port")
+    assert telemetry.telemetry_port() is None
+    monkeypatch.setenv("ANOVOS_TPU_TELEMETRY", "9138")
+    assert telemetry.telemetry_port() == 9138
+
+
+def test_bind_conflict_degrades_loudly_never_crashes(caplog):
+    blocker = socket.socket()
+    blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    before = get_metrics().counter("telemetry_bind_failures_total").value()
+    try:
+        import logging
+
+        with caplog.at_level(logging.WARNING, "anovos_tpu.obs.telemetry"):
+            assert telemetry.acquire("test", port=port) is None
+        assert any("could not bind" in r.message for r in caplog.records)
+        assert get_metrics().counter(
+            "telemetry_bind_failures_total").value() == before + 1
+    finally:
+        blocker.close()
+
+
+def test_acquire_release_refcount():
+    a = telemetry.acquire("one", port=0)
+    b = telemetry.acquire("two", port=0)
+    assert a is b
+    telemetry.release(a)
+    code, _ = _get(a.port, "/healthz")  # still up: one holder left
+    assert code == 200
+    telemetry.release(b)
+    with pytest.raises(Exception):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{a.port}/healthz", timeout=2)
+
+
+def test_unknown_path_404_and_statusz_live():
+    srv = telemetry.acquire("test", port=0)
+    try:
+        code, _ = _get(srv.port, "/nope")
+        assert code == 404
+        telemetry.register_provider(
+            "scheduler",
+            statusz=lambda: {"inflight": [{"node": "x", "state": "running"}],
+                             "queue_depth": 3, "rendezvous_holders": []})
+        telemetry.register_provider("widget", statusz=lambda: {"n": 7})
+        code, body = _get(srv.port, "/statusz")
+        doc = json.loads(body)
+        assert code == 200
+        assert doc["trigger"] == "statusz"
+        assert doc["queue_depth"] == 3
+        assert doc["inflight"][0]["node"] == "x"
+        assert doc["providers"]["widget"] == {"n": 7}
+        assert "metrics" in doc and "spans_tail" in doc
+    finally:
+        telemetry.release(srv)
+
+
+# ---------------------------------------------------------------------------
+# rolling SLO windows
+# ---------------------------------------------------------------------------
+
+def test_rolling_window_math_synthetic_stream():
+    w = telemetry.RollingWindow(windows=(60.0,), budget=0.01)
+    t0 = 1000.0
+    # 200 requests over 2s: latencies 1..200 ms, every 20th an error
+    for i in range(200):
+        w.observe((i + 1) / 1000.0, ok=(i % 20 != 0), now=t0 + i * 0.01)
+    s = w.summary(now=t0 + 2.0)["60s"]
+    assert s["count"] == 200 and s["errors"] == 10
+    assert s["p50_ms"] == pytest.approx(100.0, abs=2.0)
+    assert s["p99_ms"] == pytest.approx(198.0, abs=3.0)
+    assert s["qps"] == pytest.approx(100.0, rel=0.01)  # 200 over 2s history
+    assert s["error_rate"] == pytest.approx(0.05)
+    assert s["error_budget_burn"] == pytest.approx(5.0)
+
+
+def test_rolling_window_full_ring_does_not_deflate_qps():
+    """When the sample ring has evicted, the rate divides by the span of
+    the RETAINED samples, not the full window — a server sustaining more
+    than ring/window QPS must not report a silently clamped rate."""
+    w = telemetry.RollingWindow(windows=(60.0,), maxlen=100, budget=0.01)
+    # 1000 QPS for 1s: 1000 observations, ring keeps the newest 100
+    for i in range(1000):
+        w.observe(0.001, ok=True, now=2000.0 + i * 0.001)
+    s = w.summary(now=2001.0)["60s"]
+    assert s["count"] == 100
+    assert s["qps"] == pytest.approx(1000.0, rel=0.05)
+
+
+def test_rolling_window_ages_out_old_samples():
+    w = telemetry.RollingWindow(windows=(60.0,), budget=0.01)
+    w.observe(1.0, ok=False, now=100.0)      # outside the window later
+    for i in range(10):
+        w.observe(0.010, ok=True, now=500.0 + i)
+    s = w.summary(now=510.0)["60s"]
+    assert s["count"] == 10 and s["errors"] == 0
+    assert s["p99_ms"] == pytest.approx(10.0)
+
+
+# ---------------------------------------------------------------------------
+# trace rotation + ring overflow
+# ---------------------------------------------------------------------------
+
+def test_rotation_spec_parsing(monkeypatch):
+    monkeypatch.setenv("ANOVOS_TPU_TRACE_ROTATE", "30s")
+    assert rotation_spec() == ("secs", 30.0)
+    monkeypatch.setenv("ANOVOS_TPU_TRACE_ROTATE", "1.5s")
+    assert rotation_spec() == ("secs", 1.5)
+    monkeypatch.setenv("ANOVOS_TPU_TRACE_ROTATE", "200000")
+    assert rotation_spec() == ("spans", 200000.0)
+    for off in ("", "0", "false", "garbage"):
+        monkeypatch.setenv("ANOVOS_TPU_TRACE_ROTATE", off)
+        assert rotation_spec() is None
+
+
+def test_trace_rotation_union_equals_uninterrupted_export(tmp_path):
+    tr = Tracer(buffer=10_000)
+    rot = TraceRotator(str(tmp_path / "trace.json"), tracer=tr,
+                       spec=("spans", 37))
+    expected = []
+    for i in range(150):
+        with tr.span(f"op{i:03d}", idx=i):
+            pass
+        expected.append(f"op{i:03d}")
+        rot.maybe_rotate()
+    segments = rot.close()
+    assert len(segments) >= 3
+    got = []
+    last_end = None
+    for p in segments:
+        doc = json.load(open(p))
+        evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        got.extend(e["name"] for e in evs)
+        # one shared epoch: segment timelines do not restart at zero
+        start = min(e["ts"] for e in evs)
+        if last_end is not None:
+            assert start >= last_end - 1e3  # µs slack for overlapping spans
+        last_end = max(e["ts"] for e in evs)
+    assert sorted(got) == sorted(expected)  # complete, no dupes, no loss
+    assert tr.span_count() == 0
+
+
+def test_rotation_secs_mode_and_thread_lifecycle(tmp_path):
+    tr = Tracer(buffer=10_000)
+    rot = TraceRotator(str(tmp_path / "t.json"), tracer=tr,
+                       spec=("secs", 0.15)).start()
+    assert any(t.name == "anovos-trace-rotator" for t in threading.enumerate())
+    for i in range(20):
+        with tr.span(f"s{i}"):
+            pass
+        time.sleep(0.02)
+    segments = rot.close()
+    assert not any(t.name == "anovos-trace-rotator"
+                   for t in threading.enumerate())
+    assert len(segments) >= 2
+    names = []
+    for p in segments:
+        doc = json.load(open(p))
+        names += [e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert sorted(names) == sorted(f"s{i}" for i in range(20))
+
+
+def test_rotation_failed_export_requeues_spans_no_phantom_segment(tmp_path):
+    """A failed segment export must neither lose the drained spans nor
+    record a path that was never written."""
+    tr = Tracer(buffer=1000)
+    dest = tmp_path / "blocked" / "trace.json"
+    rot = TraceRotator(str(dest), tracer=tr, spec=("spans", 1))
+    for i in range(5):
+        with tr.span(f"s{i}"):
+            pass
+    # make the export fail: the destination's parent is a FILE
+    (tmp_path / "blocked").write_text("not a directory")
+    with pytest.raises(Exception):
+        rot.maybe_rotate(force=True)
+    assert rot.segments == []          # no phantom segment recorded
+    assert tr.span_count() == 5        # spans requeued, nothing lost
+    (tmp_path / "blocked").unlink()
+    path = rot.maybe_rotate(force=True)  # next attempt succeeds
+    assert path and rot.segments == [path]
+    doc = json.load(open(path))
+    assert sorted(e["name"] for e in doc["traceEvents"] if e.get("ph") == "X") \
+        == [f"s{i}" for i in range(5)]
+
+
+def test_tracer_ring_overflow_counts_and_warns_once(caplog):
+    import logging
+
+    before = get_metrics().counter("trace_spans_dropped_total").value()
+    tr = Tracer(buffer=16)
+    with caplog.at_level(logging.WARNING, "anovos_tpu.obs.tracing"):
+        for i in range(40):
+            with tr.span("x"):
+                pass
+    assert tr.dropped == 24
+    assert get_metrics().counter(
+        "trace_spans_dropped_total").value() == before + 24
+    warns = [r for r in caplog.records if "ring wrapped" in r.message]
+    assert len(warns) == 1  # log-once
+
+
+# ---------------------------------------------------------------------------
+# /healthz folding
+# ---------------------------------------------------------------------------
+
+def test_health_ok_then_degraded_section():
+    from anovos_tpu.resilience.policy import record_degraded, reset_degraded
+
+    doc = telemetry.health()
+    assert doc["status"] == "ok" and doc["reasons"] == []
+    record_degraded("quality_checker/outliers", "synthetic failure")
+    doc = telemetry.health()
+    assert doc["status"] == "degraded"
+    assert any("quality_checker/outliers" in r for r in doc["reasons"])
+    reset_degraded()
+
+
+def test_health_provider_fragment_names_failed_batch():
+    telemetry.register_provider(
+        "serving", health=lambda: (
+            "degraded", ["serving: micro-batch of 9 row(s) (3 request(s)) "
+                         "failed after retry: RuntimeError: boom"]))
+    doc = telemetry.health()
+    assert doc["status"] == "degraded"
+    assert any("micro-batch of 9" in r for r in doc["reasons"])
+
+
+def test_refresh_heartbeat_only_touches_registered_beats():
+    """refresh is the mid-work keepalive: it re-beats an EXISTING
+    heartbeat (a long fold stays healthy) but never registers one (a
+    one-shot step through the same code path stays heartbeat-free)."""
+    telemetry.refresh_heartbeat("svc")  # nothing registered: no-op
+    assert "svc" not in telemetry.heartbeat_ages()
+    telemetry.beat("svc", interval_s=0.01, stale_after_s=0.2)
+    time.sleep(0.25)
+    assert telemetry.heartbeat_ages()["svc"]["stale"] is True
+    telemetry.refresh_heartbeat("svc")
+    hb = telemetry.heartbeat_ages()["svc"]
+    assert hb["stale"] is False and hb["stale_after_s"] == 0.2
+
+
+def test_heartbeat_staleness_flips_health():
+    telemetry.beat("continuum_watcher", interval_s=0.01, stale_after_s=0.15)
+    doc = telemetry.health()
+    assert doc["status"] == "ok"
+    assert doc["heartbeats"]["continuum_watcher"]["stale"] is False
+    time.sleep(0.25)
+    doc = telemetry.health()
+    assert doc["status"] == "degraded"
+    assert any("continuum_watcher" in r and "stale" in r for r in doc["reasons"])
+    time.sleep(0.35)  # past 3× stale_after ⇒ unhealthy, and HTTP says 503
+    doc = telemetry.health()
+    assert doc["status"] == "unhealthy"
+    srv = telemetry.acquire("test", port=0)
+    try:
+        code, body = _get(srv.port, "/healthz")
+        assert code == 503
+        assert json.loads(body)["status"] == "unhealthy"
+    finally:
+        telemetry.release(srv)
+
+
+# ---------------------------------------------------------------------------
+# continuum integration: the watcher beats + exposes backlog/lag
+# ---------------------------------------------------------------------------
+
+def test_continuum_step_sets_gauges_but_no_oneshot_heartbeat(tmp_path):
+    from anovos_tpu.continuum.watcher import ContinuumConfig, step
+
+    feed = tmp_path / "feed"
+    feed.mkdir()
+    rng = np.random.default_rng(3)
+    pd.DataFrame({"a": rng.normal(0, 1, 50),
+                  "cat": rng.choice(["x", "y"], 50)}).to_parquet(
+        feed / "day-01.parquet", index=False)
+    cfg = ContinuumConfig(
+        dataset_path=str(feed),
+        state_dir=str(tmp_path / "state"),
+        output_path=str(tmp_path / "out"),
+        poll_s=0.5,
+    )
+    summary = step(cfg)
+    assert summary["folded"] == ["day-01.parquet"]
+    # the heartbeat belongs to run(), the service loop: a one-shot step
+    # (the `step` CLI, the workflow's continuous_analysis node) must not
+    # register a beat nothing will refresh — it would flip /healthz
+    # stale on a healthy batch run
+    assert "continuum_watcher" not in telemetry.heartbeat_ages()
+    snap = get_metrics().snapshot()
+    assert "continuum_fold_backlog" in snap
+    assert "continuum_arrival_artifact_lag_seconds" in snap
+    lag = list(snap["continuum_arrival_artifact_lag_seconds"]["series"].values())
+    assert lag and lag[0] >= 0
+    # the backlog gauge ends the step drained
+    assert list(snap["continuum_fold_backlog"]["series"].values())[0] == 0.0
+
+
+def test_continuum_run_serves_telemetry_and_rotates(tmp_path, monkeypatch):
+    """The `continuum run` service surface: the loop owns the telemetry
+    listener (env-configured port) and the trace rotator for its
+    lifetime — /metrics answers DURING the run with the fold families,
+    segments land on disk, and both are torn down at loop exit."""
+    from anovos_tpu.continuum.watcher import ContinuumConfig, run
+
+    feed = tmp_path / "feed"
+    feed.mkdir()
+    rng = np.random.default_rng(5)
+    for day in (1, 2):
+        pd.DataFrame({"a": rng.normal(0, 1, 40)}).to_parquet(
+            feed / f"day-{day:02d}.parquet", index=False)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    monkeypatch.setenv("ANOVOS_TPU_TELEMETRY", str(port))
+    monkeypatch.setenv("ANOVOS_TPU_TRACE_ROTATE", "1")  # rotate every span
+    cfg = ContinuumConfig(
+        dataset_path=str(feed),
+        state_dir=str(tmp_path / "state"),
+        output_path=str(tmp_path / "out"),
+        poll_s=0.05,
+    )
+    scraped = {}
+
+    def poll():
+        for _ in range(400):
+            try:
+                code, body = _get(port, "/metrics", timeout=2)
+                if code == 200 and "continuum_fold_backlog" in body:
+                    scraped["metrics"] = True
+                    code, hb = _get(port, "/healthz", timeout=2)
+                    doc = json.loads(hb)
+                    scraped["healthz"] = doc["status"]
+                    if "continuum_watcher" in doc["heartbeats"]:
+                        scraped["heartbeat"] = True
+                        return
+            except Exception:
+                pass
+            time.sleep(0.01)
+
+    t = threading.Thread(target=poll, daemon=True)
+    t.start()
+    steps = run(cfg, max_iterations=3)
+    t.join(timeout=5)
+    assert len(steps) == 3
+    assert scraped.get("metrics") is True
+    assert scraped.get("healthz") in ("ok", "degraded")  # scrapeable mid-run
+    assert scraped.get("heartbeat") is True  # the service loop beats
+    # listener torn down with the loop; a cleanly-stopped loop clears its
+    # heartbeat so an outliving process never pages as stale
+    assert telemetry.current() is None
+    assert "continuum_watcher" not in telemetry.heartbeat_ages()
+    segs = sorted((tmp_path / "out" / "obs").glob("trace_*.json"))
+    assert segs, "rotation produced no segments"
+    total = sum(
+        1 for p in segs
+        for e in json.loads(p.read_text())["traceEvents"] if e.get("ph") == "X")
+    assert total >= 3  # at least the per-step continuum spans
+
+
+def test_continuum_run_crash_keeps_heartbeat(tmp_path):
+    """A loop that DIES keeps its beat (stale → /healthz pages); only an
+    intentional stop clears it."""
+    from anovos_tpu.continuum.watcher import ContinuumConfig, run
+
+    feed = tmp_path / "feed"
+    feed.mkdir()
+    pd.DataFrame({"a": [1.0, 2.0]}).to_parquet(feed / "day-01.parquet",
+                                               index=False)
+    cfg = ContinuumConfig(
+        dataset_path=str(feed),
+        state_dir=str(tmp_path / "state"),
+        output_path=str(tmp_path / "out"),
+        outlier_model_path=str(tmp_path / "no_such_model"),  # step() raises
+        poll_s=0.05,
+    )
+    with pytest.raises(Exception):
+        run(cfg, max_iterations=1)
+    assert "continuum_watcher" in telemetry.heartbeat_ages()
+
+
+# ---------------------------------------------------------------------------
+# /metrics live families through providers
+# ---------------------------------------------------------------------------
+
+def test_metrics_scrape_renders_provider_gauges_and_heartbeats():
+    telemetry.beat("svc", interval_s=30.0)
+    telemetry.register_provider(
+        "serving",
+        metrics=lambda reg: reg.gauge(
+            "serve_rolling_qps", "qps").set(42.5, window="60s"))
+    srv = telemetry.acquire("test", port=0)
+    try:
+        _, body = _get(srv.port, "/metrics")
+        assert 'serve_rolling_qps{window="60s"} 42.5' in body
+        assert 'heartbeat_age_seconds{name="svc"}' in body
+        assert 'heartbeat_stale{name="svc"} 0.0' in body
+    finally:
+        telemetry.release(srv)
+
+
+def test_cleared_heartbeat_drops_its_gauge_series():
+    """A cleared heartbeat must not scrape as frozen-fresh forever: the
+    age/stale series leave the registry with the beat."""
+    telemetry.beat("gone_svc", interval_s=30.0)
+    srv = telemetry.acquire("test", port=0)
+    try:
+        _, body = _get(srv.port, "/metrics")
+        assert 'heartbeat_age_seconds{name="gone_svc"}' in body
+        telemetry.clear_heartbeat("gone_svc")
+        _, body = _get(srv.port, "/metrics")
+        assert 'name="gone_svc"' not in body
+    finally:
+        telemetry.release(srv)
+
+
+def test_serve_timeout_burns_error_budget():
+    """A request that times out awaiting its batch is a client-visible
+    failure: it must land in the rolling windows as an error, or a
+    wedged apply would scrape as a healthy server."""
+    from anovos_tpu.serving.server import FeatureServer
+
+    class _FakeProgram:
+        input_columns = [{"name": "a", "kind": "num"}]
+
+    server = FeatureServer.__new__(FeatureServer)
+    server.program = _FakeProgram()
+    server.max_batch = 8
+    import queue as _q
+
+    server._queue = _q.Queue()
+    server._lock = threading.Lock()
+    server._quarantined = 0
+    from collections import deque
+
+    server._latencies = deque(maxlen=128)
+    server.rolling = telemetry.RollingWindow(windows=(60.0,), budget=0.01)
+    # no batcher thread running: the request must time out
+    resp = server.serve({"columns": {"a": [1.0]}}, timeout_s=0.05)
+    assert resp["error"]["code"] == "timeout"
+    s = server.rolling.summary()["60s"]
+    assert s["count"] == 1 and s["errors"] == 1
+    assert s["error_budget_burn"] > 0
+    assert get_metrics().counter("serve_requests_timeout_total").value() >= 1
+    # timeouts count toward the latency tail stats() reads
+    assert len(server._latencies) == 1 and server._latencies[0] >= 0.05
+
+
+def test_broken_provider_costs_its_family_not_the_scrape():
+    def boom(reg):
+        raise RuntimeError("provider broke")
+
+    telemetry.register_provider("broken", metrics=boom,
+                                statusz=lambda: 1 / 0)
+    srv = telemetry.acquire("test", port=0)
+    try:
+        code, body = _get(srv.port, "/metrics")
+        assert code == 200 and "telemetry_scrapes_total" in body
+        code, body = _get(srv.port, "/statusz")
+        assert code == 200
+        assert "ZeroDivisionError" in json.loads(body)["providers"]["broken"]["error"]
+    finally:
+        telemetry.release(srv)

@@ -498,52 +498,116 @@ def run_serve_fault(workdir: str) -> dict:
         return frame_to_payload(program.apply_frame(frame))
 
     def run_leg(leg: str, chaos_spec: str) -> dict:
+        import threading
+        import urllib.error
+        import urllib.request
+
+        from anovos_tpu.obs import telemetry
+
         obs_dir = os.path.join(workdir, leg)
         os.makedirs(obs_dir, exist_ok=True)
         flight.configure(os.path.join(obs_dir, "obs"))
-        program = ApplyProgram(load_bundle(cache, version))
-        server = FeatureServer(program, obs_dir=obs_dir)
-        t0 = time.monotonic()
-        server.start(warm=True)
-        # faults target STEADY-STATE serving: the plan lands after boot so
-        # the warm probe is not the victim
-        chaos.install(chaos_spec or None)
-        out: dict = {"cold_start_s": round(time.monotonic() - t0, 3)}
-        victim = None
-        if chaos_spec:
-            victim = server.serve(payloads[-1])
-        clean_bad = []
-        hostile_bad = []
-        for i, p in enumerate(payloads[:12]):
-            resp = server.serve(p)
-            if "error" in resp or resp.get("columns") != reference(program, p):
-                clean_bad.append(i)
-            if chaos_spec and i % 3 == 0:
-                h = server.serve(hostile[(i // 3) % len(hostile)])
-                if "error" not in h:
-                    hostile_bad.append(i)
-        stats = server.stats()
-        server.close()
-        dumps = flight_dumps(obs_dir)
-        chaos_plan = chaos.plan()
-        out.update({
-            "victim": victim,
-            "clean_corrupted": clean_bad,
-            "hostile_unrefused": hostile_bad,
-            "stats": stats,
-            "flightrec": [{"file": os.path.basename(p), "trigger": t,
-                           "node": n} for p, t, n in dumps],
-            "injections": chaos_plan.injection_count() if chaos_plan else 0,
-        })
-        chaos.reset()
-        flight.reset()
-        return out
+        # the live telemetry plane rides the leg on an ephemeral port:
+        # the gate scrapes /metrics + /healthz WHILE the fault is in
+        # flight (a wedged apply must never wedge a scrape)
+        tele = telemetry.acquire(context=f"chaos-{leg}", port=0)
+        scrape_failures = [0]
+
+        def scrape(path: str):
+            """(status_code, body) — a 503 (unhealthy) is still a SERVED
+            scrape; only a dead/deaf listener counts as a failure."""
+            if tele is None:
+                scrape_failures[0] += 1
+                return None, "telemetry listener failed to bind"
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{tele.port}{path}", timeout=10) as r:
+                    return r.status, r.read().decode()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read().decode()
+            except Exception as e:
+                scrape_failures[0] += 1
+                return None, f"{type(e).__name__}: {e}"
+
+        try:
+            program = ApplyProgram(load_bundle(cache, version))
+            server = FeatureServer(program, obs_dir=obs_dir)
+            t0 = time.monotonic()
+            server.start(warm=True)
+            # faults target STEADY-STATE serving: the plan lands after
+            # boot so the warm probe is not the victim
+            chaos.install(chaos_spec or None)
+            out: dict = {"cold_start_s": round(time.monotonic() - t0, 3)}
+            victim = None
+            midfault_ok = 0
+            if chaos_spec:
+                # drive the victim from a side thread and scrape
+                # MID-FAULT: the injected 0.5s hang is in flight while
+                # /metrics and /healthz must keep answering
+                box: list = []
+                vt = threading.Thread(
+                    target=lambda: box.append(server.serve(payloads[-1])))
+                vt.start()
+                time.sleep(0.15)
+                for path in ("/metrics", "/healthz"):
+                    code, _body = scrape(path)
+                    if code is not None:
+                        midfault_ok += 1
+                vt.join()
+                victim = box[0] if box else None
+            clean_bad = []
+            hostile_bad = []
+            for i, p in enumerate(payloads[:12]):
+                resp = server.serve(p)
+                if "error" in resp or resp.get("columns") != reference(program, p):
+                    clean_bad.append(i)
+                if chaos_spec and i % 3 == 0:
+                    h = server.serve(hostile[(i // 3) % len(hostile)])
+                    if "error" not in h:
+                        hostile_bad.append(i)
+            # post-load health + exposition sanity, still mid-leg
+            _code, health_body = scrape("/healthz")
+            try:
+                health_doc = json.loads(health_body) if health_body else {}
+            except ValueError:
+                health_doc = {}
+            _mcode, metrics_body = scrape("/metrics")
+            stats = server.stats()
+            server.close()
+            dumps = flight_dumps(obs_dir)
+            chaos_plan = chaos.plan()
+            out.update({
+                "victim": victim,
+                "clean_corrupted": clean_bad,
+                "hostile_unrefused": hostile_bad,
+                "stats": stats,
+                "flightrec": [{"file": os.path.basename(p), "trigger": t,
+                               "node": n} for p, t, n in dumps],
+                "injections": chaos_plan.injection_count() if chaos_plan else 0,
+                "midfault_scrapes_ok": midfault_ok,
+                "scrape_failures": scrape_failures[0],
+                "healthz_status": health_doc.get("status"),
+                "healthz_reasons": health_doc.get("reasons", []),
+                "metrics_has_serve_families": bool(
+                    metrics_body and "serve_batches_total" in metrics_body
+                    and "serve_rolling_qps" in metrics_body),
+            })
+            return out
+        finally:
+            # a leg that dies mid-body must not leak the listener (the
+            # next leg's acquire would join the leaked refcount and its
+            # release would never stop the socket) nor the chaos plan
+            telemetry.release(tele)
+            chaos.reset()
+            flight.reset()
 
     clean = run_leg("clean", "")
     result["clean_flightrec"] = len(clean["flightrec"])
     result["clean_corrupted"] = clean["clean_corrupted"]
     result["clean_p99_ms"] = clean["stats"]["p99_ms"]
     result["clean_wall_s"] = clean["cold_start_s"]
+    result["clean_healthz"] = clean["healthz_status"]
+    result["clean_scrape_failures"] = clean["scrape_failures"]
 
     chaos_leg = run_leg("chaos", spec)
     result["injections"] = chaos_leg["injections"]
@@ -553,11 +617,30 @@ def run_serve_fault(workdir: str) -> dict:
     result["flightrec"] = chaos_leg["flightrec"]
     result["quarantined"] = chaos_leg["stats"]["quarantined"]
     result["served_after_fatal"] = chaos_leg["stats"]["served"]
+    result["midfault_scrapes_ok"] = chaos_leg["midfault_scrapes_ok"]
+    result["chaos_scrape_failures"] = chaos_leg["scrape_failures"]
+    result["chaos_healthz"] = chaos_leg["healthz_status"]
+    result["chaos_healthz_reasons"] = chaos_leg["healthz_reasons"]
 
     victim = chaos_leg["victim"] or {}
     victim_ok = (victim.get("error") or {}).get("code") == "apply_failed"
     fatal_dumped = any(d["trigger"] == "serve_fatal"
                       for d in chaos_leg["flightrec"])
+    # telemetry-plane gates: the clean leg reports ok with zero dropped
+    # scrapes; the chaos leg's /healthz flips to degraded NAMING the
+    # failed batch, and every scrape during the fault was served
+    health_flipped = (
+        chaos_leg["healthz_status"] == "degraded"
+        and any("serving" in r and "failed after retry" in r
+                for r in chaos_leg["healthz_reasons"]))
+    telemetry_ok = (
+        clean["healthz_status"] == "ok"
+        and clean["scrape_failures"] == 0
+        and clean["metrics_has_serve_families"]
+        and chaos_leg["scrape_failures"] == 0
+        and chaos_leg["midfault_scrapes_ok"] >= 2
+        and health_flipped)
+    result["telemetry_ok"] = telemetry_ok
     # bounded p99: the injected 0.5s hang + one retry must not push the
     # tail anywhere near a hung-server cliff
     p99_bound_ms = 10_000.0
@@ -567,12 +650,34 @@ def run_serve_fault(workdir: str) -> dict:
                             or chaos_leg["clean_corrupted"])
     result["ok"] = bool(
         result["parity"] and victim_ok and fatal_dumped and bounded
+        and telemetry_ok
         and not chaos_leg["hostile_unrefused"]
         and chaos_leg["stats"]["served"] >= len(payloads[:12])
         and result["injections"] >= 3
         and result["clean_flightrec"] == 0)
     if not result["ok"]:
         reasons = []
+        if clean["healthz_status"] != "ok":
+            reasons.append(
+                f"clean-leg /healthz reported {clean['healthz_status']!r} "
+                f"({clean.get('healthz_reasons')}) instead of ok")
+        if clean["scrape_failures"] or chaos_leg["scrape_failures"]:
+            reasons.append(
+                f"dropped scrapes (clean {clean['scrape_failures']}, "
+                f"chaos {chaos_leg['scrape_failures']}) — every scrape "
+                "must be served, fault or not")
+        if chaos_leg["midfault_scrapes_ok"] < 2:
+            reasons.append(
+                f"only {chaos_leg['midfault_scrapes_ok']}/2 mid-fault "
+                "scrapes answered while the apply hang was in flight")
+        if not health_flipped:
+            reasons.append(
+                f"/healthz did not flip to degraded naming the failed batch "
+                f"(status={chaos_leg['healthz_status']!r}, "
+                f"reasons={chaos_leg['healthz_reasons']})")
+        if not clean["metrics_has_serve_families"]:
+            reasons.append("/metrics exposition is missing the live serve "
+                           "families (serve_batches_total / serve_rolling_qps)")
         if clean["clean_corrupted"] or chaos_leg["clean_corrupted"]:
             reasons.append(
                 f"corrupted clean responses (clean leg {clean['clean_corrupted']}, "

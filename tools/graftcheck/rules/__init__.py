@@ -16,4 +16,5 @@ from tools.graftcheck.rules import (  # noqa: F401  (import = registration)
     gc013_serving_request_path,
     gc014_sync_decode,
     gc015_nonmergeable_accumulator,
+    gc016_label_cardinality,
 )

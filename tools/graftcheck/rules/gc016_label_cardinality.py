@@ -1,0 +1,167 @@
+"""GC016 — unbounded metric label cardinality.
+
+Every distinct label combination on a ``MetricsRegistry`` instrument is
+an independent series held FOREVER (the registry never evicts): a
+counter labeled by a request id, a file path, or a per-row value grows
+one series per observation, which is a slow memory leak in a
+long-running service, an unbounded ``/metrics`` exposition (the live
+telemetry plane renders every series on every scrape), and a
+cardinality explosion for any downstream Prometheus.  Label values must
+come from SMALL CLOSED SETS — enum-ish kinds, node names from the
+bounded DAG, device labels, window names — never from per-row,
+per-request, or per-path data.
+
+Detection (``anovos_tpu/`` scope):
+
+* **observation calls** — ``.inc(...)`` / ``.set(...)`` / ``.set_max(...)``
+  / ``.observe(...)`` whose receiver is a direct
+  ``*.counter(...)``/``*.gauge(...)``/``*.histogram(...)`` chain or a
+  local name assigned from one;
+* **flagged label values** —
+  - a label NAMED like per-entity data (``key``, ``column``, ``col``,
+    ``path``, ``file``, ``filename``, ``request``, ``request_id``,
+    ``id``, ``uid``, ``user``, ``url``, ``uri``, ``part``, ``row``)
+    whose value is not a string literal (a literal is a closed set of
+    one);
+  - any label whose value expression is path-derived
+    (``os.path.basename(...)`` and friends) or references an
+    identifier that names request/path data (``path``, ``file``,
+    ``filename``, ``request``, ``payload``, ``url``, ``uuid``, …);
+* **not flagged** — literal values, and variables with closed-set names
+  (``kind``, ``reason``, ``node``, ``device``, ``op``, ``window``,
+  ``block``, ``stage``, ``endpoint``, …).
+
+A genuinely bounded use with an unlucky name (a label keyed by the
+dataset SCHEMA rather than row data) takes a per-line
+``# graftcheck: disable=GC016`` or a baseline entry whose justification
+names the bound.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional, Set
+
+from tools.graftcheck.jaxmodel import call_chain
+from tools.graftcheck.registry import FileContext, Rule, register
+
+_OBSERVE_ATTRS = {"inc", "set", "set_max", "observe"}
+_CONSTRUCTOR_ATTRS = {"counter", "gauge", "histogram"}
+
+# label NAMES that declare per-entity identity: non-literal values under
+# these names are presumed unbounded until justified
+_SUSPICIOUS_LABEL_NAMES = {
+    "key", "column", "col", "path", "file", "filename", "fname",
+    "request", "request_id", "rid", "id", "uid", "user", "url", "uri",
+    "part", "row",
+}
+
+# identifiers inside a label VALUE expression that carry per-request /
+# per-path data regardless of the label's own name
+_TAINTED_VALUE_NAME = re.compile(
+    r"(^|_)(path|file|filename|fname|request|req|payload|url|uri|uuid)(s|_id)?$")
+
+_PATH_CALLS = {"basename", "abspath", "relpath", "realpath", "dirname"}
+
+_MSG_NAME = (
+    "metric label {label}={value!r} looks per-entity (label name {label!r} "
+    "with a non-literal value): every distinct value is a series held "
+    "forever and rendered on every /metrics scrape — label from a small "
+    "closed set, fold the identity into a log/journal line instead, or "
+    "justify the bound (suppression/baseline)"
+)
+_MSG_VALUE = (
+    "metric label {label}={value!r} derives from per-request/per-path data "
+    "({why}): unbounded label cardinality leaks one series per observation "
+    "in a long-running service — label from a small closed set or move the "
+    "identity to a log/journal line"
+)
+
+
+def _expr_src(ctx: FileContext, node: ast.AST) -> str:
+    try:
+        return ast.get_source_segment(ctx.source, node) or ast.dump(node)
+    except Exception:
+        return ast.dump(node)
+
+
+def _metric_receiver_names(tree: ast.Module) -> Set[str]:
+    """Names assigned (anywhere in the file) from a
+    ``*.counter/gauge/histogram(...)`` call."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            fn = node.value.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _CONSTRUCTOR_ATTRS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _is_metric_receiver(expr: ast.AST, names: Set[str]) -> bool:
+    if isinstance(expr, ast.Call):
+        fn = expr.func
+        return isinstance(fn, ast.Attribute) and fn.attr in _CONSTRUCTOR_ATTRS
+    if isinstance(expr, ast.Name):
+        return expr.id in names
+    return False
+
+
+def _value_taint(value: ast.AST) -> Optional[str]:
+    """Why this label value looks unbounded, or None."""
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call):
+            chain = call_chain(sub)
+            last = chain.rsplit(".", 1)[-1] if chain else ""
+            if chain.startswith("os.path.") or last in _PATH_CALLS:
+                return f"path-derived via {chain or last}()"
+        if isinstance(sub, ast.Name) and _TAINTED_VALUE_NAME.search(sub.id):
+            return f"references {sub.id!r}"
+        if isinstance(sub, ast.Attribute) and _TAINTED_VALUE_NAME.search(sub.attr):
+            return f"references .{sub.attr}"
+    return None
+
+
+@register
+class LabelCardinalityRule(Rule):
+    id = "GC016"
+    title = "unbounded metric label cardinality"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("anovos_tpu/") or "gc016" in relpath
+
+    def check(self, ctx: FileContext) -> Iterable:
+        receiver_names = _metric_receiver_names(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _OBSERVE_ATTRS
+                    and node.keywords
+                    and _is_metric_receiver(node.func.value, receiver_names)):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    yield ctx.finding(
+                        self.id, node,
+                        "metric labels splatted from **kwargs are "
+                        "unverifiable — pass explicit label keywords so "
+                        "cardinality is auditable")
+                    continue
+                if kw.arg == "buckets":  # histogram() config, not a label
+                    continue
+                is_literal = isinstance(kw.value, ast.Constant)
+                if kw.arg.lower() in _SUSPICIOUS_LABEL_NAMES and not is_literal:
+                    yield ctx.finding(
+                        self.id, node,
+                        _MSG_NAME.format(label=kw.arg,
+                                         value=_expr_src(ctx, kw.value)))
+                    continue
+                why = None if is_literal else _value_taint(kw.value)
+                if why is not None:
+                    yield ctx.finding(
+                        self.id, node,
+                        _MSG_VALUE.format(label=kw.arg,
+                                          value=_expr_src(ctx, kw.value),
+                                          why=why))

@@ -104,6 +104,12 @@ TRACKED_FIELDS: Dict[str, Tuple[str, float]] = {
     "e2e_continuum_fold_s": ("lower", 0.60),
     "e2e_continuum_vs_batch_ratio": ("lower", 0.60),
     "e2e_continuum_alerts": ("higher", 0.60),
+    # telemetry plane (round 14): the A/B overhead percentage hovers near
+    # zero and is noise-dominated on a shared box, so its band is very
+    # wide (the <1% acceptance bar is enforced by bench itself, loudly);
+    # the scrape tail rides the usual shared-box latency band.
+    "e2e_telemetry_overhead_pct": ("lower", 3.00),
+    "e2e_scrape_p99_ms": ("lower", 0.60),
 }
 BASELINE_WINDOW = 3
 
