@@ -31,15 +31,22 @@ Three cooperating, stdlib-only pieces:
   events dumped synchronously to ``obs/flightrec_<node>.json`` on
   timeout escalation, abandonment, backend failover, or fatal error —
   the postmortem a merely-survived wedge used to throw away.
+* **Perf doctor** (``obs.diffing``): the structural run-diff engine —
+  two manifests (or two perf-ledger entries) in, one ranked diagnosis
+  out: per-node phase movement, compile-census program-set diff, cache
+  hit-set diff with the moved fingerprint input named, env-knob diff,
+  queue-wait separated from body movement.  ``tools/perf_doctor.py`` is
+  the CLI; ledger gate failures attach a ``diagnosis`` automatically.
 
 Recording is always on at negligible cost; trace-file export is gated by
 ``ANOVOS_TPU_TRACE=<path|1>``, attribution by ``ANOVOS_TPU_DEVPROF``,
 the flight recorder by ``ANOVOS_TPU_FLIGHTREC``.
 """
 
-from anovos_tpu.obs import compile_census, devprof, flight, telemetry
+from anovos_tpu.obs import compile_census, devprof, diffing, flight, telemetry
 from anovos_tpu.obs.manifest import (
     MANIFEST_VERSION,
+    STABLE_TOP_FIELDS,
     build_manifest,
     config_hash,
     load_manifest,
@@ -72,10 +79,12 @@ from anovos_tpu.obs.tracing import (
 __all__ = [
     "compile_census",
     "devprof",
+    "diffing",
     "flight",
     "telemetry",
     "memory_by_device",
     "MANIFEST_VERSION",
+    "STABLE_TOP_FIELDS",
     "build_manifest",
     "config_hash",
     "load_manifest",

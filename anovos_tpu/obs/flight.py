@@ -58,6 +58,11 @@ _LOCK = threading.Lock()
 _RING: Optional[deque] = None   # None until configure(); disabled when env=0
 _DIR: Optional[str] = None      # dump destination (the run's obs/ dir)
 _DUMPS: List[str] = []          # paths written this run
+# the LAST completed run's manifest at this obs dir, captured at arm time
+# (before this run overwrites it): the perf doctor's live baseline — so
+# /statusz and postmortems can say "slow vs the last clean run", not just
+# "slow"
+_BASELINE_MANIFEST: Optional[dict] = None
 
 
 def _ring_bound() -> int:
@@ -83,10 +88,23 @@ def configure(obs_dir: Optional[str]) -> None:
     before scheduling; a falsy ``obs_dir`` or ``ANOVOS_TPU_FLIGHTREC=0``
     disarms (library users of DagScheduler outside a workflow run see a
     no-op recorder)."""
-    global _RING, _DIR
+    global _RING, _DIR, _BASELINE_MANIFEST
     bound = _ring_bound()
+    baseline = None
+    if obs_dir and bound != 0:
+        # parse the previous completed run's manifest NOW — the file is
+        # overwritten at this run's end, and a mid-run /statusz or crash
+        # dump must compare against the run BEFORE this one
+        try:
+            path = os.path.join(os.path.abspath(obs_dir), "run_manifest.json")
+            if os.path.isfile(path):
+                with open(path) as f:
+                    baseline = json.load(f)
+        except Exception:
+            baseline = None  # a torn/foreign file is no baseline
     with _LOCK:
         _DUMPS.clear()
+        _BASELINE_MANIFEST = baseline
         if not obs_dir or bound == 0:
             _RING, _DIR = None, None
             return
@@ -124,6 +142,24 @@ def snapshot_events() -> List[dict]:
     alert stream — use instead of triggering a full postmortem dump."""
     with _LOCK:
         return list(_RING) if _RING is not None else []
+
+
+def _doctor_summary() -> Optional[dict]:
+    """``diffing.live_node_summary`` over the captured baseline manifest
+    and the current devprof state (guarded — never raises)."""
+    with _LOCK:
+        baseline = _BASELINE_MANIFEST
+    if baseline is None:
+        return None
+    try:
+        from anovos_tpu.obs import devprof
+        from anovos_tpu.obs.diffing import live_node_summary
+
+        return live_node_summary(baseline, devprof.results(),
+                                 devprof.active_frames())
+    except Exception:
+        logger.exception("perf-doctor live summary failed")
+        return None
 
 
 def _safe_name(node: str) -> str:
@@ -210,6 +246,11 @@ def build_snapshot(trigger: str, node: str = "",
         "events": events,
         "spans_tail": _span_tail(),
         "devprof_finished": devprof.results(),
+        # perf-doctor live summary: THIS run's per-node walls vs the last
+        # completed run at the same obs dir (captured at configure time) —
+        # "what is slow right now vs the last clean run".  None when no
+        # prior manifest exists; a summary must never sink a snapshot.
+        "doctor": _doctor_summary(),
         "metrics": get_metrics().snapshot(),
     }
     if extra:

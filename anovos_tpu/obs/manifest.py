@@ -25,12 +25,40 @@ MANIFEST_VERSION = 1
 
 __all__ = [
     "MANIFEST_VERSION",
+    "STABLE_TOP_FIELDS",
     "config_hash",
     "build_manifest",
     "write_manifest",
     "load_manifest",
     "stable_view",
 ]
+
+
+def _env_section(all_configs: dict) -> Optional[dict]:
+    """The fingerprint inputs the perf doctor diffs: code version, the
+    audited env knobs (values, not just the digest — a knob DIFF must name
+    the knob), and the dataset/env fingerprints.  Telemetry for run
+    comparison, stripped by ``stable_view`` (knob values embed chaos specs
+    and spill-dir temp paths; the dataset signature embeds mtimes)."""
+    try:
+        from anovos_tpu.cache.fingerprint import (
+            KNOWN_ENV_KNOBS,
+            dataset_fingerprint,
+            env_fingerprint,
+        )
+        from anovos_tpu.version import __version__
+
+        return {
+            "code_version": __version__,
+            "knobs": {k: os.environ[k] for k in KNOWN_ENV_KNOBS
+                      if os.environ.get(k) not in (None, "")},
+            "env_fingerprint": env_fingerprint(),
+            "dataset_fingerprint": dataset_fingerprint(
+                all_configs.get("input_dataset")
+                if isinstance(all_configs, dict) else None),
+        }
+    except Exception:  # a manifest must build even without the cache pkg
+        return None
 
 
 def config_hash(all_configs: dict) -> str:
@@ -104,6 +132,10 @@ def build_manifest(
         # bench.py's e2e_device_time_s / e2e_transfer_bytes fields and
         # the HTML report's devprof split read
         "devprof": devprof,
+        # fingerprint-input record (the perf doctor's knob/code/dataset
+        # diff material): audited env-knob VALUES, code version, env and
+        # dataset fingerprints — see anovos_tpu.obs.diffing
+        "env": _env_section(all_configs),
         "trace_path": trace_path,
         "backend": backend,
         "generated_unix": round(
@@ -140,6 +172,21 @@ _VOLATILE_NODE_FIELDS = ("start_s", "end_s", "dur_s", "queue_wait_s", "thread",
                          # plan, real flakes, watchdog timing), never on what
                          # the run computes
                          "attempts", "escalated", "degraded")
+# Every key build_manifest writes must appear in exactly ONE of the two
+# classification lists below — STABLE (survives stable_view: pure run
+# identity, byte-equal across two sequential runs of one config) or
+# VOLATILE (stripped: wall-clock / history / environment-derived).
+# graftcheck GC017 audits build_manifest's keys against this partition, so
+# a future obs field cannot silently break the byte-parity goldens.
+STABLE_TOP_FIELDS = (
+    "manifest_version",
+    "config_hash",
+    "run_type",
+    "executor",
+    "scheduler",
+    "metrics",
+)
+
 _VOLATILE_TOP_FIELDS = (
     "generated_unix", "block_seconds", "trace_path", "backend",
     # the critical path is the longest chain BY MEASURED DURATION — two
@@ -155,6 +202,10 @@ _VOLATILE_TOP_FIELDS = (
     # every devprof field is duration/byte-rate telemetry (and byte counts
     # depend on cache-store history: a restored node transfers nothing)
     "devprof",
+    # fingerprint-input record for the perf doctor: knob VALUES embed
+    # chaos directives and spill-dir temp paths, and the dataset signature
+    # embeds mtimes — run-comparison telemetry, never run identity
+    "env",
 )
 
 

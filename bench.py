@@ -342,6 +342,7 @@ def e2e_cold_warm() -> dict:
     summary = {}
     census = {}
     devprof = {}
+    mans = {}
     cwd = os.getcwd()
     for label in ("cold", "warm"):
         with tempfile.TemporaryDirectory() as d:
@@ -356,6 +357,7 @@ def e2e_cold_warm() -> dict:
                 from anovos_tpu.obs import load_manifest
 
                 man = load_manifest(workflow.LAST_MANIFEST_PATH)
+                mans[label] = man  # the perf doctor diffs the pair below
                 blocks = dict(man.get("block_seconds", {}))
                 summary = dict(man.get("scheduler", {}))
                 # per-run XLA compile census (cold = the shape-bucketing
@@ -415,6 +417,20 @@ def e2e_cold_warm() -> dict:
                 sum(v.get("h2d_bytes", 0) + v.get("d2h_bytes", 0)
                     for v in devprof.values())),
         })
+        # compact per-node summary for the perf ledger: a gate failure's
+        # attached diagnosis (tools/perf_doctor) names WHICH node regressed
+        # and its dominant phase from exactly this record
+        result["e2e_node_summary"] = {
+            name: {k: v[k] for k in ("wall_s", "device_time_s", "dispatch_s",
+                                     "transfer_s", "host_s")
+                   if isinstance(v.get(k), (int, float))}
+            for name, v in sorted(devprof.items()) if isinstance(v, dict)
+        }
+    if len(mans) == 2 and os.environ.get("BENCH_DOCTOR", "1") == "1":
+        try:
+            result.update(e2e_doctor(mans["cold"], mans["warm"]))
+        except Exception as e:  # the doctor must never sink the headline
+            result["e2e_doctor_error"] = str(e)[-200:]
     if summary:
         # DAG-executor observability (warm run): serial work vs wall,
         # measured critical path, and the chain itself — how much of the
@@ -463,6 +479,27 @@ def e2e_cold_warm() -> dict:
         except Exception as e:  # continuum section must never sink the headline
             result["e2e_continuum_error"] = str(e)[-200:]
     return result
+
+
+def e2e_doctor(cold_man: dict, warm_man: dict) -> dict:
+    """Perf-doctor trajectory (round 15): structurally diff the cold ->
+    warm manifest pair the e2e loop just produced — the doctor's own wall
+    (it must stay trivially cheap), the attribution count, and the top
+    attribution line ride the round record, so the diff engine is
+    exercised on every bench run against real manifests, not just the
+    committed ledger pair.  ``BENCH_DOCTOR=0`` skips."""
+    from anovos_tpu.obs.diffing import diff_manifests, render_text
+
+    t0 = time.perf_counter()
+    diag = diff_manifests(cold_man, warm_man,
+                          baseline_label="cold", candidate_label="warm")
+    wall = time.perf_counter() - t0
+    top = render_text(diag, top=1)
+    return {
+        "e2e_doctor_attributions": len(diag.get("attributions") or []),
+        "e2e_doctor_top": top[0] if top else "",
+        "e2e_doctor_wall_s": round(wall, 4),
+    }
 
 
 def e2e_serving() -> dict:
@@ -1005,6 +1042,12 @@ def main() -> None:
     except Exception as e:
         result["ledger_ok"] = False
         result["ledger_error"] = str(e)[-200:]
+    # a flagged regression prints the perf doctor's top-3 attribution
+    # lines (which node/phase/program-set/knob moved) instead of leaving
+    # the reader a bare field name to hand-diff manifests over
+    if not result.get("ledger_ok", True):
+        for line in result.get("ledger_attribution") or []:
+            print("bench: ledger diagnosis " + line, file=sys.stderr)
     print(json.dumps(result))
 
 

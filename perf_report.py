@@ -276,6 +276,13 @@ def bench_e2e() -> dict:
         "scrape_p99_ms": r.get("e2e_scrape_p99_ms"),
         "scrape_failures": r.get("e2e_scrape_failures"),
         "telemetry_error": r.get("e2e_telemetry_error"),
+        # perf doctor (bench.e2e_doctor, round 15): the structural diff of
+        # the cold -> warm manifest pair — attribution count, the top
+        # attribution line, and the doctor's own (trivially cheap) wall
+        "doctor_attributions": r.get("e2e_doctor_attributions"),
+        "doctor_top": r.get("e2e_doctor_top"),
+        "doctor_wall_s": r.get("e2e_doctor_wall_s"),
+        "doctor_error": r.get("e2e_doctor_error"),
     }
 
 
@@ -432,6 +439,15 @@ def _write_md(r: dict) -> None:
                 f"| | warm devprof split | device {e['device_time_s']} s / "
                 f"dispatch {e.get('dispatch_s')} s / transfer "
                 f"{e.get('transfer_s')} s ({mb:.1f} MB moved) |")
+        if e.get("doctor_attributions") is not None:
+            lines.append(
+                f"| | run-diff doctor (cold→warm) | {e['doctor_attributions']} "
+                f"attribution(s) in {e.get('doctor_wall_s')} s |")
+            if e.get("doctor_top"):
+                lines.append(
+                    f"| | doctor top attribution | {str(e['doctor_top'])[:120]} |")
+        elif e.get("doctor_error"):
+            lines.append(f"| | run-diff doctor error | {str(e['doctor_error'])[:100]} |")
         for blk, secs in (e.get("warm_blocks") or {}).items():
             lines.append(f"| | warm block: {blk} | {secs} s |")
         if e.get("warm_blocks"):

@@ -1,0 +1,29 @@
+"""GC017 negative fixture: every produced manifest key is classified in
+exactly one tuple and no tuple entry is stale."""
+
+STABLE_TOP_FIELDS = (
+    "manifest_version",
+    "config_hash",
+    "scheduler",
+)
+
+_VOLATILE_TOP_FIELDS = (
+    "generated_unix",
+    "devprof",
+)
+
+
+def build_manifest(summary, devprof=None):
+    out = {
+        "manifest_version": 1,
+        "config_hash": "abc",
+        "scheduler": summary,
+        "generated_unix": 0.0,
+    }
+    out["devprof"] = devprof
+    return out
+
+
+def unrelated_helper():
+    # plain dicts outside build_* functions are not manifest keys
+    return {"anything": 1}

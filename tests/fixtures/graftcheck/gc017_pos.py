@@ -1,0 +1,30 @@
+"""GC017 positive fixture: a manifest-builder module whose field
+classification is broken four ways — an unclassified produced key, a key
+in both tuples, a stale entry in each tuple."""
+
+STABLE_TOP_FIELDS = (
+    "manifest_version",
+    "config_hash",
+    "scheduler",
+    "both_ways",            # also volatile below -> ambiguous
+    "stable_ghost",         # produced by nothing -> stale
+)
+
+_VOLATILE_TOP_FIELDS = (
+    "generated_unix",
+    "both_ways",
+    "volatile_ghost",       # produced by nothing -> stale
+)
+
+
+def build_manifest(summary):
+    out = {
+        "manifest_version": 1,
+        "config_hash": "abc",
+        "scheduler": summary,
+        "both_ways": summary,
+        "generated_unix": 0.0,
+        "mystery_field": summary,   # in neither tuple -> unclassified
+    }
+    out["late_mystery"] = summary   # subscript write, also unclassified
+    return out

@@ -27,7 +27,7 @@ FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftcheck")
 PKG = os.path.join(REPO, "anovos_tpu")
 RULE_IDS = ["GC001", "GC002", "GC003", "GC004", "GC005", "GC006", "GC007",
             "GC008", "GC009", "GC010", "GC011", "GC012", "GC013", "GC014",
-            "GC015", "GC016"]
+            "GC015", "GC016", "GC017"]
 
 
 # -- the gate: repo scan is clean against the committed baseline ----------
@@ -120,7 +120,7 @@ def test_expected_positive_counts():
     expected = {"GC001": 5, "GC002": 4, "GC003": 6, "GC004": 3,
                 "GC005": 4, "GC006": 4, "GC007": 2, "GC008": 4, "GC009": 4,
                 "GC010": 4, "GC011": 5, "GC012": 4, "GC013": 4, "GC014": 4,
-                "GC015": 2, "GC016": 4}
+                "GC015": 2, "GC016": 4, "GC017": 5}
     for rule_id, n in expected.items():
         path = os.path.join(FIXTURES, f"{rule_id.lower()}_pos.py")
         hits = [f for f in scan([path]) if f.rule == rule_id]
@@ -195,6 +195,22 @@ def test_gc008_zero_findings_in_workflow():
     wf = os.path.join(PKG, "workflow.py")
     findings = [f for f in scan([wf]) if f.rule == "GC008"]
     assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_gc017_manifest_classification_exact():
+    """The acceptance contract for the manifest contract itself: every key
+    ``build_manifest`` writes is classified in exactly one of
+    STABLE_TOP_FIELDS / _VOLATILE_TOP_FIELDS (zero findings), and the two
+    committed tuples partition the produced key set exactly — so a future
+    obs field cannot silently break byte-parity goldens."""
+    man = os.path.join(PKG, "obs", "manifest.py")
+    findings = [f for f in scan([man]) if f.rule == "GC017"]
+    assert not findings, "\n".join(f.render() for f in findings)
+    from anovos_tpu.obs import manifest as m
+
+    produced = set(m.build_manifest({}, {}, {}))
+    assert produced == set(m.STABLE_TOP_FIELDS) | set(m._VOLATILE_TOP_FIELDS)
+    assert not set(m.STABLE_TOP_FIELDS) & set(m._VOLATILE_TOP_FIELDS)
 
 
 def test_cli_exits_zero_on_repo():

@@ -191,6 +191,82 @@ def test_cli_check_flags_candidate_regression(tmp_path):
     assert {r["field"] for r in rec["regressions"]} >= {"e2e_warm_s", "value"}
 
 
+def test_field_trends_emits_gap_markers_aligned_to_entries():
+    """Satellite (round 15): an entry missing a tracked field used to be
+    silently skipped, shifting the sparkline left and misaligning the
+    HTML ledger tab against run ids — now every trend string carries one
+    glyph per ledger entry with an explicit gap marker."""
+    def entry(n, fields):
+        return perf_ledger._entry_from_bench(
+            {**fields, "e2e_backend": "cpu", "backend": "cpu"}, f"e{n}", n)
+
+    entries = [
+        entry(1, {"e2e_warm_s": 8.0, "value": 100.0}),
+        entry(2, {"value": 110.0}),                      # e2e_warm_s gap
+        entry(3, {"e2e_warm_s": 6.0, "value": 120.0}),
+    ]
+    rows = {r["field"]: r for r in perf_ledger.field_trends(entries)}
+    warm = rows["e2e_warm_s"]
+    assert len(warm["trend"]) == len(entries)            # aligned to run ids
+    assert warm["trend"][1] == perf_ledger.GAP_MARK      # the gap is EXPLICIT
+    assert warm["trend"][0] != perf_ledger.GAP_MARK
+    assert warm["trend"][2] != perf_ledger.GAP_MARK
+    assert warm["n"] == 2 and warm["gaps"] == 1
+    val = rows["value"]
+    assert perf_ledger.GAP_MARK not in val["trend"]
+    assert val["n"] == 3 and val["gaps"] == 0
+    assert len(val["trend"]) == len(entries)
+
+
+def test_flagged_entry_carries_doctor_diagnosis(tmp_path):
+    """Tentpole wiring (round 15): a gate failure attaches a non-empty
+    perf-doctor ``diagnosis`` to the flagged ledger entry, naming the
+    regressed node and its dominant phase, and the bench hook returns the
+    top attribution lines for printing."""
+    path = _fresh(tmp_path)
+    good = {"value": 3_700_000.0, "e2e_warm_s": 6.0, "e2e_backend": "cpu",
+            "backend": "cpu-fallback (t)",
+            "e2e_node_summary": {
+                "drift_statistics/all": {"wall_s": 1.0, "dispatch_s": 0.8,
+                                         "host_s": 0.2}}}
+    assert perf_ledger.record_and_check(good, path=path)["ledger_ok"] is True
+    bad = {"value": 3_700_000.0, "e2e_warm_s": 60.0, "e2e_backend": "cpu",
+           "backend": "cpu-fallback (t)",
+           "e2e_node_summary": {
+               "drift_statistics/all": {"wall_s": 3.0, "dispatch_s": 2.6,
+                                        "host_s": 0.4}}}
+    out = perf_ledger.record_and_check(bad, path=path)
+    assert out["ledger_ok"] is False
+    assert out["ledger_attribution"], out  # top-3 lines, not a bare field
+    flagged = perf_ledger.load(path)[-1]
+    diag = flagged.get("diagnosis")
+    assert diag and diag["attributions"], flagged
+    from anovos_tpu.obs.diffing import validate_diagnosis
+
+    assert validate_diagnosis(diag) == []
+    # the flagged FIELD leads (structural), and the regressed NODE is
+    # named with its dominant phase
+    assert diag["attributions"][0]["subject"] == "e2e_warm_s"
+    node_attrs = [a for a in diag["attributions"] if a["kind"] == "node"]
+    assert any("drift_statistics/all" in a["detail"]
+               and "dispatch" in a["detail"] for a in node_attrs), node_attrs
+    # a clean follow-up run attaches nothing
+    out3 = perf_ledger.record_and_check(dict(good), path=path)
+    assert out3["ledger_attribution"] == []
+
+
+def test_node_summary_rides_entries_but_not_content_id():
+    """The per-node summary must not move the committed entries' content
+    ids (ingest dedup keys on them)."""
+    base = {"value": 1.0, "e2e_backend": "cpu", "backend": "cpu"}
+    with_nodes = {**base,
+                  "e2e_node_summary": {"n1": {"wall_s": 1.0, "host_s": 1.0}}}
+    e1 = perf_ledger._entry_from_bench(base, "s", 1)
+    e2 = perf_ledger._entry_from_bench(with_nodes, "s", 1)
+    assert e1["id"] == e2["id"]
+    assert "nodes" not in e1 and e2["nodes"]["n1"]["wall_s"] == 1.0
+
+
 def test_committed_ledger_matches_rounds():
     """The repo-root PERF_LEDGER.jsonl is the ingested committed rounds —
     regenerating from BENCH_r*.json must be a no-op (append-only identity;

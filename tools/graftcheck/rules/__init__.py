@@ -17,4 +17,5 @@ from tools.graftcheck.rules import (  # noqa: F401  (import = registration)
     gc014_sync_decode,
     gc015_nonmergeable_accumulator,
     gc016_label_cardinality,
+    gc017_manifest_classification,
 )

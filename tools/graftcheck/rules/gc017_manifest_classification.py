@@ -1,0 +1,167 @@
+"""GC017 — run-manifest field classification audit.
+
+``obs.stable_view`` is the byte-parity contract of the run manifest: two
+sequential runs of one config must compare equal after the volatile
+fields are stripped.  That contract is only as good as the strip list —
+when PR 7 added ``devprof`` and PR 14 added nothing but PR 15 added
+``env``, each new ``build_manifest`` key had to be HAND-remembered into
+``_VOLATILE_TOP_FIELDS`` or the goldens would break (or worse: a
+wall-clock-valued field would silently ride ``stable_view`` and make
+"identical" runs compare unequal only under load).
+
+This rule makes the classification mechanical: every top-level key the
+manifest builder writes must appear in exactly one of the two committed
+classification tuples —
+
+* ``STABLE_TOP_FIELDS`` — run identity, survives ``stable_view``;
+* ``_VOLATILE_TOP_FIELDS`` — wall-clock/history/environment-derived,
+  stripped.
+
+Findings (``anovos_tpu/obs/manifest.py`` scope + gc017 fixtures):
+
+* a produced key in NEITHER tuple — unclassified: a future obs field
+  breaks byte-parity goldens silently;
+* a produced key in BOTH tuples — ambiguous classification;
+* a tuple element no manifest builder produces — stale classification
+  entry (the field was renamed/removed but the list still grandfathers
+  the old name);
+* a module that builds manifests with no classification tuples at all.
+
+Keys are collected from every dict literal returned by a ``build_*``
+function plus ``<name>["key"] = ...`` subscript-assignments inside it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from tools.graftcheck.registry import FileContext, Rule, register
+
+STABLE_LIST = "STABLE_TOP_FIELDS"
+VOLATILE_LIST = "_VOLATILE_TOP_FIELDS"
+
+
+def _tuple_elements(node: ast.AST) -> Optional[List[str]]:
+    """String elements of a tuple/list literal (None when not one)."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for el in node.elts:
+        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+            out.append(el.value)
+    return out
+
+
+def _classification_lists(tree: ast.Module) -> Dict[str, List[str]]:
+    out: Dict[str, List[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in (STABLE_LIST, VOLATILE_LIST):
+                    els = _tuple_elements(node.value)
+                    if els is not None:
+                        out[t.id] = els
+    return out
+
+
+def _builder_functions(tree: ast.Module) -> Iterable[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name.startswith("build_"):
+            yield node
+
+
+def _produced_keys(fn: ast.FunctionDef) -> Dict[str, ast.AST]:
+    """{manifest key: first AST node producing it} for one builder: string
+    keys of dict literals that are returned — directly (``return {...}``)
+    or through a returned local (``out = {...}; out["k"] = v; return
+    out``) — plus subscript string-assigns on those returned locals."""
+    returned_names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Name):
+            returned_names.add(node.value.id)
+    keys: Dict[str, ast.AST] = {}
+
+    def collect_dict(d: ast.Dict) -> None:
+        for k in d.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.setdefault(k.value, k)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Dict):
+            collect_dict(node.value)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Name) and t.id in returned_names
+                        and isinstance(node.value, ast.Dict)):
+                    collect_dict(node.value)
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id in returned_names
+                        and isinstance(t.slice, ast.Constant)
+                        and isinstance(t.slice.value, str)):
+                    keys.setdefault(t.slice.value, t)
+    return keys
+
+
+@register
+class ManifestClassificationRule(Rule):
+    id = "GC017"
+    title = "run-manifest field classification audit"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.endswith("anovos_tpu/obs/manifest.py") \
+            or relpath == "anovos_tpu/obs/manifest.py" \
+            or "gc017" in relpath
+
+    def check(self, ctx: FileContext) -> Iterable:
+        lists = _classification_lists(ctx.tree)
+        builders = list(_builder_functions(ctx.tree))
+        produced: Dict[str, ast.AST] = {}
+        for fn in builders:
+            for k, node in _produced_keys(fn).items():
+                produced.setdefault(k, node)
+        if not builders or not produced:
+            return  # not a manifest-builder module (empty fixture)
+        stable: Set[str] = set(lists.get(STABLE_LIST, []))
+        volatile: Set[str] = set(lists.get(VOLATILE_LIST, []))
+        if STABLE_LIST not in lists or VOLATILE_LIST not in lists:
+            missing = [n for n in (STABLE_LIST, VOLATILE_LIST) if n not in lists]
+            yield ctx.finding(
+                self.id, builders[0],
+                f"manifest builder with no classification tuple(s) "
+                f"{', '.join(missing)}: every produced key must be "
+                "committed as stable (survives stable_view) or volatile "
+                "(stripped), or byte-parity goldens break silently")
+            return
+        for key in sorted(produced):
+            node = produced[key]
+            in_s, in_v = key in stable, key in volatile
+            if in_s and in_v:
+                yield ctx.finding(
+                    self.id, node,
+                    f"manifest field {key!r} is in BOTH {STABLE_LIST} and "
+                    f"{VOLATILE_LIST} — ambiguous classification; pick one")
+            elif not in_s and not in_v:
+                yield ctx.finding(
+                    self.id, node,
+                    f"unclassified manifest field {key!r}: add it to "
+                    f"{STABLE_LIST} (pure run identity, byte-equal across "
+                    f"sequential re-runs) or {VOLATILE_LIST} (stripped by "
+                    "stable_view) — a silently-stable wall-clock field "
+                    "breaks byte-parity goldens only under load")
+        for name, els in sorted(lists.items()):
+            for el in els:
+                if el not in produced:
+                    # anchor stale entries on the list assignment itself
+                    anchor = next(
+                        (n for n in ast.walk(ctx.tree)
+                         if isinstance(n, ast.Assign)
+                         and any(isinstance(t, ast.Name) and t.id == name
+                                 for t in n.targets)), builders[0])
+                    yield ctx.finding(
+                        self.id, anchor,
+                        f"stale classification entry {el!r} in {name}: no "
+                        "manifest builder produces this key — remove it or "
+                        "restore the field")

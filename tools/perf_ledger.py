@@ -130,6 +130,29 @@ def _backend_class(backend: Optional[str]) -> str:
     return "accel"
 
 
+# per-node phase keys lifted from bench's e2e_node_summary into ledger
+# entries — the doctor's material for naming WHICH node regressed and its
+# dominant phase when a gate failure attaches a diagnosis
+_NODE_SUMMARY_KEYS = ("wall_s", "device_time_s", "dispatch_s",
+                      "transfer_s", "host_s")
+
+
+def _node_summary(parsed: dict) -> Optional[dict]:
+    raw = parsed.get("e2e_node_summary")
+    if not isinstance(raw, dict):
+        return None
+    out = {}
+    for name, rec in sorted(raw.items()):
+        if not isinstance(rec, dict):
+            continue
+        keep = {k: round(float(rec[k]), 6) for k in _NODE_SUMMARY_KEYS
+                if isinstance(rec.get(k), (int, float))
+                and not isinstance(rec.get(k), bool)}
+        if keep:
+            out[str(name)] = keep
+    return out or None
+
+
 def _entry_from_bench(parsed: dict, source: str, round_n: Optional[int]) -> dict:
     fields = {
         k: parsed[k] for k in TRACKED_FIELDS
@@ -147,6 +170,12 @@ def _entry_from_bench(parsed: dict, source: str, round_n: Optional[int]) -> dict
         "attested": bool(parsed.get("attested", False)),
         "fields": fields,
     }
+    nodes = _node_summary(parsed)
+    if nodes:
+        entry["nodes"] = nodes
+    # content id stays a function of (source, round, backend, fields) ONLY:
+    # the committed entries' ids must not move when the node summary or a
+    # diagnosis is attached alongside
     entry["id"] = hashlib.sha256(
         json.dumps({k: entry[k] for k in ("source", "round", "backend", "fields")},
                    sort_keys=True, separators=(",", ":")).encode()
@@ -272,12 +301,46 @@ def check(entries: List[dict], candidate: dict,
     return out
 
 
+def attach_diagnosis(entries: List[dict], cand: dict,
+                     regressions: List[dict]) -> List[str]:
+    """Perf-doctor hookup: a gate-flagged candidate gets a ``diagnosis``
+    object (anovos_tpu.obs.diffing ledger diff against the last clean
+    same-class entry) attached in place, and the top-3 attribution lines
+    are returned for bench to print instead of a bare field name.
+
+    Best-effort by contract: a broken doctor must never break the gate —
+    failures land as ``diagnosis_error`` on the entry, and [] returns."""
+    if not regressions:
+        return []
+    try:
+        from anovos_tpu.obs.diffing import diff_ledger_entries, render_text
+
+        cls = cand.get("backend_class", "unknown")
+        cand_fields = set(cand.get("fields") or {})
+        prior = [e for e in entries
+                 if e.get("id") != cand.get("id")
+                 and e.get("backend_class") == cls
+                 and not e.get("regressions")
+                 and cand_fields & set(e.get("fields") or {})]
+        if not prior:
+            return []
+        diag = diff_ledger_entries(prior[-1], cand,
+                                   flagged=[r["field"] for r in regressions])
+        cand["diagnosis"] = diag
+        return render_text(diag, top=3)
+    except Exception as e:
+        cand["diagnosis_error"] = str(e)[-200:]
+        return []
+
+
 def record_and_check(bench_result: dict,
                      path: Optional[str] = None) -> dict:
     """bench.py's hook: ingest committed rounds, append this run, gate it.
 
     Returns the fields bench merges into its JSON line.  Never raises —
-    bench's output contract survives a broken ledger."""
+    bench's output contract survives a broken ledger.  A flagged run's
+    ledger entry carries a full perf-doctor ``diagnosis`` and the return
+    carries the top-3 attribution lines (``ledger_attribution``)."""
     path = path or ledger_path()
     try:
         ingest_rounds(path=path)
@@ -286,6 +349,7 @@ def record_and_check(bench_result: dict,
         cand["t_unix"] = round(time.time(), 3)
         regressions = check(entries, cand)
         cand["regressions"] = [r["field"] for r in regressions]
+        attribution = attach_diagnosis(entries, cand, regressions)
         append_entries([cand], path)
         return {
             "ledger_ok": not regressions,
@@ -294,6 +358,7 @@ def record_and_check(bench_result: dict,
                 f"({r['worse_by']}% worse, band {int(r['band'] * 100)}%)"
                 for r in regressions
             ],
+            "ledger_attribution": attribution,
             "ledger_entries": len(entries) + 1,
             "ledger_path": path,
         }
@@ -302,31 +367,42 @@ def record_and_check(bench_result: dict,
 
 
 _SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+# explicit gap marker for an entry that does not carry the field: every
+# trend string has one glyph PER LEDGER ENTRY, so sparklines stay aligned
+# against run ids (silently skipping an entry shifted everything after it
+# left — the HTML ledger tab was misattributing values to rounds)
+GAP_MARK = "·"
 
 
 def field_trends(entries: List[dict]) -> List[dict]:
     """Per-tracked-field trajectory rows (the ONE source for the CLI trend
     text and the HTML report's ledger tab): ``{field, trend (unicode
-    sparkline), latest, min, max, n, better, noise_band}``, fields with
-    fewer than two data points omitted."""
+    sparkline, one glyph per ledger entry with ``·`` marking entries that
+    lack the field), latest, min, max, n, gaps, better, noise_band}``,
+    fields with fewer than two data points omitted."""
     rows: List[dict] = []
     for field in sorted({f for e in entries for f in (e.get("fields") or {})}):
         spec = TRACKED_FIELDS.get(field)
         if spec is None:
             continue
-        vals = [e["fields"][field] for e in entries
-                if isinstance(e.get("fields", {}).get(field), (int, float))
-                and not isinstance(e.get("fields", {}).get(field), bool)]
+        pts: List[Optional[float]] = []
+        for e in entries:
+            v = (e.get("fields") or {}).get(field)
+            pts.append(float(v) if isinstance(v, (int, float))
+                       and not isinstance(v, bool) else None)
+        vals = [v for v in pts if v is not None]
         if len(vals) < 2:
             continue
         lo, hi = min(vals), max(vals)
         span = (hi - lo) or 1.0
         spark = "".join(
-            _SPARK_BLOCKS[int((v - lo) / span * (len(_SPARK_BLOCKS) - 1))]
-            for v in vals)
+            GAP_MARK if v is None
+            else _SPARK_BLOCKS[int((v - lo) / span * (len(_SPARK_BLOCKS) - 1))]
+            for v in pts)
         direction, band = spec
         rows.append({"field": field, "trend": spark, "latest": vals[-1],
                      "min": lo, "max": hi, "n": len(vals),
+                     "gaps": len(pts) - len(vals),
                      "better": direction, "noise_band": f"{int(band * 100)}%"})
     return rows
 
@@ -370,9 +446,12 @@ def main(argv=None) -> int:
         # mark the entry with its own gate verdict BEFORE appending — like
         # record_and_check does — so a regressing candidate is excluded
         # from future baselines instead of normalizing the regression away
-        candidate["regressions"] = [
-            r["field"] for r in check(entries + [candidate], candidate,
-                                      window=ns.window)]
+        cand_regressions = check(entries + [candidate], candidate,
+                                 window=ns.window)
+        candidate["regressions"] = [r["field"] for r in cand_regressions]
+        # a flagged candidate carries its perf-doctor diagnosis in the
+        # ledger itself (same contract as the bench hook)
+        attach_diagnosis(entries, candidate, cand_regressions)
         append_entries([candidate], path)
         entries = load(path)
         result["entries"] = len(entries)
@@ -390,6 +469,16 @@ def main(argv=None) -> int:
             result["regressions"] = regressions
             result["ok"] = not regressions
             rc = 1 if regressions else 0
+            if regressions and "diagnosis" not in candidate:
+                attach_diagnosis(entries, candidate, regressions)
+            if candidate.get("diagnosis") is not None:
+                try:
+                    from anovos_tpu.obs.diffing import render_text
+
+                    result["attribution"] = render_text(
+                        candidate["diagnosis"], top=3)
+                except Exception:
+                    pass  # the gate verdict stands without the doctor
     if ns.json:
         print(json.dumps(result, sort_keys=True))
     else:
@@ -406,6 +495,8 @@ def main(argv=None) -> int:
                 print(f"perf_ledger: REGRESSION {r['field']}: {r['value']} vs "
                       f"baseline {r['baseline']} ({r['worse_by']}% worse, "
                       f"band {int(r['band'] * 100)}%)", file=sys.stderr)
+            for line in result.get("attribution") or []:
+                print(f"perf_ledger: diagnosis {line}", file=sys.stderr)
     return rc
 
 
