@@ -14,7 +14,10 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from anovos_tpu.obs import timed
 
+
+@timed("ops.knn_impute_tile")
 @functools.partial(jax.jit, static_argnames=("n_neighbors",))
 def knn_impute_tile(
     Xq: jax.Array,

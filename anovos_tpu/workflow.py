@@ -797,9 +797,13 @@ def main(
                             res_policy.record_degraded(
                                 "timeseries_analyzer/inspection",
                                 f"{type(e).__name__}: {e}")
+                    # placement: the inspection body reaches ts_analyzer's
+                    # column_parallel sharding constraints — a collective
+                    # dispatch, so the node must ride the rendezvous lane
+                    # (graftcheck GC011, whole-program closure)
                     pipe.fanout("timeseries_analyzer/inspection", _ts_inspect,
                                 writes=("report:ts_inspection",), timed="timeseries_analyzer",
-                                placement="device",
+                                placement="mesh",
                                 cache_slice={"timeseries_analyzer": opt, "mode": "inspect"})
                 continue
 
@@ -1220,10 +1224,15 @@ def main(
                             charts_to_objects(df, **value, **extra_args, master_path=report_input_path,
                                               run_type=run_type, auth_key=auth_key,
                                               async_writer=writer, async_key="charts:objects")
+                        # placement: charts_to_objects reaches column_parallel
+                        # sharding constraints through the stats helpers — a
+                        # collective dispatch, so the node must ride the
+                        # rendezvous lane (graftcheck GC011, whole-program
+                        # closure)
                         pipe.fanout(f"report_preprocessing/{subkey}", _charts,
                                     reads=chart_reads, writes=("charts:objects",),
                                     timed=f"{key}, {subkey}",
-                                    placement="device",
+                                    placement="mesh",
                                     cache_slice={"charts_to_objects": value})
 
             if key == "report_generation" and args is not None:

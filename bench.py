@@ -478,7 +478,52 @@ def e2e_cold_warm() -> dict:
             result.update(e2e_continuum())
         except Exception as e:  # continuum section must never sink the headline
             result["e2e_continuum_error"] = str(e)[-200:]
+    if os.environ.get("BENCH_GRAFTCHECK", "1") == "1":
+        try:
+            result.update(e2e_graftcheck())
+        except Exception as e:  # analysis section must never sink the headline
+            result["e2e_graftcheck_error"] = str(e)[-200:]
     return result
+
+
+def e2e_graftcheck() -> dict:
+    """Static-analysis trajectory (graftcheck engine v2): a COLD whole-
+    program scan of anovos_tpu/ in a fresh subprocess populating a temp
+    incremental cache, then a WARM re-scan against that cache (nothing
+    changed, so every file is cache-served).  The warm wall is the cost
+    every tier-1 run and pre-commit hook actually pays once the cache is
+    in place — it rides the perf ledger (``e2e_graftcheck_incr_s``); a
+    divergent warm output or a warm scan that re-analyzes files is
+    reported loudly as ``e2e_graftcheck_error``."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as d:
+        cache = os.path.join(d, "gc_cache.json")
+        args = [sys.executable, "-m", "tools.graftcheck", "anovos_tpu",
+                "--no-baseline", "--json", "--cache", cache]
+        walls = {}
+        stdouts = {}
+        for label in ("cold", "incr"):
+            t0 = time.perf_counter()
+            p = subprocess.run(args, capture_output=True, text=True,
+                               cwd=here, timeout=600)
+            walls[label] = round(time.perf_counter() - t0, 3)
+            stdouts[label] = p.stdout
+        out["e2e_graftcheck_cold_s"] = walls["cold"]
+        out["e2e_graftcheck_incr_s"] = walls["incr"]
+        try:
+            out["e2e_graftcheck_findings"] = len(json.loads(stdouts["incr"]))
+        except ValueError:
+            out["e2e_graftcheck_error"] = "scan produced no finding JSON"
+            print("bench: " + out["e2e_graftcheck_error"], file=sys.stderr)
+            return out
+        if stdouts["cold"] != stdouts["incr"]:
+            out["e2e_graftcheck_error"] = (
+                "warm incremental scan output diverged from cold scan")
+            print("bench: " + out["e2e_graftcheck_error"], file=sys.stderr)
+    return out
 
 
 def e2e_doctor(cold_man: dict, warm_man: dict) -> dict:

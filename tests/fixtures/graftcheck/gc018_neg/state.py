@@ -1,0 +1,11 @@
+"""GC018 negative fixture — owning module, identical to the positive one."""
+
+import threading
+
+_REGISTRY = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def record(key, value):
+    with _REGISTRY_LOCK:
+        _REGISTRY[key] = value

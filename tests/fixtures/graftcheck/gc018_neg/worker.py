@@ -1,0 +1,24 @@
+"""GC018 negative fixture — cross-module writes that respect the lock.
+
+``sweep`` takes the owner's lock at the mutation site; ``_flush`` mutates
+without a lock in scope but is ONLY reachable through ``drain``'s locked
+call site, so the whole-program path analysis must sanction it and stay
+quiet.
+"""
+
+from . import state
+
+
+def sweep(keys):
+    with state._REGISTRY_LOCK:
+        for k in keys:
+            state._REGISTRY[k] = None
+
+
+def _flush():
+    state._REGISTRY["flushed"] = True
+
+
+def drain():
+    with state._REGISTRY_LOCK:
+        _flush()

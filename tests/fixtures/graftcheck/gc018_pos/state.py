@@ -1,0 +1,22 @@
+"""GC018 positive fixture — owning module: a lock-disciplined registry.
+
+``_REGISTRY`` is mutable module state whose owner mutates it exclusively
+under ``_REGISTRY_LOCK`` — the global is lock-DISCIPLINED.  The sibling
+``worker`` module mutates it cross-module on unlocked paths, which is the
+violation GC018 exists for.
+"""
+
+import threading
+
+_REGISTRY = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def record(key, value):
+    with _REGISTRY_LOCK:
+        _REGISTRY[key] = value
+
+
+def snapshot():
+    with _REGISTRY_LOCK:
+        return dict(_REGISTRY)

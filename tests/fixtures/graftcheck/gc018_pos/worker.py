@@ -1,0 +1,18 @@
+"""GC018 positive fixture — offending module: unlocked cross-module writes.
+
+Both functions are call-graph roots (nothing calls them), so every path in
+is unlocked; ``state`` guards ``_REGISTRY`` with a lock, making each write
+below a cross-module race against the owner's locked mutators.
+"""
+
+from . import state
+from .state import _REGISTRY
+
+
+def sweep(keys):
+    for k in keys:
+        state._REGISTRY[k] = None  # chain write, no lock held
+
+
+def evict(key):
+    _REGISTRY.pop(key, None)  # mutator call on the imported name, no lock

@@ -1,0 +1,21 @@
+"""GC019 negative fixture — every ``_``-closure in the registering scope is
+registered, called, or referenced by name; nothing is dead."""
+
+
+def build(pipe, cfg):
+    def _live(df):
+        return df
+
+    def _helper(df):
+        return df * cfg["scale"]
+
+    def _wrapped(df):
+        return _helper(df)
+
+    def _stored(df):
+        return df
+
+    handlers = {"stored": _stored}  # referenced by name, never called here
+    pipe.spine("analysis/live", _live, placement="host")
+    pipe.aside("analysis/wrapped", _wrapped, placement="host")
+    return handlers

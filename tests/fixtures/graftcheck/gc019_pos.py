@@ -1,0 +1,16 @@
+"""GC019 positive fixture — dead node bodies left behind in a registering
+scope: ``_dead`` and ``_also_dead`` parse fine, look like live pipeline
+code, and silently never run."""
+
+
+def build(pipe, cfg):
+    def _live(df):
+        return df
+
+    def _dead(df):
+        return df + cfg["offset"]
+
+    def _also_dead(df):
+        return df * cfg["scale"]
+
+    pipe.spine("analysis/live", _live, placement="host")

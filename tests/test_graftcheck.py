@@ -27,7 +27,15 @@ FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftcheck")
 PKG = os.path.join(REPO, "anovos_tpu")
 RULE_IDS = ["GC001", "GC002", "GC003", "GC004", "GC005", "GC006", "GC007",
             "GC008", "GC009", "GC010", "GC011", "GC012", "GC013", "GC014",
-            "GC015", "GC016", "GC017"]
+            "GC015", "GC016", "GC017", "GC018", "GC019"]
+
+
+def fixture_path(rule_id, kind):
+    """Single-file fixture (``gc0xx_pos.py``) or, for the cross-module
+    rules, a package directory (``gc0xx_pos/``) of sibling modules."""
+    single = os.path.join(FIXTURES, f"{rule_id.lower()}_{kind}.py")
+    return single if os.path.exists(single) else \
+        os.path.join(FIXTURES, f"{rule_id.lower()}_{kind}")
 
 
 # -- the gate: repo scan is clean against the committed baseline ----------
@@ -78,14 +86,14 @@ def test_gc006_zero_undeclared_writes_in_workflow():
 
 @pytest.mark.parametrize("rule_id", RULE_IDS)
 def test_rule_fires_on_positive_fixture(rule_id):
-    path = os.path.join(FIXTURES, f"{rule_id.lower()}_pos.py")
+    path = fixture_path(rule_id, "pos")
     hits = [f for f in scan([path]) if f.rule == rule_id]
     assert hits, f"{rule_id} found nothing in its positive fixture"
 
 
 @pytest.mark.parametrize("rule_id", RULE_IDS)
 def test_rule_quiet_on_negative_fixture(rule_id):
-    path = os.path.join(FIXTURES, f"{rule_id.lower()}_neg.py")
+    path = fixture_path(rule_id, "neg")
     hits = [f for f in scan([path]) if f.rule == rule_id]
     assert not hits, "\n".join(f.render() for f in hits)
 
@@ -109,7 +117,7 @@ def test_fixtures_have_no_cross_rule_noise():
     (keeps fixture failures attributable)."""
     for rule_id in RULE_IDS:
         for kind in ("pos", "neg"):
-            path = os.path.join(FIXTURES, f"{rule_id.lower()}_{kind}.py")
+            path = fixture_path(rule_id, kind)
             other = [f for f in scan([path]) if f.rule != rule_id]
             assert not other, "\n".join(f.render() for f in other)
 
@@ -120,9 +128,9 @@ def test_expected_positive_counts():
     expected = {"GC001": 5, "GC002": 4, "GC003": 6, "GC004": 3,
                 "GC005": 4, "GC006": 4, "GC007": 2, "GC008": 4, "GC009": 4,
                 "GC010": 4, "GC011": 5, "GC012": 4, "GC013": 4, "GC014": 4,
-                "GC015": 2, "GC016": 4, "GC017": 5}
+                "GC015": 2, "GC016": 4, "GC017": 5, "GC018": 2, "GC019": 2}
     for rule_id, n in expected.items():
-        path = os.path.join(FIXTURES, f"{rule_id.lower()}_pos.py")
+        path = fixture_path(rule_id, "pos")
         hits = [f for f in scan([path]) if f.rule == rule_id]
         assert len(hits) == n, (rule_id, [f.render() for f in hits])
 

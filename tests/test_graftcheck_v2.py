@@ -1,0 +1,372 @@
+"""Tier-1 wiring for the graftcheck v2 engine: whole-program call-graph
+edge cases (decorator stacks, partial-wrapped bodies, self-method
+resolution, lambda registrations, cycles), the fresh-subprocess
+determinism gate (byte-identical double scan, cold vs warm incremental
+cache), the incremental reverse-dependency cone, SARIF 2.1.0 output,
+the typed env-knob inventory (pinned against the README table), and the
+stale-suppression fixer."""
+
+import ast
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graftcheck import engine, scan  # noqa: E402
+from tools.graftcheck.callgraph import Program, summarize_module  # noqa: E402
+
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftcheck")
+PKG = os.path.join(REPO, "anovos_tpu")
+
+
+def prog(files):
+    """Build a whole-program model from {relpath: source} (no filesystem)."""
+    return Program({rel: summarize_module(rel, ast.parse(textwrap.dedent(src)))
+                    for rel, src in files.items()})
+
+
+# -- call-graph edge cases -------------------------------------------------
+
+def test_decorator_stack_jit_plus_timed():
+    p = prog({"pkg/ops.py": """
+        import functools
+        import jax
+        from anovos_tpu.obs import timed
+
+        @timed("ops.kernel")
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def kernel(x, n=2):
+            return x * n
+        """})
+    fn = p.fns["pkg/ops.py::kernel"]
+    assert fn["jit"] and fn["attributed"]
+    assert "pkg/ops.py::kernel" in p.attributed
+
+
+def test_partial_wrapped_registration_body():
+    p = prog({"pkg/wf.py": """
+        import functools
+
+        def _body(df, k):
+            return df
+
+        def build(sched):
+            sched.add("n/partial", functools.partial(_body, k=1),
+                      writes=("stats:x",))
+        """})
+    assert ("n/partial", "pkg/wf.py::_body") in p.entry_regs
+    assert "pkg/wf.py::_body" in p.node_reachable
+
+
+def test_self_method_resolution():
+    p = prog({"pkg/cls.py": """
+        class Runner:
+            def run(self, x):
+                return self._step(x)
+
+            def _step(self, x):
+                return x
+        """})
+    tos = [e["to"] for e in p.edges["pkg/cls.py::Runner.run"]]
+    assert "pkg/cls.py::Runner._step" in tos
+
+
+def test_lambda_registration_edges():
+    p = prog({"pkg/lam.py": """
+        def _helper(df):
+            return df
+
+        def build(pipe):
+            pipe.spine("n/lam", lambda df: _helper(df), writes=("stats:x",))
+        """})
+    lambda_bodies = [b for _n, b in p.entry_regs if "<lambda" in b]
+    assert lambda_bodies, p.entry_regs
+    # the lambda's call edge reaches the helper, so the helper is on a node path
+    assert "pkg/lam.py::_helper" in p.node_reachable
+
+
+def test_call_cycle_terminates_and_propagates():
+    p = prog({"pkg/cyc.py": """
+        def a(n):
+            return b(n - 1) if n else 0
+
+        def b(n):
+            return a(n - 1) if n else 1
+
+        def build(sched):
+            sched.add("n/cycle", a, writes=("stats:x",))
+        """})
+    assert "pkg/cyc.py::a" in p.node_reachable
+    assert "pkg/cyc.py::b" in p.node_reachable
+
+
+def test_cross_module_import_resolution_and_device_view():
+    p = prog({
+        "pkg/m1.py": """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                return x
+            """,
+        "pkg/m2.py": """
+            from pkg.m1 import kernel
+
+            def run(x):
+                return kernel(x)
+            """,
+    })
+    tos = [e["to"] for e in p.edges["pkg/m2.py::run"]]
+    assert "pkg/m1.py::kernel" in tos
+    assert "pkg/m2.py::run" in p.device_returning  # wrapper chain fixpoint
+    assert "kernel" in p.view("pkg/m2.py")["device_names"]
+
+
+# -- incremental cache: reverse-dependency cone ----------------------------
+
+def test_incremental_rescan_limits_to_reverse_dep_cone(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m1.py").write_text(
+        "import jax\n\n\n@jax.jit\ndef kernel(x):\n    return x\n")
+    (pkg / "m2.py").write_text(
+        "from .m1 import kernel\n\n\ndef run(x):\n    return kernel(x)\n")
+    (pkg / "m3.py").write_text("def other(x):\n    return x\n")
+    cache = str(tmp_path / "gc_cache.json")
+
+    r1 = engine.scan_detail([str(pkg)], cache_path=cache)
+    assert r1.files_reanalyzed == 3
+    r2 = engine.scan_detail([str(pkg)], cache_path=cache)
+    assert r2.files_reanalyzed == 0  # nothing changed: fully cache-served
+    assert [f.__dict__ for f in r2.findings] == [f.__dict__ for f in r1.findings]
+
+    # a local-only edit re-analyzes exactly that file
+    (pkg / "m3.py").write_text("def other(x):\n    return x + 0\n")
+    r3 = engine.scan_detail([str(pkg)], cache_path=cache)
+    assert r3.files_reanalyzed == 1
+
+    # un-jitting m1.kernel flips m2's view (imported device name gone):
+    # the cone is {m1, m2}; m3 must stay cache-served
+    (pkg / "m1.py").write_text("def kernel(x):\n    return x\n")
+    r4 = engine.scan_detail([str(pkg)], cache_path=cache)
+    assert r4.files_reanalyzed == 2
+    cold = engine.scan_detail([str(pkg)])
+    assert [f.__dict__ for f in r4.findings] == [f.__dict__ for f in cold.findings]
+
+
+# -- fresh-subprocess determinism gate ------------------------------------
+
+def _cli(args, **kw):
+    return subprocess.run([sys.executable, "-m", "tools.graftcheck"] + args,
+                          cwd=REPO, capture_output=True, timeout=300, **kw)
+
+
+@pytest.mark.slow
+def test_double_scan_byte_identical_cold_warm_cache(tmp_path):
+    """Four fresh subprocesses over anovos_tpu/: two cache-less scans, one
+    cold-cache scan, one warm-cache scan — all four stdouts byte-identical
+    (the full pre-baseline finding list, the strongest possible output)."""
+    base = ["anovos_tpu", "--no-baseline", "--json"]
+    cache = str(tmp_path / "gc_cache.json")
+    a = _cli(base)
+    b = _cli(base)
+    cold = _cli(base + ["--cache", cache])
+    assert os.path.exists(cache)
+    warm = _cli(base + ["--cache", cache])
+    assert a.stdout and a.stdout == b.stdout == cold.stdout == warm.stdout, (
+        a.stderr, b.stderr, cold.stderr, warm.stderr)
+
+
+def test_sarif_serialization_deterministic():
+    findings = scan([os.path.join(FIXTURES, "gc003_pos.py")])
+    from tools.graftcheck import sarif
+
+    a = json.dumps(sarif.to_sarif(findings), sort_keys=True)
+    b = json.dumps(sarif.to_sarif(scan([os.path.join(FIXTURES, "gc003_pos.py")])),
+                   sort_keys=True)
+    assert a == b
+
+
+# -- SARIF 2.1.0 -----------------------------------------------------------
+
+SARIF_SUBSET_SCHEMA = {
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "version": {"const": "2.1.0"},
+        "$schema": {"type": "string"},
+        "runs": {"type": "array", "minItems": 1, "items": {
+            "type": "object", "required": ["tool", "results"],
+            "properties": {
+                "tool": {"type": "object", "required": ["driver"], "properties": {
+                    "driver": {
+                        "type": "object", "required": ["name", "rules"],
+                        "properties": {
+                            "name": {"type": "string"},
+                            "rules": {"type": "array", "items": {
+                                "type": "object",
+                                "required": ["id", "shortDescription"],
+                                "properties": {
+                                    "id": {"type": "string"},
+                                    "shortDescription": {
+                                        "type": "object", "required": ["text"],
+                                        "properties": {"text": {"type": "string"}},
+                                    }}}}}}}},
+                "results": {"type": "array", "items": {
+                    "type": "object",
+                    "required": ["ruleId", "ruleIndex", "level", "message",
+                                 "locations"],
+                    "properties": {
+                        "ruleId": {"type": "string"},
+                        "ruleIndex": {"type": "integer", "minimum": 0},
+                        "level": {"enum": ["none", "note", "warning", "error"]},
+                        "message": {"type": "object", "required": ["text"],
+                                    "properties": {"text": {"type": "string"}}},
+                        "locations": {"type": "array", "minItems": 1, "items": {
+                            "type": "object", "required": ["physicalLocation"],
+                            "properties": {"physicalLocation": {
+                                "type": "object",
+                                "required": ["artifactLocation", "region"],
+                                "properties": {
+                                    "artifactLocation": {
+                                        "type": "object", "required": ["uri"],
+                                        "properties": {"uri": {"type": "string"}},
+                                    },
+                                    "region": {
+                                        "type": "object",
+                                        "required": ["startLine"],
+                                        "properties": {"startLine": {
+                                            "type": "integer", "minimum": 1}},
+                                    }}}}}},
+                        "suppressions": {"type": "array", "items": {
+                            "type": "object", "required": ["kind"],
+                            "properties": {
+                                "kind": {"enum": ["inSource", "external"]},
+                                "justification": {"type": "string"},
+                            }}},
+                    }}}}}},
+    },
+}
+
+
+def test_sarif_schema_valid_with_baseline_suppressions():
+    jsonschema = pytest.importorskip("jsonschema")
+    from tools.graftcheck import sarif
+
+    findings = scan([os.path.join(FIXTURES, "gc003_pos.py")])
+    assert findings
+    f0 = findings[0]
+    entries = [{"rule": f0.rule, "path": f0.path, "symbol": f0.symbol,
+                "message": f0.message, "count": 1, "justification": "test debt"}]
+    doc = sarif.to_sarif(findings, entries)
+    jsonschema.validate(doc, SARIF_SUBSET_SCHEMA)
+    run = doc["runs"][0]
+    ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert len(ids) == len(set(ids))
+    assert len(run["results"]) == len(findings)
+    for res in run["results"]:
+        assert ids[res["ruleIndex"]] == res["ruleId"]
+    suppressed = [r for r in run["results"] if r.get("suppressions")]
+    assert len(suppressed) == 1
+    assert suppressed[0]["suppressions"][0]["justification"] == "test debt"
+
+
+def test_sarif_cli_smoke():
+    proc = _cli([os.path.join("tests", "fixtures", "graftcheck", "gc003_pos.py"),
+                 "--no-baseline", "--format", "sarif"], text=True)
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"]
+
+
+# -- env-knob inventory ----------------------------------------------------
+
+def test_knob_inventory_typed_and_clean():
+    inv = engine.knob_inventory()
+    classes = {e["class"] for e in inv}
+    assert classes <= {"fingerprinted", "exempt", "off-node", "unaudited",
+                       "dynamic"}
+    # the acceptance contract GC008 enforces, restated over the inventory:
+    # no node-reachable read of an unaudited or dynamically-named knob
+    assert not [e for e in inv if e["class"] == "unaudited"]
+    assert not [e for e in inv
+                if e["class"] == "dynamic" and e["node_reachable_reads"]]
+    for e in inv:
+        assert (e["class"] == "exempt") == bool(e["justification"]), e
+        assert len(e["sites"]) == e["reads"]
+
+
+def test_readme_knob_rows_match_inventory():
+    """The audited rows of the README's env-knob table mirror the live
+    fingerprint lists exactly — knob set, class, and justification text."""
+    from tools.graftcheck.rules.gc008_cache_key import (
+        exempt_env_knobs, known_env_knobs)
+
+    with open(os.path.join(REPO, "tools", "graftcheck", "README.md"),
+              encoding="utf-8") as f:
+        text = f.read()
+    section = text.split("## Env-knob inventory", 1)[1].split("\n## ", 1)[0]
+    rows = re.findall(r"^\| `([A-Z0-9_]+)` \| (\S+) \| (.*) \|$", section, re.M)
+    assert rows, "README env-knob table is missing or malformed"
+    got = {(k, c) for k, c, _ in rows}
+    want = ({(k, "fingerprinted") for k in known_env_knobs()}
+            | {(k, "exempt") for k in exempt_env_knobs()})
+    assert got == want, (sorted(got - want), sorted(want - got))
+    assert {k: j for k, c, j in rows if c == "exempt"} == exempt_env_knobs()
+
+
+# -- stale-suppression fixer ----------------------------------------------
+
+def test_fix_stale_suppressions_rewrites_sources(tmp_path):
+    src = (
+        "import jax\n"
+        "\n"
+        "\n"
+        "def per_call(fn, x):\n"
+        "    y = x + 1  # graftcheck: disable=GC003\n"
+        "    j = jax.jit(fn)  # graftcheck: disable=GC003\n"
+        "    return j(y)\n"
+    )
+    p = tmp_path / "stale.py"
+    p.write_text(src)
+    result = engine.scan_detail([str(p)])
+    assert [s.line for s in result.stale_suppressions] == [5]
+    touched = engine.fix_stale_suppressions(result.stale_suppressions,
+                                            root=str(tmp_path))
+    assert touched
+    fixed = p.read_text()
+    assert fixed.count("graftcheck: disable") == 1  # live one kept
+    assert "y = x + 1\n" in fixed  # stale token gone, code intact
+    rescan = engine.scan_detail([str(p)])
+    assert not rescan.stale_suppressions
+
+
+def test_suppression_text_in_docstring_is_not_a_suppression(tmp_path):
+    # Rule docs quote the suppression syntax verbatim; a string occurrence
+    # must neither suppress a finding nor be reported as a stale token.
+    src = (
+        '"""Docs: silence with ``# graftcheck: disable=GC012`` on the line."""\n'
+        "import jax\n"
+        "\n"
+        "\n"
+        "def per_call(fn, x):\n"
+        "    note = 'also not live: # graftcheck: disable=GC003'\n"
+        "    return jax.jit(fn)(x)  # graftcheck: disable=GC003\n"
+    )
+    p = tmp_path / "doc.py"
+    p.write_text(src)
+    result = engine.scan_detail([str(p)])
+    assert not result.stale_suppressions
+    assert not [f for f in result.findings if f.rule == "GC003"]
+    # and fix-stale must never rewrite a docstring occurrence
+    fake = [engine.StaleSuppression(os.path.basename(p), 1, "GC012")]
+    assert engine.fix_stale_suppressions(fake, root=str(tmp_path)) == []
+    assert p.read_text() == src

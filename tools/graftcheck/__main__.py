@@ -38,12 +38,34 @@ def main(argv=None) -> int:
                     help="write the current findings as baseline template entries "
                          "(justifications left blank — fill them in before committing)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable finding list on stdout")
+                    help="shorthand for --format json")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text",
+                    help="report format: human text (default), raw finding "
+                         "JSON, or SARIF 2.1.0 (baselined findings carry "
+                         "SARIF suppressions with their justifications)")
     ap.add_argument("--list-rules", action="store_true", help="print the rule catalogue")
     ap.add_argument("--emit-metrics", action="store_true",
                     help="book graftcheck_findings_total{rule=...} into the "
                          "anovos_tpu.obs metrics registry (used by the test gate)")
+    ap.add_argument("--incremental", action="store_true",
+                    help="persist per-file summaries + findings keyed by "
+                         "content hash and an engine-source salt "
+                         "(tools/graftcheck/.gc_cache.json); re-scans "
+                         "re-analyze only changed files plus their "
+                         "reverse-dependency cone")
+    ap.add_argument("--cache", default=None, metavar="PATH",
+                    help="incremental cache file (implies --incremental)")
+    ap.add_argument("--fix-stale", action="store_true",
+                    help="rewrite sources deleting stale "
+                         "'# graftcheck: disable=...' tokens, then report")
+    ap.add_argument("--knobs", action="store_true",
+                    help="print the typed env-knob inventory (fingerprinted / "
+                         "exempt / unaudited / dynamic, with whole-program "
+                         "read sites) and exit")
     args = ap.parse_args(argv)
+    if args.as_json:
+        args.format = "json"
 
     if args.list_rules:
         for rule in all_rules():
@@ -52,6 +74,40 @@ def main(argv=None) -> int:
 
     paths = args.paths or [os.path.join(engine.ROOT, "anovos_tpu")]
     baseline = None if args.no_baseline else args.baseline
+    cache_path = args.cache or (engine.CACHE_PATH if args.incremental else None)
+
+    if args.knobs:
+        inventory = engine.knob_inventory(paths if args.paths else None)
+        if args.format == "json":
+            print(json.dumps(inventory, indent=1, sort_keys=True))
+            return 0
+        counts = {}
+        for e in inventory:
+            reach = (f"{e['node_reachable_reads']}/{e['reads']} node-reachable"
+                     if e["reads"] else "no observed reads")
+            line = f"{e['knob']:36s} {e['class']:13s} {reach}"
+            if e["justification"]:
+                line += f" — {e['justification']}"
+            print(line)
+            counts[e["class"]] = counts.get(e["class"], 0) + 1
+        bad = counts.get("unaudited", 0) + sum(
+            1 for e in inventory
+            if e["class"] == "dynamic" and e["node_reachable_reads"])
+        print(f"{len(inventory)} knob(s): "
+              + ", ".join(f"{counts.get(c, 0)} {c}" for c in
+                          ("fingerprinted", "exempt", "off-node",
+                           "unaudited", "dynamic")))
+        return 1 if bad else 0
+
+    if args.fix_stale:
+        result = engine.scan_detail(paths)
+        touched = engine.fix_stale_suppressions(result.stale_suppressions)
+        for rel in touched:
+            print(f"fixed stale suppression(s) in {rel}")
+        if not touched:
+            print("no stale suppressions")
+            return 0
+        # fall through to a fresh scan of the cleaned sources
 
     if args.write_baseline:
         findings = engine.scan(paths)
@@ -65,9 +121,16 @@ def main(argv=None) -> int:
         return 0
 
     code, report, findings = engine.run(paths, baseline_path=baseline,
-                                        emit_metrics=args.emit_metrics)
-    if args.as_json:
+                                        emit_metrics=args.emit_metrics,
+                                        cache_path=cache_path)
+    if args.format == "json":
         print(json.dumps([f.__dict__ for f in findings], indent=1, sort_keys=True))
+    elif args.format == "sarif":
+        from tools.graftcheck import sarif
+
+        entries = engine.load_baseline(baseline) if baseline else []
+        print(json.dumps(sarif.to_sarif(findings, entries),
+                         indent=1, sort_keys=True))
     else:
         print(report)
     return code

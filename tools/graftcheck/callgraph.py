@@ -1,0 +1,1274 @@
+"""graftcheck engine v2: whole-program call graph + interprocedural facts.
+
+One scan builds ONE program model over every file in the scan set:
+
+1. **Module summaries** (`summarize_module`) — a JSON-serializable digest
+   of one parsed file: imports (absolute and relative, aliased), function
+   defs with qualnames matching :class:`FileContext.qualname`, class
+   method tables with base-class chains, module-level callable aliases
+   (``X = jax.jit(f)`` / ``functools.partial(f, …)`` / ``lru_cache()(f)``
+   / plain ``g = f``), scheduler registration edges
+   (``pipe.spine``/``fanout``/``aside``/``sched.add(body=…)`` — including
+   lambda bodies and ``partial``-wrapped bodies), and per-function leaf
+   facts (env reads with their literal defaults, collective dispatches,
+   unguarded I/O, part decodes, host syncs, device dispatch evidence,
+   lock-annotated call sites, mutable-global mutations).  Summaries are
+   what the incremental cache stores — an unchanged file is never
+   re-parsed.
+
+2. **Resolution** (:class:`Program`) — call sites resolve to function ids
+   (``relpath::qualname``) through lexical scope (nested defs outward),
+   module-level defs and aliases, import aliases (following
+   ``from m import f as g`` and ``import m as n`` chains), ``self.``/
+   ``cls.`` method lookup through the local class hierarchy, and
+   decorator/`partial`/`lru_cache` unwrapping.  Unresolvable calls are
+   tracked per function: chains into a known-host allowlist (``np.``,
+   ``math.``, ``os.``, …) keep a body "resolvable" for GC011's stale
+   check; anything else makes it opaque.
+
+3. **Transitive facts** — deterministic fixpoints over the graph:
+   node-reachability from scheduler registrations (GC008/GC012), the
+   streaming-consumer cone (GC014, stopping at the sanctioned prefetch
+   boundary), the attribution closure (GC010/GC013: ``@timed``/
+   ``dispatch_bracket`` coverage flows down real call edges, cross-module),
+   device-returning functions (GC001 taint seeds, wrapper chains
+   included), transitive collective reach + body resolvability (GC011),
+   lock-discipline (GC018: an unlocked mutation site is sanctioned only
+   when every call path into it traverses a lock), and dead node-body
+   detection (GC019).
+
+4. **Per-file views** (:meth:`Program.view`) — exactly the program-derived
+   facts the rules for that file consume, as a canonical-JSON dict.  The
+   view digest doubles as the incremental-scan invalidation key: a file
+   needs re-analysis iff its own content hash changed OR its view digest
+   changed (cross-file influence is, by construction, visible only
+   through the view).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftcheck.jaxmodel import (
+    TaintAnalysis, attr_chain, call_chain, is_jit_decorator, walk_function,
+)
+
+__all__ = [
+    "SUMMARY_VERSION", "module_name", "summarize_module", "Program",
+    "view_digest", "is_collective_call", "io_flagged", "decode_flagged",
+    "COLLECTIVE_TAILS", "HOST_BUILTINS", "SAFE_CHAIN_ROOTS",
+    "STREAM_BARRIERS", "REGISTRAR_ATTRS", "REG_KWARGS",
+]
+
+SUMMARY_VERSION = 1
+
+REGISTRAR_ATTRS = {"spine", "fanout", "aside", "add"}
+REG_KWARGS = {"reads", "writes", "placement", "on_error", "cache", "timed",
+              "cache_slice", "body"}
+
+# call-chain tails that prove a cross-device collective dispatch (GC011)
+COLLECTIVE_TAILS = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "shard_map", "pmap", "xmap", "with_sharding_constraint",
+    "column_parallel", "row_sharded", "replicated", "masked_moments_shmap",
+}
+
+# builtins whose calls never dispatch device work
+HOST_BUILTINS = {
+    "open", "len", "str", "int", "float", "bool", "sorted", "list", "dict",
+    "tuple", "set", "range", "enumerate", "zip", "min", "max", "sum", "abs",
+    "isinstance", "issubclass", "getattr", "setattr", "hasattr", "round",
+    "repr", "format", "print", "type", "id", "iter", "next", "vars", "map",
+    "filter", "any", "all", "hash", "callable", "divmod", "ord", "chr",
+    "super", "frozenset", "bytes", "bytearray", "memoryview", "slice",
+    "reversed", "staticmethod", "classmethod", "property", "ValueError",
+    "TypeError", "KeyError", "RuntimeError", "OSError", "IOError",
+    "NotImplementedError", "StopIteration", "Exception", "AttributeError",
+    "IndexError", "ZeroDivisionError", "FileNotFoundError",
+}
+
+# dotted-chain roots that are provably host-side (keep a GC011 body
+# "resolvable" without an in-repo target).  jnp/lax/jax chains stay OPAQUE:
+# on sharded inputs they can lower to implicit collectives, so absence of
+# collectives is not provable through them.
+SAFE_CHAIN_ROOTS = {
+    "np", "numpy", "math", "os", "sys", "json", "logging", "time", "re",
+    "itertools", "functools", "collections", "pd", "pandas", "string",
+    "hashlib", "warnings", "textwrap", "copy", "dataclasses", "enum",
+    "typing", "pathlib", "shutil", "csv", "gzip", "io", "struct", "base64",
+}
+
+# the sanctioned streaming-pool boundary: the GC014 cone does not descend
+# through these — the decode they perform happens on pool workers by design
+STREAM_BARRIERS = {"_run_pass", "_iter_chunks", "stream_schema",
+                   "_parquet_numeric_cols"}
+_STREAM_BARRIER_FILES = ("anovos_tpu/data_ingest/prefetch.py",)
+
+# GC012: host decodes of external bytes
+_READER_ATTRS = {
+    "read_parquet", "read_csv", "read_json", "read_table",
+    "read_schema", "read_metadata", "read_avro", "ParquetFile",
+}
+
+# GC014: part-decode entry points
+_DECODE_NAMES = {
+    "read_host_frame", "read_dataset", "read_dataset_distributed",
+    "_read_one_part", "guarded_part_read", "read_parquet", "read_avro",
+    "ParquetFile",
+}
+_DECODE_CHAINS = {"pacsv.read_csv", "pyarrow.csv.read_csv"}
+
+_MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict",
+                  "collections.OrderedDict", "defaultdict",
+                  "collections.defaultdict", "deque", "collections.deque"}
+_MUTATORS = {"append", "add", "update", "setdefault", "pop", "popitem",
+             "clear", "extend", "insert", "remove", "discard", "appendleft",
+             "popleft"}
+
+
+# -- shared classifiers ----------------------------------------------------
+
+def _read_mode_open(node: ast.Call) -> bool:
+    chain = call_chain(node)
+    if chain not in ("open", "gzip.open"):
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return True
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return not any(ch in mode.value for ch in "wax+")
+    return True
+
+
+def io_flagged(call: ast.Call) -> str:
+    """The offending chain when ``call`` is a GC012-shaped host read."""
+    if _read_mode_open(call):
+        return call_chain(call) or "open"
+    func = call.func
+    name = func.attr if isinstance(func, ast.Attribute) else getattr(func, "id", "")
+    if name in _READER_ATTRS:
+        return call_chain(call) or name
+    return ""
+
+
+def decode_flagged(call: ast.Call) -> str:
+    """The offending chain when ``call`` is a GC014-shaped part decode."""
+    chain = call_chain(call) or ""
+    if chain in _DECODE_CHAINS:
+        return chain
+    func = call.func
+    name = func.attr if isinstance(func, ast.Attribute) else getattr(func, "id", "")
+    if name in _DECODE_NAMES:
+        return chain or name
+    if _read_mode_open(call):
+        return chain or "open"
+    return ""
+
+
+def is_collective_call(node: ast.Call) -> str:
+    """The collective chain when ``node`` dispatches a collective, else ''."""
+    chain = call_chain(node) or ""
+    tail = chain.rsplit(".", 1)[-1]
+    if tail in COLLECTIVE_TAILS:
+        return chain or tail
+    if tail == "numeric_block":
+        for kw in node.keywords:
+            if kw.arg == "shard_cols" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True:
+                return chain + "(shard_cols=True)"
+    return ""
+
+
+def module_name(relpath: str) -> str:
+    """Dotted module name of a repo-relative path (``__init__`` → package)."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = p.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _is_timed_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        return call_chain(dec) in ("timed", "obs.timed")
+    return attr_chain(dec) in ("timed", "obs.timed")
+
+
+def _is_lru_decorator(dec: ast.AST) -> bool:
+    chain = attr_chain(dec) or (call_chain(dec) if isinstance(dec, ast.Call) else None)
+    return chain in ("lru_cache", "functools.lru_cache", "cache", "functools.cache")
+
+
+_BRACKETS = ("dispatch_bracket", "node_bracket")
+
+
+def _module_mutable_globals(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in tree.body:
+        targets: List[ast.Name] = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            targets = [node.target]
+            value = node.value
+        if value is None or not targets:
+            continue
+        mutable = isinstance(value, (ast.Dict, ast.List, ast.Set)) or (
+            isinstance(value, ast.Call) and attr_chain(value.func) in _MUTABLE_CTORS
+        )
+        if mutable:
+            out.update(t.id for t in targets)
+    return out
+
+
+def _env_read(node: ast.AST,
+              consts: Optional[Dict[str, str]] = None,
+              ) -> Optional[Tuple[Optional[str], Optional[str], int]]:
+    """(var name | None-if-dynamic, literal default | None, line).  A name
+    argument that is a module-level string CONSTANT (``ENV_KNOB =
+    "ANOVOS_TPU_CHAOS"``; ``os.environ.get(ENV_KNOB)``) resolves through
+    ``consts`` — a named constant is as auditable as a literal."""
+
+    def _name_of(arg: ast.AST) -> Optional[str]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        if consts and isinstance(arg, ast.Name):
+            return consts.get(arg.id)
+        return None
+
+    if isinstance(node, ast.Call):
+        chain = call_chain(node)
+        if chain in ("os.environ.get", "os.getenv", "environ.get", "getenv"):
+            name = default = None
+            if node.args:
+                name = _name_of(node.args[0])
+            if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) \
+                    and isinstance(node.args[1].value, str):
+                default = node.args[1].value
+            return name, default, node.lineno
+    if isinstance(node, ast.Subscript) and attr_chain(node.value) in ("os.environ", "environ"):
+        name = _name_of(node.slice)
+        return name, None, node.lineno
+    return None
+
+
+def _is_jit_expr(value: ast.AST) -> bool:
+    """True when ``value`` is a jit-wrapping call regardless of whether the
+    wrapped callable resolves to a name (``jax.jit(lambda x: …)``,
+    ``functools.partial(jax.jit, …)(…)``)."""
+    if not isinstance(value, ast.Call):
+        return False
+    chain = call_chain(value)
+    if chain in ("jax.jit", "jit"):
+        return True
+    if isinstance(value.func, ast.Call):
+        inner = call_chain(value.func)
+        if inner in ("jax.jit", "jit"):
+            return True
+        if inner in ("functools.partial", "partial") and value.func.args \
+                and attr_chain(value.func.args[0]) in ("jax.jit", "jit"):
+            return True
+    return False
+
+
+def _wrap_target(value: ast.AST) -> Optional[Tuple[str, bool]]:
+    """(wrapped callable name, is_jit) for module-level wrapper assignments:
+    ``jax.jit(f)``, ``functools.partial(f, …)``, ``lru_cache()(f)``,
+    ``functools.partial(jax.jit, …)(f)`` and plain ``g = f``."""
+    if isinstance(value, ast.Name):
+        return value.id, False
+    if not isinstance(value, ast.Call):
+        return None
+    chain = call_chain(value)
+    if chain in ("jax.jit", "jit") and value.args:
+        inner = value.args[0]
+        if isinstance(inner, ast.Name):
+            return inner.id, True
+        nested = _wrap_target(inner) if isinstance(inner, ast.Call) else None
+        if nested:
+            return nested[0], True
+    if chain in ("functools.partial", "partial") and value.args:
+        head = value.args[0]
+        if attr_chain(head) in ("jax.jit", "jit") and len(value.args) >= 2 \
+                and isinstance(value.args[1], ast.Name):
+            return value.args[1].id, True
+        if isinstance(head, ast.Name):
+            return head.id, False
+        if isinstance(head, ast.Call):
+            nested = _wrap_target(head)
+            if nested:
+                return nested
+    # lru_cache()(f) / cache()(f) / jit-factory(...)(f)
+    if isinstance(value.func, ast.Call):
+        inner_chain = call_chain(value.func)
+        if inner_chain in ("lru_cache", "functools.lru_cache", "cache",
+                           "functools.cache") and value.args \
+                and isinstance(value.args[0], ast.Name):
+            return value.args[0].id, False
+        if inner_chain in ("jax.jit", "jit") or (
+            isinstance(value.func, ast.Call)
+            and call_chain(value.func) in ("functools.partial", "partial")
+            and value.func.args
+            and attr_chain(value.func.args[0]) in ("jax.jit", "jit")
+        ):
+            if value.args and isinstance(value.args[0], ast.Name):
+                return value.args[0].id, True
+    return None
+
+
+def _body_ref(node: ast.AST, enclosing: str, lambda_name: Optional[str]) -> Optional[dict]:
+    """A registration body reference: Name / lambda / partial(f, …)."""
+    if isinstance(node, ast.Name):
+        return {"kind": "scoped", "scope": enclosing, "name": node.id}
+    if isinstance(node, ast.Lambda) and lambda_name:
+        return {"kind": "scoped", "scope": enclosing, "name": lambda_name}
+    if isinstance(node, ast.Call):
+        chain = call_chain(node)
+        if chain in ("functools.partial", "partial") and node.args:
+            return _body_ref(node.args[0], enclosing, None)
+    if isinstance(node, ast.Attribute):
+        chain = attr_chain(node)
+        if chain:
+            head = chain.split(".", 1)[0]
+            if head in ("self", "cls"):
+                return {"kind": "self", "name": chain.split(".")[-1]}
+            return {"kind": "chain", "chain": chain}
+    return None
+
+
+# -- summary extraction ----------------------------------------------------
+
+class _Summarizer(ast.NodeVisitor):
+    def __init__(self, relpath: str, tree: ast.Module):
+        self.relpath = relpath
+        self.mod = module_name(relpath)
+        self.package = self.mod.rsplit(".", 1)[0] if "." in self.mod else ""
+        if relpath.endswith("/__init__.py"):
+            self.package = self.mod  # relative imports resolve in the package itself
+        self.tree = tree
+        self.imports: Dict[str, str] = {}
+        self.functions: Dict[str, dict] = {}
+        self.classes: Dict[str, dict] = {}
+        self.aliases: Dict[str, dict] = {}
+        self.registrations: List[dict] = []
+        self.mutable_globals = sorted(_module_mutable_globals(tree))
+        self.load_names: Set[str] = set()
+        self.jitted_names: Set[str] = set()
+        # module-level ALL_CAPS string constants: auditable env-knob names
+        self.str_consts: Dict[str, str] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.isupper() \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                self.str_consts[node.targets[0].id] = node.value.value
+
+    # -- imports ----------------------------------------------------------
+    def _abs_module(self, level: int, mod: Optional[str]) -> str:
+        if level == 0:
+            return mod or ""
+        base = self.package
+        for _ in range(level - 1):
+            base = base.rsplit(".", 1)[0] if "." in base else ""
+        return f"{base}.{mod}" if mod else base
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.imports[a.asname] = a.name
+                    else:
+                        self.imports[a.name.split(".", 1)[0]] = a.name.split(".", 1)[0]
+            elif isinstance(node, ast.ImportFrom):
+                base = self._abs_module(node.level, node.module)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = f"{base}.{a.name}" if base else a.name
+
+    # -- module body ------------------------------------------------------
+    def run(self) -> dict:
+        self._collect_imports()
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt = _wrap_target(node.value)
+                name = node.targets[0].id
+                if tgt is not None:
+                    self.aliases[name] = {"target": tgt[0], "jit": tgt[1]}
+                    if tgt[1]:
+                        self.jitted_names.add(name)
+                elif _is_jit_expr(node.value):
+                    # jit over a non-Name body (jax.jit(lambda x: ...)) —
+                    # no call-graph target, but calling it IS a dispatch
+                    self.jitted_names.add(name)
+        self._walk_scope(self.tree, "<module>", None)
+        # module-level jit-decorated defs are also dispatchable names
+        for qual, fn in self.functions.items():
+            if fn["jit"] and "." not in qual:
+                self.jitted_names.add(qual)
+        # second pass: classify scoped calls to module-level jitted names as
+        # dispatch evidence (needs the full jitted-name set).  Calls into
+        # jitted names that are THEMSELVES @timed stay quiet — their wall
+        # books under the callee's own attribution, not anonymously.
+        for fn in self.functions.values():
+            extra = [
+                [c["line"], f"call to jitted {c['name']!r}"]
+                for c in fn["calls"]
+                if c["kind"] == "scoped" and c["name"] in self.jitted_names
+                and not self.functions.get(c["name"], {}).get("attributed")
+            ]
+            if extra:
+                fn["dispatch"] = sorted(fn["dispatch"] + extra)
+        return {
+            "version": SUMMARY_VERSION,
+            "relpath": self.relpath,
+            "module": self.mod,
+            "imports": dict(sorted(self.imports.items())),
+            "functions": {k: self.functions[k] for k in sorted(self.functions)},
+            "classes": {k: self.classes[k] for k in sorted(self.classes)},
+            "aliases": dict(sorted(self.aliases.items())),
+            "registrations": sorted(self.registrations,
+                                    key=lambda r: (r["line"], r.get("node") or "")),
+            "mutable_globals": self.mutable_globals,
+            "load_names": sorted(self.load_names),
+        }
+
+    def _walk_scope(self, scope: ast.AST, qual: str, cls: Optional[str]) -> None:
+        """Register nested defs/classes; collect module-level load names."""
+        for child in ast.iter_child_nodes(scope):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = child.name if qual == "<module>" else f"{qual}.{child.name}"
+                self._summarize_function(child, q, cls)
+                self._walk_scope(child, q, None)
+            elif isinstance(child, ast.ClassDef):
+                q = child.name if qual == "<module>" else f"{qual}.{child.name}"
+                if qual == "<module>":
+                    self.classes[child.name] = {
+                        "bases": sorted(filter(None, (attr_chain(b) for b in child.bases))),
+                        "methods": sorted(
+                            n.name for n in child.body
+                            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))),
+                    }
+                owner = child.name if qual == "<module>" else cls
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        mq = f"{q}.{sub.name}"
+                        self._summarize_function(sub, mq, owner)
+                        self._walk_scope(sub, mq, owner)
+                    else:
+                        self._walk_scope(sub, q, cls)
+            else:
+                if isinstance(child, ast.Name) and isinstance(child.ctx, ast.Load):
+                    self.load_names.add(child.id)
+                if isinstance(child, ast.Call):
+                    self._maybe_registration(child, qual, cls)
+                self._walk_scope(child, qual, cls)
+
+    # -- one function ------------------------------------------------------
+    def _summarize_function(self, fn: ast.AST, qual: str, cls: Optional[str]) -> None:
+        if qual in self.functions:
+            return
+        decorators = []
+        jit = False
+        attributed = False
+        for dec in getattr(fn, "decorator_list", []):
+            chain = attr_chain(dec) or (call_chain(dec) if isinstance(dec, ast.Call) else None)
+            if chain:
+                decorators.append(chain)
+            if is_jit_decorator(dec):
+                jit = True
+            if _is_timed_decorator(dec):
+                attributed = True
+        if jit and "." not in qual:
+            self.jitted_names.add(qual)
+
+        calls: List[dict] = []
+        env_reads: List[list] = []
+        collectives: List[list] = []
+        io: List[list] = []
+        decode: List[list] = []
+        syncs: List[list] = []
+        dispatch: List[list] = []
+        muts: List[list] = []
+        ret_calls: List[dict] = []
+        unresolved = False
+
+        params = {a.arg for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs}
+        if fn.args.vararg:
+            params.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            params.add(fn.args.kwarg.arg)
+        declared_global: Set[str] = set()
+        local_assigns: Set[str] = set()
+
+        # walk with parent/lock tracking, excluding nested defs/classes
+        def walk(node: ast.AST, locked: bool) -> None:
+            nonlocal unresolved
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    # nested defs are their own entries; a load of their name
+                    # marks them referenced
+                    continue
+                child_locked = locked
+                if isinstance(child, ast.With):
+                    for item in child.items:
+                        try:
+                            src = ast.unparse(item.context_expr)
+                        except Exception:
+                            src = ""
+                        if "lock" in src.lower():
+                            child_locked = True
+                if isinstance(child, ast.Global):
+                    declared_global.update(child.names)
+                if isinstance(child, (ast.Assign, ast.AnnAssign)):
+                    targets = child.targets if isinstance(child, ast.Assign) else [child.target]
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            local_assigns.add(t.id)
+                if isinstance(child, ast.Name) and isinstance(child.ctx, ast.Load):
+                    self.load_names.add(child.id)
+                env = _env_read(child, self.str_consts)
+                if env is not None:
+                    env_reads.append([env[0], env[1], env[2]])
+                if isinstance(child, ast.Call):
+                    self._record_call(child, qual, cls, calls, collectives, io,
+                                      decode, syncs, dispatch, muts, child_locked)
+                    if self._call_is_opaque(child):
+                        unresolved = True
+                self._record_mutation(child, muts, child_locked, params,
+                                      declared_global, local_assigns)
+                if isinstance(child, ast.Return) and child.value is not None:
+                    for sub in ast.walk(child.value):
+                        if isinstance(sub, ast.Call):
+                            ref = self._call_ref(sub, qual, cls)
+                            if ref is not None:
+                                ret_calls.append(ref)
+                walk(child, child_locked)
+
+        walk(fn, False)
+        if not attributed:
+            attributed = any(
+                (c.get("chain") or "").endswith(b)
+                for c in calls for b in _BRACKETS if c["kind"] == "chain"
+            )
+
+        # local device-value taint: does this function return a device value?
+        ret_device = False
+        if not jit and isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_jit = set(self.jitted_names)
+            try:
+                ta = TaintAnalysis(fn, device_fns=local_jit)
+                for node in walk_function(fn):
+                    if isinstance(node, ast.Return) and node.value is not None \
+                            and ta.tainted(node.value):
+                        ret_device = True
+                        break
+            except RecursionError:  # pathological nesting: stay conservative
+                ret_device = False
+
+        # mutable-global loads (GC008's hidden-state check, v2 scope)
+        global_loads: List[list] = []
+        mg = set(self.mutable_globals)
+        if mg:
+            shadowed = (params | local_assigns) - declared_global
+            for node in walk_function(fn):
+                if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                        and node.id in mg and not node.id.isupper() \
+                        and node.id not in shadowed:
+                    global_loads.append([node.id, node.lineno])
+
+        self.functions[qual] = {
+            "qual": qual,
+            "name": qual.rsplit(".", 1)[-1],
+            "class": cls,
+            "line": fn.lineno,
+            "decorators": sorted(set(decorators)),
+            "jit": jit,
+            "attributed": attributed,
+            "calls": sorted(calls, key=lambda c: (c["line"], c.get("name") or c.get("chain") or "")),
+            "env_reads": sorted(env_reads, key=lambda e: (e[2], e[0] or "")),
+            "collectives": sorted(collectives),
+            "io": sorted(io),
+            "decode": sorted(decode),
+            "syncs": sorted(syncs),
+            "dispatch": sorted(dispatch),
+            "muts": sorted(muts, key=lambda m: (m[4], m[0] or "", m[1])),
+            "global_loads": sorted(global_loads, key=lambda g: (g[1], g[0])),
+            "ret_calls": ret_calls[:16],
+            "ret_device": ret_device,
+            "unresolved": unresolved,
+            "streaming": qual.rsplit(".", 1)[-1].endswith("_streaming"),
+        }
+
+    def _call_ref(self, call: ast.Call, qual: str, cls: Optional[str]) -> Optional[dict]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return {"kind": "scoped", "scope": qual, "name": func.id}
+        chain = attr_chain(func)
+        if chain:
+            head = chain.split(".", 1)[0]
+            if head in ("self", "cls"):
+                return {"kind": "self", "cls": cls, "name": chain.split(".")[-1]}
+            return {"kind": "chain", "chain": chain}
+        return None
+
+    def _call_is_opaque(self, call: ast.Call) -> bool:
+        """True when the callee cannot possibly resolve to a repo function
+        and is not on the known-host allowlist (GC011 resolvability)."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return False  # scoped: resolvable or a builtin, decided later
+        chain = attr_chain(func)
+        if chain is None:
+            return True  # call on a call result / subscript: opaque
+        head = chain.split(".", 1)[0]
+        if head in ("self", "cls"):
+            return False
+        return False  # chains are judged at resolution time
+
+    def _record_call(self, call, qual, cls, calls, collectives, io, decode,
+                     syncs, dispatch, muts, locked) -> None:
+        ref = self._call_ref(call, qual, cls)
+        if ref is not None:
+            ref = dict(ref)
+            ref["line"] = call.lineno
+            ref["locked"] = locked
+            calls.append(ref)
+        chain = call_chain(call) or ""
+        col = is_collective_call(call)
+        if col:
+            collectives.append([col, call.lineno])
+        what = io_flagged(call)
+        if what:
+            io.append([what, call.lineno])
+        dec = decode_flagged(call)
+        if dec:
+            decode.append([dec, call.lineno])
+        if chain in ("jax.device_get", "device_get") or chain.endswith(".block_until_ready"):
+            syncs.append([chain, call.lineno])
+            dispatch.append([call.lineno, chain])
+        if isinstance(call.func, ast.Attribute) and call.func.attr in _MUTATORS \
+                and not isinstance(call.func.value, ast.Name):
+            # alias.G.append(...) — cross-module mutator through a chain
+            chain2 = attr_chain(call.func.value)
+            if chain2 and "." in chain2:
+                head, gname = chain2.split(".", 1)
+                if "." not in gname and gname and not gname.isupper() \
+                        and head not in ("self", "cls"):
+                    muts.append([head, gname, f".{call.func.attr}()-mutated",
+                                 locked, call.lineno])
+    def _maybe_registration(self, call: ast.Call, qual: str, cls: Optional[str]) -> None:
+        """Record a scheduler registration edge (spine/fanout/aside/add)."""
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr in REGISTRAR_ATTRS):
+            return
+        kwargs = {kw.arg for kw in call.keywords if kw.arg}
+        body_kw = next((kw.value for kw in call.keywords if kw.arg == "body"), None)
+        if call.func.attr == "add" and not (kwargs & REG_KWARGS):
+            return  # set.add() etc.: not a scheduler registration
+        if len(call.args) < 2 and body_kw is None:
+            return
+        node_name = None
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            node_name = call.args[0].value
+        elif call.args and isinstance(call.args[0], ast.JoinedStr):
+            from tools.graftcheck.jaxmodel import normalize_template
+            node_name = normalize_template(call.args[0])
+        body_expr = body_kw if body_kw is not None else (
+            call.args[1] if len(call.args) >= 2 else None)
+        # unwrap functools.partial(f, ...) to the underlying body
+        while isinstance(body_expr, ast.Call) \
+                and call_chain(body_expr) in ("functools.partial", "partial") \
+                and body_expr.args:
+            body_expr = body_expr.args[0]
+        ref2 = None
+        if isinstance(body_expr, ast.Lambda):
+            lambda_name = f"<lambda:{body_expr.lineno}>"
+            lam_qual = lambda_name if qual == "<module>" else f"{qual}.{lambda_name}"
+            self._summarize_function(body_expr, lam_qual, None)
+            ref2 = {"kind": "scoped", "scope": qual, "name": lambda_name}
+        elif body_expr is not None:
+            ref2 = _body_ref(body_expr, qual, None)
+            if ref2 is not None and ref2.get("kind") == "self":
+                ref2["cls"] = cls
+        placement = None
+        for kw in call.keywords:
+            if kw.arg == "placement":
+                placement = (kw.value.value
+                             if isinstance(kw.value, ast.Constant)
+                             and isinstance(kw.value.value, str) else "<dyn>")
+        self.registrations.append({
+            "node": node_name, "body": ref2, "line": call.lineno,
+            "registrar": call.func.attr, "placement": placement,
+            "scope": qual,
+        })
+
+    def _record_mutation(self, node, muts, locked, params, declared_global,
+                         local_assigns) -> None:
+        def root_name(t):
+            while isinstance(t, (ast.Subscript, ast.Attribute)):
+                t = t.value
+            return t.id if isinstance(t, ast.Name) else None
+
+        def chain_mut(t) -> Optional[Tuple[str, str]]:
+            """alias.G[...] = v — (alias, G)."""
+            if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Attribute):
+                chain = attr_chain(t.value)
+                if chain and chain.count(".") == 1:
+                    head, gname = chain.split(".")
+                    if head not in ("self", "cls"):
+                        return head, gname
+            return None
+
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                cm = chain_mut(t)
+                if cm:
+                    muts.append([cm[0], cm[1], "item-assigned", locked, node.lineno])
+                elif isinstance(t, ast.Subscript):
+                    n = root_name(t)
+                    if n and n not in params and n not in local_assigns:
+                        muts.append([None, n, "item-assigned", locked, node.lineno])
+        elif isinstance(node, ast.AugAssign):
+            cm = chain_mut(node.target)
+            if cm:
+                muts.append([cm[0], cm[1], "item-augmented", locked, node.lineno])
+            elif isinstance(node.target, ast.Subscript):
+                n = root_name(node.target)
+                if n and n not in params and n not in local_assigns:
+                    muts.append([None, n, "item-augmented", locked, node.lineno])
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                cm = chain_mut(t)
+                if cm:
+                    muts.append([cm[0], cm[1], "item-deleted", locked, node.lineno])
+                elif isinstance(t, ast.Subscript):
+                    n = root_name(t)
+                    if n and n not in params and n not in local_assigns:
+                        muts.append([None, n, "item-deleted", locked, node.lineno])
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS \
+                and isinstance(node.func.value, ast.Name):
+            n = node.func.value.id
+            if n not in params and n not in local_assigns:
+                muts.append([None, n, f".{node.func.attr}()-mutated", locked,
+                             node.lineno])
+
+
+def summarize_module(relpath: str, tree: ast.Module) -> dict:
+    return _Summarizer(relpath, tree).run()
+
+
+# -- the program -----------------------------------------------------------
+
+def view_digest(view: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(view, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()
+
+
+class Program:
+    """Whole-program resolution + transitive facts over module summaries."""
+
+    def __init__(self, summaries: Dict[str, dict]):
+        self.summaries = summaries
+        self.by_module: Dict[str, str] = {}          # module name -> relpath
+        self.fns: Dict[str, dict] = {}               # fid -> function summary
+        self.edges: Dict[str, List[dict]] = {}       # fid -> [{to, line, locked}]
+        self.preds: Dict[str, List[Tuple[str, bool]]] = {}  # fid -> [(caller, locked)]
+        self.entry_regs: List[Tuple[str, str]] = []  # (node name, body fid)
+        self._build()
+
+    # -- construction ------------------------------------------------------
+    def _build(self) -> None:
+        for rel, s in sorted(self.summaries.items()):
+            self.by_module[s["module"]] = rel
+            for qual, fn in s["functions"].items():
+                self.fns[f"{rel}::{qual}"] = fn
+        for rel, s in sorted(self.summaries.items()):
+            for qual, fn in sorted(s["functions"].items()):
+                fid = f"{rel}::{qual}"
+                out: List[dict] = []
+                for call in fn["calls"]:
+                    to = self.resolve(rel, call)
+                    if to is not None and to in self.fns:
+                        out.append({"to": to, "line": call["line"],
+                                    "locked": bool(call.get("locked"))})
+                self.edges[fid] = sorted(out, key=lambda e: (e["line"], e["to"]))
+            for reg in s["registrations"]:
+                if reg.get("body") is None:
+                    continue
+                body = dict(reg["body"])
+                if body.get("kind") == "self":
+                    body["cls"] = None  # registration inside a method: best effort
+                to = self.resolve(rel, body)
+                if to is not None and to in self.fns:
+                    self.entry_regs.append((reg.get("node") or "<dynamic>", to))
+        self.entry_regs.sort()
+        for fid in self.fns:
+            self.preds[fid] = []
+        for fid, outs in self.edges.items():
+            for e in outs:
+                self.preds[e["to"]].append((fid, e["locked"]))
+        for _node, body in self.entry_regs:
+            # scheduler invocation: an un-locked virtual call edge
+            self.preds[body].append(("<scheduler>", False))
+        for fid in self.preds:
+            self.preds[fid].sort()
+        self._compute()
+
+    # -- resolution --------------------------------------------------------
+    def _module_symbol(self, mod: str, name: str, depth: int = 0) -> Optional[str]:
+        """fid of ``mod.name`` (function, alias chain, or class __init__)."""
+        rel = self.by_module.get(mod)
+        if rel is None or depth > 6:
+            return None
+        s = self.summaries[rel]
+        if name in s["functions"]:
+            return f"{rel}::{name}"
+        alias = s["aliases"].get(name)
+        if alias is not None:
+            return self._resolve_scoped(rel, "<module>", alias["target"], depth + 1)
+        if name in s["classes"]:
+            if "__init__" in s["classes"][name]["methods"]:
+                return f"{rel}::{name}.__init__"
+            return None
+        imp = s["imports"].get(name)
+        if imp is not None:
+            return self._resolve_imported(imp, depth + 1)
+        return None
+
+    def _resolve_imported(self, target: str, depth: int = 0) -> Optional[str]:
+        """``from m import f`` target ``m.f`` — or a re-exported chain."""
+        if depth > 6:
+            return None
+        if target in self.by_module:
+            return None  # a module object, not a callable
+        if "." not in target:
+            return None
+        mod, sym = target.rsplit(".", 1)
+        return self._module_symbol(mod, sym, depth + 1)
+
+    def _resolve_scoped(self, rel: str, scope: str, name: str, depth: int = 0) -> Optional[str]:
+        if depth > 8:
+            return None
+        s = self.summaries[rel]
+        # lexical scope: nested defs of the enclosing function chain, outward
+        q = scope
+        while q and q != "<module>":
+            cand = f"{q}.{name}"
+            if cand in s["functions"]:
+                return f"{rel}::{cand}"
+            q = q.rsplit(".", 1)[0] if "." in q else "<module>"
+        return self._module_symbol(s["module"], name, depth + 1)
+
+    def resolve(self, rel: str, ref: dict) -> Optional[str]:
+        kind = ref.get("kind")
+        s = self.summaries[rel]
+        if kind == "scoped":
+            name = ref["name"]
+            if name in HOST_BUILTINS:
+                return None
+            return self._resolve_scoped(rel, ref.get("scope") or "<module>", name)
+        if kind == "self":
+            cls = ref.get("cls")
+            name = ref["name"]
+            seen = 0
+            while cls is not None and seen < 6:
+                info = s["classes"].get(cls)
+                if info is None:
+                    return None
+                if name in info["methods"]:
+                    return f"{rel}::{cls}.{name}"
+                nxt = None
+                for b in info["bases"]:
+                    base = b.rsplit(".", 1)[-1]
+                    if base in s["classes"]:
+                        nxt = base
+                        break
+                cls = nxt
+                seen += 1
+            return None
+        if kind == "chain":
+            chain = ref["chain"]
+            parts = chain.split(".")
+            head = parts[0]
+            target = s["imports"].get(head)
+            if target is None:
+                # maybe a module-level alias object (rare) — give up
+                return None
+            full = target + "." + ".".join(parts[1:]) if len(parts) > 1 else target
+            # longest module prefix
+            bits = full.split(".")
+            for i in range(len(bits) - 1, 0, -1):
+                mod = ".".join(bits[:i])
+                if mod in self.by_module:
+                    restparts = bits[i:]
+                    if len(restparts) == 1:
+                        return self._module_symbol(mod, restparts[0])
+                    if len(restparts) == 2:
+                        relm = self.by_module[mod]
+                        cand = f"{restparts[0]}.{restparts[1]}"
+                        if cand in self.summaries[relm]["functions"]:
+                            return f"{relm}::{cand}"
+                    return None
+            if full and "." in full:
+                return self._resolve_imported(full)
+            return None
+        return None
+
+    def _chain_unresolved(self, rel: str, call: dict) -> bool:
+        """Is this call site opaque for GC011 resolvability?"""
+        kind = call.get("kind")
+        if kind == "scoped":
+            name = call["name"]
+            if name in HOST_BUILTINS:
+                return False
+            return self.resolve(rel, call) is None
+        if kind == "self":
+            return self.resolve(rel, call) is None
+        if kind == "chain":
+            head = call["chain"].split(".", 1)[0]
+            if head in SAFE_CHAIN_ROOTS:
+                return False
+            return self.resolve(rel, call) is None
+        return True
+
+    # -- transitive facts --------------------------------------------------
+    def _bfs(self, seeds: Iterable[Tuple[str, str]],
+             barrier=None) -> Dict[str, str]:
+        """{fid: tag} reachable from ``(tag, fid)`` seeds; first (sorted)
+        seed to reach a function wins, so the map is deterministic.  A
+        ``barrier`` function is excluded from the result entirely — it is a
+        sanctioned boundary, not a member of the cone."""
+        out: Dict[str, str] = {}
+        visited: Set[str] = set()
+        for tag, seed in sorted(seeds):
+            if seed not in self.fns:
+                continue
+            stack = [seed]
+            while stack:
+                fid = stack.pop()
+                if fid in visited:
+                    continue
+                visited.add(fid)
+                if barrier is not None and barrier(fid):
+                    continue
+                out[fid] = tag
+                for e in self.edges.get(fid, ()):
+                    if e["to"] not in visited:
+                        stack.append(e["to"])
+        return out
+
+    def _compute(self) -> None:
+        # node-reachability from scheduler registrations
+        self.node_reachable = self._bfs(self.entry_regs)
+
+        # streaming-consumer cone, stopping at the sanctioned pool boundary
+        def stream_barrier(fid: str) -> bool:
+            rel = fid.split("::", 1)[0]
+            name = self.fns[fid]["name"]
+            return name in STREAM_BARRIERS or rel in _STREAM_BARRIER_FILES \
+                or name in _DECODE_NAMES
+        stream_seeds = [(self.fns[f]["name"], f) for f in self.fns
+                        if self.fns[f]["streaming"]]
+        self.streaming = self._bfs(stream_seeds, barrier=stream_barrier)
+
+        # attribution closure: @timed / bracket coverage flows down callees
+        attr_seeds = [(self.fns[f]["qual"], f) for f in self.fns
+                      if self.fns[f]["attributed"]]
+        self.attributed = set(self._bfs(attr_seeds))
+
+        # device-returning fixpoint (wrapper chains across modules)
+        device: Set[str] = {f for f, fn in self.fns.items()
+                            if fn["jit"] or fn["ret_device"]}
+        for _ in range(6):
+            grew = False
+            for fid, fn in self.fns.items():
+                if fid in device:
+                    continue
+                rel = fid.split("::", 1)[0]
+                for ref in fn["ret_calls"]:
+                    to = self.resolve(rel, ref)
+                    if to in device:
+                        device.add(fid)
+                        grew = True
+                        break
+            if not grew:
+                break
+        self.device_returning = device
+
+        # transitive collective reach: fid -> (chain, via-qual | "")
+        collects: Dict[str, Tuple[str, str]] = {}
+        for fid, fn in sorted(self.fns.items()):
+            if fn["collectives"]:
+                collects[fid] = (fn["collectives"][0][0], "")
+        for _ in range(len(self.fns)):
+            grew = False
+            for fid in sorted(self.fns):
+                if fid in collects:
+                    continue
+                best: Optional[Tuple[str, str]] = None
+                for e in self.edges.get(fid, ()):
+                    hit = collects.get(e["to"])
+                    if hit is not None:
+                        via = self.fns[e["to"]]["qual"]
+                        cand = (hit[0], via)
+                        if best is None or cand < best:
+                            best = cand
+                if best is not None:
+                    collects[fid] = best
+                    grew = True
+            if not grew:
+                break
+        self.collects = collects
+
+        # transitive dispatch evidence: fid -> [line, desc] (anchored locally).
+        # Base evidence: local facts (jitted-name calls, blocking fetches)
+        # plus direct call edges into @jax.jit functions anywhere in the repo.
+        # Evidence never flows THROUGH an attributed callee: a call landing
+        # in a @timed/bracketed function books its dispatch wall under THAT
+        # name — only unattributed reach is anonymous dispatch.
+        dispatches: Dict[str, List] = {}
+        for fid, fn in sorted(self.fns.items()):
+            if fn["dispatch"]:
+                dispatches[fid] = list(fn["dispatch"][0])
+                continue
+            best = None
+            for e in self.edges.get(fid, ()):
+                if self.fns[e["to"]]["jit"] and e["to"] not in self.attributed:
+                    cand = [e["line"],
+                            f"call to jitted {self.fns[e['to']]['qual']!r}"]
+                    if best is None or cand < best:
+                        best = cand
+            if best is not None:
+                dispatches[fid] = best
+        for _ in range(len(self.fns)):
+            grew = False
+            for fid in sorted(self.fns):
+                if fid in dispatches:
+                    continue
+                best = None
+                for e in self.edges.get(fid, ()):
+                    if e["to"] in dispatches and e["to"] not in self.attributed:
+                        cand = [e["line"],
+                                f"call to {self.fns[e['to']]['qual']!r} "
+                                "(dispatches transitively)"]
+                        if best is None or cand < best:
+                            best = cand
+                if best is not None:
+                    dispatches[fid] = best
+                    grew = True
+            if not grew:
+                break
+        self.dispatches = dispatches
+
+        # resolvability (GC011 stale check): False when the function or any
+        # transitive callee has an opaque call site
+        unresolved0: Set[str] = set()
+        for fid, fn in self.fns.items():
+            rel = fid.split("::", 1)[0]
+            if fn["unresolved"]:
+                unresolved0.add(fid)
+                continue
+            for call in fn["calls"]:
+                if self._chain_unresolved(rel, call):
+                    unresolved0.add(fid)
+                    break
+        opaque = set(unresolved0)
+        for _ in range(len(self.fns)):
+            grew = False
+            for fid in self.fns:
+                if fid in opaque:
+                    continue
+                if any(e["to"] in opaque for e in self.edges.get(fid, ())):
+                    opaque.add(fid)
+                    grew = True
+            if not grew:
+                break
+        self.opaque = opaque
+
+        # lock discipline (GC018)
+        self._compute_lock_discipline()
+        # dead node bodies (GC019)
+        self._compute_dead_nodes()
+
+    def _compute_lock_discipline(self) -> None:
+        # resolve every mutation site to (defining relpath, global name)
+        sites: List[dict] = []
+        for rel, s in sorted(self.summaries.items()):
+            mg = set(s["mutable_globals"])
+            for qual, fn in sorted(s["functions"].items()):
+                for head, gname, how, locked, line in fn["muts"]:
+                    owner_rel = None
+                    if head is None:
+                        if gname in mg:
+                            owner_rel = rel
+                        else:
+                            imp = s["imports"].get(gname)
+                            if imp and "." in imp:
+                                mod, sym = imp.rsplit(".", 1)
+                                r2 = self.by_module.get(mod)
+                                if r2 and sym in self.summaries[r2]["mutable_globals"]:
+                                    owner_rel = r2
+                                    gname = sym
+                    else:
+                        target = s["imports"].get(head)
+                        if target:
+                            r2 = self.by_module.get(target)
+                            if r2 and gname in self.summaries[r2]["mutable_globals"]:
+                                owner_rel = r2
+                    if owner_rel is not None:
+                        sites.append({
+                            "rel": rel, "qual": qual, "line": line,
+                            "how": how, "locked": bool(locked),
+                            "owner": owner_rel, "global": gname,
+                        })
+        disciplined = {(st["owner"], st["global"]) for st in sites if st["locked"]}
+
+        # unlocked-reachability: can execution reach a function without
+        # having traversed a lock-holding call site?
+        unlocked: Set[str] = {f for f in self.fns if not self.preds.get(f)}
+        unlocked |= {body for _n, body in self.entry_regs}
+        frontier = sorted(unlocked)
+        while frontier:
+            nxt: Set[str] = set()
+            for fid in frontier:
+                for e in self.edges.get(fid, ()):
+                    if not e["locked"] and e["to"] not in unlocked:
+                        nxt.add(e["to"])
+            unlocked |= nxt
+            frontier = sorted(nxt)
+        self.unlocked_reachable = unlocked
+
+        viol: List[dict] = []
+        for st in sites:
+            if st["locked"]:
+                continue
+            if (st["owner"], st["global"]) not in disciplined:
+                continue
+            if st["rel"] == st["owner"]:
+                continue  # same-module: GC005's jurisdiction
+            fid = f"{st['rel']}::{st['qual']}"
+            if fid in self.fns and fid not in self.unlocked_reachable:
+                continue  # every call path into this site holds the lock
+            viol.append(st)
+        self.lock_violations = sorted(
+            viol, key=lambda v: (v["rel"], v["line"], v["global"]))
+
+    def _compute_dead_nodes(self) -> None:
+        """Underscore-named functions nested in a registering scope that are
+        never registered, never called, and never referenced."""
+        registering_scopes: Set[Tuple[str, str]] = set()
+        for rel, s in self.summaries.items():
+            for reg in s["registrations"]:
+                registering_scopes.add((rel, reg["scope"]))
+        called: Set[str] = set()
+        for outs in self.edges.values():
+            called.update(e["to"] for e in outs)
+        called.update(body for _n, body in self.entry_regs)
+        dead: List[dict] = []
+        for rel, s in sorted(self.summaries.items()):
+            loads = set(s["load_names"])
+            for qual, fn in sorted(s["functions"].items()):
+                if "." not in qual:
+                    continue  # module level: public API surface, not a node body
+                scope = qual.rsplit(".", 1)[0]
+                if (rel, scope) not in registering_scopes:
+                    continue
+                name = fn["name"]
+                if not name.startswith("_") or name.startswith("__"):
+                    continue
+                fid = f"{rel}::{qual}"
+                if fid in called or name in loads:
+                    continue
+                dead.append({"rel": rel, "qual": qual, "line": fn["line"],
+                             "scope": scope})
+        self.dead_nodes = dead
+
+    # -- per-file views ----------------------------------------------------
+    def view(self, rel: str) -> dict:
+        """The program-derived facts rules consume for ``rel`` — canonical,
+        JSON-serializable, and the incremental invalidation key."""
+        s = self.summaries.get(rel)
+        if s is None:
+            return {}
+        quals = sorted(s["functions"])
+        node_reach = {}
+        streaming = {}
+        attributed = []
+        dispatch = {}
+        for q in quals:
+            fid = f"{rel}::{q}"
+            if fid in self.node_reachable:
+                node_reach[q] = self.node_reachable[fid]
+            if fid in self.streaming:
+                streaming[q] = self.streaming[fid]
+            if fid in self.attributed:
+                attributed.append(q)
+            if fid in self.dispatches:
+                dispatch[q] = self.dispatches[fid]
+        # local names resolving to device-returning functions (GC001 seeds)
+        device_names = []
+        for name in sorted(set(s["imports"]) | set(s["aliases"])):
+            ref = {"kind": "scoped", "scope": "<module>", "name": name}
+            to = self.resolve(rel, ref)
+            if to is not None and to in self.device_returning:
+                device_names.append(name)
+        # per-registration collective reach + resolvability (GC011)
+        regs = {}
+        for reg in s["registrations"]:
+            body = reg.get("body")
+            to = self.resolve(rel, dict(body)) if body else None
+            entry: Dict[str, Any] = {"collects": None, "resolvable": False}
+            if to is not None and to in self.fns:
+                hit = self.collects.get(to)
+                if hit is not None:
+                    chain, via = hit
+                    entry["collects"] = chain if not via else f"{chain} (via {via})"
+                entry["resolvable"] = to not in self.opaque
+            regs[str(reg["line"])] = entry
+        gc018 = [[v["qual"], v["line"],
+                  f"{module_name(v['owner'])}.{v['global']}", v["how"]]
+                 for v in self.lock_violations if v["rel"] == rel]
+        gc019 = [[d["qual"], d["line"], d["scope"]]
+                 for d in self.dead_nodes if d["rel"] == rel]
+        return {
+            "node_reachable": node_reach,
+            "streaming": streaming,
+            "attributed": attributed,
+            "dispatch": dispatch,
+            "device_names": device_names,
+            "registrations": regs,
+            "gc018": gc018,
+            "gc019": gc019,
+        }
+
+    # -- program-wide queries (knob inventory) -----------------------------
+    def env_read_sites(self) -> List[dict]:
+        """Every env read in the program: name, default, site, reachability."""
+        out: List[dict] = []
+        for rel, s in sorted(self.summaries.items()):
+            for qual, fn in sorted(s["functions"].items()):
+                fid = f"{rel}::{qual}"
+                for name, default, line in fn["env_reads"]:
+                    out.append({
+                        "name": name, "default": default, "rel": rel,
+                        "qual": qual, "line": line,
+                        "node_reachable": fid in self.node_reachable,
+                    })
+        return sorted(out, key=lambda e: (e["name"] or "", e["rel"], e["line"]))

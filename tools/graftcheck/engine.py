@@ -1,47 +1,100 @@
-"""graftcheck engine: file walking, suppressions, baseline, reporting.
+"""graftcheck engine: file walking, whole-program analysis, suppressions,
+baseline, incremental cache, reporting.
 
-Scan pipeline
--------------
+Scan pipeline (engine v2)
+-------------------------
 1. Collect ``.py`` files under the given paths (skipping ``__pycache__``).
-2. Parse each once; hand the :class:`FileContext` to every rule whose
-   ``applies(relpath)`` accepts the file.
-3. Drop findings suppressed by a ``# graftcheck: disable=GC001[,GC002]``
-   (or ``disable=all``) comment on the flagged line.
-4. Partition the rest against the committed baseline
+2. Parse each once and build its :mod:`callgraph` module summary (or reuse
+   the cached summary when the file's content hash is unchanged).
+3. Construct the whole-program :class:`~tools.graftcheck.callgraph.Program`
+   — call graph, node-reachability, attribution closure, transitive
+   collective/dispatch/taint facts — and derive each file's *view*: the
+   exact slice of program facts that file's rules consume.
+4. Analyze each file: hand a :class:`FileContext` (with its view) to every
+   rule whose ``applies(relpath)`` accepts it.  In incremental mode a file
+   is re-analyzed only when its content hash OR its view digest changed —
+   cross-file influence is visible only through the view, so this is the
+   exact reverse-dependency cone, not a heuristic.
+5. Drop findings suppressed by a ``# graftcheck: disable=GC001[,GC002]``
+   (or ``disable=all``) comment on the flagged line.  Only real COMMENT
+   tokens count — the same text inside a string or docstring declares
+   nothing.  Suppression tokens that drop nothing are STALE (reported
+   like stale baseline entries).
+6. Partition the rest against the committed baseline
    (``tools/graftcheck/baseline.json``): a finding matching a baseline
    entry on ``(rule, path, symbol, message)`` — up to the entry's
    ``count`` — is grandfathered; anything beyond is NEW.  Baseline
-   entries with no live finding are STALE.  Both new findings and stale
-   entries fail the run, so the committed baseline is always exact.
+   entries with no live finding are STALE.  New findings, stale entries
+   and stale suppressions all fail the run, so the committed state is
+   always exact.
 
 Every baseline entry carries a human ``justification`` — loading refuses
 entries without one, so debt can't be silently parked.
 
 Output is deterministic: files sorted by relpath, findings sorted by
 (path, line, rule, message), JSON dumped with sorted keys — two scans of
-the same tree are byte-identical (the determinism tier-1 test).
+the same tree are byte-identical (the determinism tier-1 test), cold or
+warm, with or without the incremental cache.
 """
 
 from __future__ import annotations
 
 import ast
+import hashlib
+import io
 import json
 import os
 import re
-from typing import Dict, Iterable, List, Optional, Tuple
+import time
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
+from tools.graftcheck.callgraph import Program, summarize_module, view_digest
+from tools.graftcheck.callgraph import SUMMARY_VERSION
 from tools.graftcheck.registry import FileContext, Finding, all_rules
 
 __all__ = [
-    "ROOT", "BASELINE_PATH", "iter_py_files", "scan", "load_baseline",
+    "ROOT", "BASELINE_PATH", "CACHE_PATH", "ScanResult", "StaleSuppression",
+    "iter_py_files", "scan", "scan_detail", "load_baseline",
     "apply_baseline", "baseline_from_findings", "render_report",
-    "record_obs_metrics", "run",
+    "record_obs_metrics", "run", "fix_stale_suppressions",
+    "knob_inventory",
 ]
 
 ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+_HERE = os.path.dirname(os.path.abspath(__file__))
+BASELINE_PATH = os.path.join(_HERE, "baseline.json")
+CACHE_PATH = os.path.join(_HERE, ".gc_cache.json")
 
 _SUPPRESS_RE = re.compile(r"#\s*graftcheck:\s*disable=([A-Za-z0-9_,\s]+)")
+
+# in-process memo: (abspath, content sha) -> module summary.  Repeated
+# scans in one test session re-summarize nothing.
+_SUMMARY_MEMO: Dict[Tuple[str, str], dict] = {}
+
+
+@dataclass(frozen=True, order=True)
+class StaleSuppression:
+    """A ``# graftcheck: disable=GC0xx`` token that suppressed nothing."""
+
+    path: str
+    line: int
+    rule: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: STALE suppression "
+                f"(disable={self.rule} matches no finding — remove it)")
+
+
+@dataclass
+class ScanResult:
+    findings: List[Finding] = field(default_factory=list)
+    stale_suppressions: List[StaleSuppression] = field(default_factory=list)
+    files_scanned: int = 0
+    files_reanalyzed: int = 0
+    scan_seconds: float = 0.0
+    program: Optional[Program] = None
 
 
 def iter_py_files(paths: Iterable[str]) -> List[str]:
@@ -73,39 +126,307 @@ def _relpath(path: str) -> str:
     return path.replace(os.sep, "/")
 
 
-def _suppressed_rules(line_text: str) -> set:
-    m = _SUPPRESS_RE.search(line_text)
-    if not m:
-        return set()
-    return {tok.strip().upper() for tok in m.group(1).split(",") if tok.strip()}
+def _suppression_comments(source: str) -> Dict[int, Tuple[int, List[str]]]:
+    """Map line -> (column of the ``# graftcheck`` comment, declared rule
+    tokens in source order).  Tokenized, so ``disable=GC0xx`` text inside a
+    string or docstring is never a suppression — and never reported stale."""
+    out: Dict[int, Tuple[int, List[str]]] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            declared = [t.strip() for t in m.group(1).split(",") if t.strip()]
+            if declared:
+                out[tok.start[0]] = (tok.start[1], declared)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return {}
+    return out
+
+
+# -- incremental cache -----------------------------------------------------
+
+def _engine_salt() -> str:
+    """Content hash of the analysis engine itself (every tool source plus
+    the audited knob lists in cache/fingerprint.py).  Any rule or engine
+    edit invalidates the whole cache — cached findings are only ever reused
+    under the exact engine that produced them."""
+    h = hashlib.sha256()
+    h.update(f"summary-v{SUMMARY_VERSION}".encode())
+    tool_files: List[str] = []
+    for dirpath, dirs, files in os.walk(_HERE):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        tool_files.extend(os.path.join(dirpath, f) for f in sorted(files)
+                          if f.endswith(".py"))
+    fp = os.path.join(ROOT, "anovos_tpu", "cache", "fingerprint.py")
+    if os.path.exists(fp):
+        tool_files.append(fp)
+    for path in sorted(tool_files):
+        h.update(path.encode())
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+def _load_cache(cache_path: str, salt: str) -> Dict[str, dict]:
+    try:
+        with open(cache_path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if data.get("salt") != salt:
+        return {}
+    files = data.get("files")
+    return files if isinstance(files, dict) else {}
+
+
+def _save_cache(cache_path: str, salt: str, files: Dict[str, dict]) -> None:
+    tmp = cache_path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"salt": salt, "files": files}, f, sort_keys=True,
+                  separators=(",", ":"))
+    os.replace(tmp, cache_path)
+
+
+def _empty_summary(rel: str) -> dict:
+    return summarize_module(rel, ast.parse(""))
+
+
+# -- the scan --------------------------------------------------------------
+
+def _analyze_file(path: str, rel: str, source: str, tree: Optional[ast.Module],
+                  view: dict, rules, parse_error) -> Tuple[List[Finding], List[StaleSuppression]]:
+    """Run every applicable rule over one parsed file; apply per-line
+    suppressions and report the tokens that suppressed nothing."""
+    declared: Dict[int, Set[str]] = {
+        line: {t.upper() for t in toks}
+        for line, (_, toks) in _suppression_comments(source).items()
+    }
+    if parse_error is not None:
+        finding = Finding(rule="GC000", path=rel, line=parse_error.lineno or 0,
+                          symbol="<module>", message=f"syntax error: {parse_error.msg}")
+        return [finding], []
+    ctx = FileContext(path, rel, source, tree, view=view)
+    findings: List[Finding] = []
+    used: Set[Tuple[int, str]] = set()
+    for rule in rules:
+        if not rule.applies(rel):
+            continue
+        for f_ in rule.check(ctx):
+            sup = declared.get(f_.line, set())
+            if f_.rule in sup:
+                used.add((f_.line, f_.rule))
+                continue
+            if "ALL" in sup:
+                used.add((f_.line, "ALL"))
+                continue
+            findings.append(f_)
+    stale: List[StaleSuppression] = []
+    for line, toks in declared.items():
+        for tok in toks:
+            if (line, tok) not in used:
+                stale.append(StaleSuppression(rel, line, tok))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    stale.sort()
+    return findings, stale
+
+
+def scan_detail(paths: Iterable[str], rules=None,
+                cache_path: Optional[str] = None) -> ScanResult:
+    """Full scan pipeline.  ``cache_path`` enables incremental mode: module
+    summaries and per-file findings persist keyed by content hash + an
+    engine-source salt; a file is re-analyzed only when its own content or
+    its view of program facts changed."""
+    t0 = time.monotonic()
+    custom_rules = rules is not None
+    rules = list(rules) if custom_rules else all_rules()
+    use_cache = cache_path is not None and not custom_rules
+
+    salt = _engine_salt() if use_cache else ""
+    cached = _load_cache(cache_path, salt) if use_cache else {}
+
+    files: List[Tuple[str, str]] = []          # (abspath, rel)
+    sources: Dict[str, str] = {}               # rel -> source text
+    shas: Dict[str, str] = {}                  # rel -> content sha
+    trees: Dict[str, Optional[ast.Module]] = {}
+    errors: Dict[str, SyntaxError] = {}
+    summaries: Dict[str, dict] = {}
+
+    for path in iter_py_files(paths):
+        rel = _relpath(path)
+        files.append((path, rel))
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        sources[rel] = source
+        sha = hashlib.sha256(source.encode()).hexdigest()
+        shas[rel] = sha
+        entry = cached.get(rel)
+        if entry is not None and entry.get("sha") == sha \
+                and isinstance(entry.get("summary"), dict):
+            summaries[rel] = entry["summary"]
+            continue
+        memo = _SUMMARY_MEMO.get((path, sha))
+        if memo is not None and not use_cache:
+            summaries[rel] = memo
+            continue
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            errors[rel] = e
+            trees[rel] = None
+            summaries[rel] = _empty_summary(rel)
+            continue
+        trees[rel] = tree
+        summaries[rel] = summarize_module(rel, tree)
+        if not use_cache:
+            _SUMMARY_MEMO[(path, sha)] = summaries[rel]
+
+    program = Program(summaries)
+
+    findings: List[Finding] = []
+    stale_sups: List[StaleSuppression] = []
+    new_cache: Dict[str, dict] = {}
+    reanalyzed = 0
+    for path, rel in files:
+        view = program.view(rel)
+        digest = view_digest(view)
+        entry = cached.get(rel)
+        if use_cache and entry is not None and entry.get("sha") == shas[rel] \
+                and entry.get("view_digest") == digest \
+                and isinstance(entry.get("findings"), list):
+            file_findings = [Finding(*f) for f in entry["findings"]]
+            file_stale = [StaleSuppression(*s) for s in entry.get("stale_sups", [])]
+        else:
+            if rel not in trees and rel not in errors:
+                try:
+                    trees[rel] = ast.parse(sources[rel], filename=path)
+                except SyntaxError as e:  # unreachable if sha matched cache
+                    errors[rel] = e
+                    trees[rel] = None
+            file_findings, file_stale = _analyze_file(
+                path, rel, sources[rel], trees.get(rel), view, rules,
+                errors.get(rel))
+            reanalyzed += 1
+        findings.extend(file_findings)
+        stale_sups.extend(file_stale)
+        if use_cache:
+            new_cache[rel] = {
+                "sha": shas[rel],
+                "summary": summaries[rel],
+                "view_digest": digest,
+                "findings": [[f.rule, f.path, f.line, f.symbol, f.message]
+                             for f in file_findings],
+                "stale_sups": [[s.path, s.line, s.rule] for s in file_stale],
+            }
+    if use_cache:
+        _save_cache(cache_path, salt, new_cache)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    stale_sups.sort()
+    return ScanResult(
+        findings=findings, stale_suppressions=stale_sups,
+        files_scanned=len(files), files_reanalyzed=reanalyzed,
+        scan_seconds=time.monotonic() - t0, program=program,
+    )
 
 
 def scan(paths: Iterable[str], rules=None) -> List[Finding]:
     """All unsuppressed findings under ``paths``, deterministically sorted."""
-    rules = list(rules) if rules is not None else all_rules()
-    findings: List[Finding] = []
-    for path in iter_py_files(paths):
-        rel = _relpath(path)
-        applicable = [r for r in rules if r.applies(rel)]
-        if not applicable:
+    return scan_detail(paths, rules=rules).findings
+
+
+# -- stale-suppression cleanup ---------------------------------------------
+
+def fix_stale_suppressions(stale: List[StaleSuppression],
+                           root: str = None) -> List[str]:
+    """Rewrite sources deleting stale suppression tokens (whole comment when
+    every token on the line is stale).  Returns the rewritten paths."""
+    root = root or ROOT
+    by_file: Dict[str, Dict[int, Set[str]]] = {}
+    for s in stale:
+        by_file.setdefault(s.path, {}).setdefault(s.line, set()).add(s.rule)
+    touched: List[str] = []
+    for rel, line_toks in sorted(by_file.items()):
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
             continue
         with open(path, encoding="utf-8") as f:
-            source = f.read()
-        try:
-            tree = ast.parse(source, filename=path)
-        except SyntaxError as e:
-            findings.append(Finding(rule="GC000", path=rel, line=e.lineno or 0,
-                                    symbol="<module>", message=f"syntax error: {e.msg}"))
-            continue
-        ctx = FileContext(path, rel, source, tree)
-        for rule in applicable:
-            for f_ in rule.check(ctx):
-                line_text = ctx.lines[f_.line - 1] if 0 < f_.line <= len(ctx.lines) else ""
-                sup = _suppressed_rules(line_text)
-                if f_.rule in sup or "ALL" in sup:
-                    continue
-                findings.append(f_)
-    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))
+            text = f.read()
+        lines = text.splitlines(keepends=True)
+        comments = _suppression_comments(text)
+        changed = False
+        for lineno, toks in line_toks.items():
+            if not (0 < lineno <= len(lines)) or lineno not in comments:
+                continue
+            line = lines[lineno - 1]
+            m = _SUPPRESS_RE.search(line, comments[lineno][0])
+            if not m:
+                continue
+            declared = [t.strip() for t in m.group(1).split(",") if t.strip()]
+            keep = [t for t in declared if t.upper() not in toks]
+            if keep:
+                new_comment = f"# graftcheck: disable={','.join(keep)}"
+                new_line = line[:m.start()] + new_comment + line[m.end():]
+            else:
+                new_line = line[:m.start()].rstrip() + line[m.end():].rstrip("\n") \
+                    + ("\n" if line.endswith("\n") else "")
+                if new_line.strip() == "":
+                    new_line = "" if line.endswith("\n") else new_line
+            if new_line != line:
+                lines[lineno - 1] = new_line
+                changed = True
+        if changed:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write("".join(lines))
+            touched.append(rel)
+    return touched
+
+
+# -- env-knob inventory ----------------------------------------------------
+
+def knob_inventory(paths: Optional[Iterable[str]] = None) -> List[dict]:
+    """Typed inventory of every environment knob the program touches or
+    audits: the fingerprinted set (``KNOWN_ENV_KNOBS``), the documented
+    artifact-neutral exemptions (``EXEMPT_ENV_KNOBS`` with justifications),
+    and every observed read besides.  A knob in neither list is
+    ``unaudited`` when some read is reachable from a scheduler node body (a
+    live GC008 concern) and ``off-node`` when none is — those reads cannot
+    influence node artifacts, so the cache key is allowed to ignore them.
+    Dynamic (non-literal) env names class as ``dynamic``.  Read sites come
+    from the whole-program call graph, annotated with node-reachability."""
+    from tools.graftcheck.rules.gc008_cache_key import (
+        exempt_env_knobs, known_env_knobs)
+
+    result = scan_detail(paths or [os.path.join(ROOT, "anovos_tpu")])
+    by_name: Dict[str, List[dict]] = {}
+    for site in result.program.env_read_sites():
+        by_name.setdefault(site["name"] or "<dynamic>", []).append(site)
+    known = set(known_env_knobs())
+    exempt = exempt_env_knobs()
+    out: List[dict] = []
+    for name in sorted(known | set(exempt) | set(by_name)):
+        sites = by_name.get(name, [])
+        if name == "<dynamic>":
+            cls = "dynamic"
+        elif name in known:
+            cls = "fingerprinted"
+        elif name in exempt:
+            cls = "exempt"
+        elif any(s["node_reachable"] for s in sites):
+            cls = "unaudited"
+        else:
+            cls = "off-node"
+        out.append({
+            "knob": name,
+            "class": cls,
+            "justification": exempt.get(name, ""),
+            "reads": len(sites),
+            "node_reachable_reads": sum(1 for s in sites if s["node_reachable"]),
+            "sites": [f"{s['rel']}:{s['line']}" for s in sites],
+        })
+    return out
 
 
 # -- baseline -------------------------------------------------------------
@@ -116,9 +437,9 @@ def load_baseline(path: str = BASELINE_PATH) -> List[dict]:
     with open(path, encoding="utf-8") as f:
         entries = json.load(f)
     for e in entries:
-        for field in ("rule", "path", "symbol", "message"):
-            if not isinstance(e.get(field), str) or not e[field]:
-                raise ValueError(f"baseline entry missing {field!r}: {e}")
+        for field_ in ("rule", "path", "symbol", "message"):
+            if not isinstance(e.get(field_), str) or not e[field_]:
+                raise ValueError(f"baseline entry missing {field_!r}: {e}")
         if not isinstance(e.get("justification"), str) or not e["justification"].strip():
             raise ValueError(
                 f"baseline entry for {e['rule']} at {e['path']} [{e['symbol']}] "
@@ -165,7 +486,9 @@ def baseline_from_findings(findings: List[Finding]) -> List[dict]:
 
 # -- reporting ------------------------------------------------------------
 
-def render_report(new: List[Finding], stale: List[dict], total: int) -> str:
+def render_report(new: List[Finding], stale: List[dict], total: int,
+                  stale_sups: Iterable[StaleSuppression] = ()) -> str:
+    stale_sups = list(stale_sups)
     lines: List[str] = []
     for f in new:
         lines.append(f.render())
@@ -174,21 +497,29 @@ def render_report(new: List[Finding], stale: List[dict], total: int) -> str:
             f"{e['path']}: {e['rule']} [{e['symbol']}] STALE baseline entry "
             f"(finding no longer present — remove it): {e['message']}"
         )
-    if new or stale:
-        lines.append(
-            f"graftcheck: {len(new)} new finding(s), {len(stale)} stale baseline "
-            f"entr{'y' if len(stale) == 1 else 'ies'} ({total} finding(s) total pre-baseline)"
-        )
+    for s in stale_sups:
+        lines.append(s.render())
+    if new or stale or stale_sups:
+        parts = [f"graftcheck: {len(new)} new finding(s)",
+                 f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'}"]
+        if stale_sups:
+            parts.append(f"{len(stale_sups)} stale suppression(s)")
+        lines.append(", ".join(parts)
+                     + f" ({total} finding(s) total pre-baseline)")
     else:
         lines.append(f"graftcheck: ok — 0 new findings ({total} baselined)")
     return "\n".join(lines)
 
 
-def record_obs_metrics(findings: List[Finding]) -> None:
+def record_obs_metrics(findings: List[Finding],
+                       result: Optional[ScanResult] = None) -> None:
     """Book per-rule finding totals (pre-baseline lint debt) into the obs
-    metrics registry as ``graftcheck_findings_total{rule=...}`` so the run
-    manifest / dashboards can track debt over time.  Never raises; a
-    missing anovos_tpu package (standalone tool checkout) is a no-op."""
+    metrics registry as ``graftcheck_findings_total{rule=...}``, plus scan
+    cost gauges (``graftcheck_scan_seconds``,
+    ``graftcheck_files_reanalyzed_total``) so the run manifest / dashboards
+    can track debt AND the incremental engine's work over time.  Never
+    raises; a missing anovos_tpu package (standalone tool checkout) is a
+    no-op."""
     try:
         from anovos_tpu.obs import get_metrics
     except Exception:
@@ -204,15 +535,28 @@ def record_obs_metrics(findings: List[Finding]) -> None:
         per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
     for rule in all_rules():
         gauge.set(per_rule.get(rule.id, 0), rule=rule.id)
+    if result is not None:
+        get_metrics().gauge(
+            "graftcheck_scan_seconds",
+            "wall seconds of the last graftcheck scan (whole-program engine)",
+        ).set(round(result.scan_seconds, 6))
+        get_metrics().gauge(
+            "graftcheck_files_reanalyzed_total",
+            "files the last scan actually re-analyzed (vs served from the "
+            "incremental cache)",
+        ).set(result.files_reanalyzed)
 
 
 def run(paths: Iterable[str], baseline_path: Optional[str] = BASELINE_PATH,
-        emit_metrics: bool = False) -> Tuple[int, str, List[Finding]]:
+        emit_metrics: bool = False,
+        cache_path: Optional[str] = None) -> Tuple[int, str, List[Finding]]:
     """Scan + baseline in one call: (exit_code, report_text, all_findings)."""
-    findings = scan(paths)
+    result = scan_detail(paths, cache_path=cache_path)
+    findings = result.findings
     entries = load_baseline(baseline_path) if baseline_path else []
     new, stale = apply_baseline(findings, entries)
     if emit_metrics:
-        record_obs_metrics(findings)
-    code = 1 if (new or stale) else 0
-    return code, render_report(new, stale, len(findings)), findings
+        record_obs_metrics(findings, result)
+    code = 1 if (new or stale or result.stale_suppressions) else 0
+    report = render_report(new, stale, len(findings), result.stale_suppressions)
+    return code, report, findings

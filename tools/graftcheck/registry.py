@@ -39,14 +39,23 @@ class Finding:
 
 
 class FileContext:
-    """Parsed view of one source file handed to every applicable rule."""
+    """Parsed view of one source file handed to every applicable rule.
 
-    def __init__(self, path: str, relpath: str, source: str, tree: ast.Module):
+    ``view`` carries the file's slice of the whole-program call-graph facts
+    (engine v2): node-reachability, the streaming cone, the attribution
+    closure, transitive dispatch/collective evidence, cross-module
+    device-returning names, and the GC018/GC019 verdicts.  It is empty only
+    when a rule is exercised outside the engine's scan pipeline.
+    """
+
+    def __init__(self, path: str, relpath: str, source: str, tree: ast.Module,
+                 view: Optional[dict] = None):
         self.path = path
         self.relpath = relpath
         self.source = source
         self.lines = source.splitlines()
         self.tree = tree
+        self.view: dict = view or {}
         self._parents: Dict[ast.AST, ast.AST] = {}
         self._qualnames: Dict[ast.AST, str] = {}
         self._index()
@@ -84,6 +93,12 @@ class FileContext:
         return Finding(rule=rule, path=self.relpath,
                        line=getattr(node, "lineno", 0),
                        symbol=self.qualname(node), message=message)
+
+    def finding_at(self, rule: str, line: int, symbol: str, message: str) -> Finding:
+        """A finding anchored by line/symbol directly — for call-graph rules
+        whose evidence is a program fact, not an AST node in hand."""
+        return Finding(rule=rule, path=self.relpath, line=line,
+                       symbol=symbol, message=message)
 
 
 class Rule:

@@ -18,4 +18,6 @@ from tools.graftcheck.rules import (  # noqa: F401  (import = registration)
     gc015_nonmergeable_accumulator,
     gc016_label_cardinality,
     gc017_manifest_classification,
+    gc018_lock_discipline,
+    gc019_dead_node,
 )

@@ -49,7 +49,12 @@ class HostSyncRule(Rule):
         return any(relpath.startswith(p) for p in HOT_PATHS) or "gc001" in relpath
 
     def check(self, ctx: FileContext):
+        # engine v2: the local device-returning set is unioned with names
+        # that the whole-program call graph proves resolve to device-
+        # returning functions in OTHER modules (imported helpers whose
+        # return value is a device array)
         device_fns = device_returning_functions(ctx.tree)
+        device_fns |= set(ctx.view.get("device_names", ()))
         for fn in ast.walk(ctx.tree):
             if not isinstance(fn, ast.FunctionDef):
                 continue

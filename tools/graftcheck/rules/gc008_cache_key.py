@@ -1,24 +1,25 @@
-"""GC008 — cache-key completeness for scheduler node bodies.
+"""GC008 — cache-key completeness for scheduler-reachable code.
 
 The incremental-recompute cache (``anovos_tpu.cache``) treats a node's
 artifacts as a pure function of (dataset fingerprint, config slice, code
 version, upstream fingerprints, audited env knobs).  That soundness claim
-dies silently the day a node body reads an input the key cannot see: an
-environment variable missing from ``fingerprint.KNOWN_ENV_KNOBS``, or a
-mutable module global whose value varies between processes.  Either one
-makes two runs with identical fingerprints produce different artifacts —
-a WRONG cache hit, the worst failure mode a cache can have.
+dies silently the day node-reachable code reads an input the key cannot
+see: an environment variable missing from ``fingerprint.KNOWN_ENV_KNOBS``,
+or a mutable module global whose value varies between processes.  Either
+one makes two runs with identical fingerprints produce different artifacts
+— a WRONG cache hit, the worst failure mode a cache can have.
 
-This rule cross-checks every scheduler registration's resolved body
-(``pipe.spine`` / ``pipe.fanout`` / ``sched.add``, plus same-file callees
-one level deep — the ``save``/``stats_args`` helpers node bodies route
-through):
+Engine v2: the scan scope is the whole-program call graph's
+node-reachability cone — EVERY function transitively reachable from a
+scheduler registration body (``pipe.spine`` / ``pipe.fanout`` /
+``sched.add``), across module boundaries, not just same-file helpers one
+level deep.  For each function in the cone:
 
 * ``os.environ[...]`` / ``os.environ.get`` / ``os.getenv`` reads whose
   literal name is NOT in ``anovos_tpu/cache/fingerprint.py``'s
-  ``KNOWN_ENV_KNOBS`` are flagged — add the knob to the audited list (it
-  then folds into every fingerprint) or baseline with a justification
-  that it cannot change artifacts;
+  ``KNOWN_ENV_KNOBS`` (fingerprinted) or ``EXEMPT_ENV_KNOBS`` (documented
+  as artifact-neutral: pure perf/telemetry toggles) are flagged — add the
+  knob to one of the audited lists or baseline with a justification;
 * env reads with a non-literal name are flagged as unverifiable;
 * loads of module-level MUTABLE globals (same detection as GC005's
   mutation tracking) are flagged unless the name is ALL_CAPS — the
@@ -33,14 +34,12 @@ from __future__ import annotations
 
 import ast
 import os
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+from typing import Dict, Optional, Set, Tuple
 
 from tools.graftcheck.engine import ROOT
-from tools.graftcheck.jaxmodel import attr_chain, call_chain
+from tools.graftcheck.jaxmodel import attr_chain, call_chain, walk_function
 from tools.graftcheck.registry import FileContext, Rule, register
 from tools.graftcheck.rules.gc005_global_mutation import _module_mutable_globals
-
-_REGISTRAR_ATTRS = {"spine", "fanout", "add"}
 
 # mirror of fingerprint.KNOWN_ENV_KNOBS for standalone-tool checkouts;
 # the live list is parsed from the source so the two cannot drift silently
@@ -53,18 +52,26 @@ _FALLBACK_KNOBS = (
 )
 
 _knobs_cache: Optional[Tuple[str, ...]] = None
+_exempt_cache: Optional[Dict[str, str]] = None
+
+
+def _fingerprint_tree() -> Optional[ast.Module]:
+    path = os.path.join(ROOT, "anovos_tpu", "cache", "fingerprint.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            return ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
 
 
 def known_env_knobs() -> Tuple[str, ...]:
-    """The audited knob list, parsed from cache/fingerprint.py's AST."""
+    """The fingerprinted knob list, parsed from cache/fingerprint.py's AST."""
     global _knobs_cache
     if _knobs_cache is not None:
         return _knobs_cache
-    path = os.path.join(ROOT, "anovos_tpu", "cache", "fingerprint.py")
     knobs: Tuple[str, ...] = _FALLBACK_KNOBS
-    try:
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read(), filename=path)
+    tree = _fingerprint_tree()
+    if tree is not None:
         for node in tree.body:
             if (isinstance(node, ast.Assign)
                     and any(isinstance(t, ast.Name) and t.id == "KNOWN_ENV_KNOBS"
@@ -75,118 +82,135 @@ def known_env_knobs() -> Tuple[str, ...]:
                     if isinstance(e, ast.Constant) and isinstance(e.value, str)
                 )
                 break
-    except OSError:
-        pass
     _knobs_cache = knobs
     return knobs
 
 
-def _env_read(node: ast.AST) -> Optional[Tuple[Optional[str], ast.AST]]:
+def exempt_env_knobs() -> Dict[str, str]:
+    """``EXEMPT_ENV_KNOBS`` (knob -> why it cannot change artifacts), parsed
+    from cache/fingerprint.py's AST — the documented artifact-neutral
+    exemption list the --knobs inventory renders."""
+    global _exempt_cache
+    if _exempt_cache is not None:
+        return _exempt_cache
+    exempt: Dict[str, str] = {}
+    tree = _fingerprint_tree()
+    if tree is not None:
+        for node in tree.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "EXEMPT_ENV_KNOBS"
+                            for t in node.targets)
+                    and isinstance(node.value, ast.Dict)):
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                            and isinstance(v, ast.Constant) and isinstance(v.value, str):
+                        exempt[k.value] = v.value
+                break
+    _exempt_cache = exempt
+    return exempt
+
+
+def _str_consts(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ALL_CAPS string constants — a named knob constant is as
+    auditable as a literal (``ENV_KNOB = "ANOVOS_TPU_CHAOS"``)."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.isupper() \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _env_read(node: ast.AST,
+              consts: Dict[str, str]) -> Optional[Tuple[Optional[str], ast.AST]]:
     """(env var name | None-if-dynamic, anchor node) for an environ read."""
+
+    def _name_of(arg: ast.AST) -> Optional[str]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        if isinstance(arg, ast.Name):
+            return consts.get(arg.id)
+        return None
+
     if isinstance(node, ast.Call):
         chain = call_chain(node)
         if chain in ("os.environ.get", "os.getenv", "environ.get", "getenv"):
-            if node.args and isinstance(node.args[0], ast.Constant) \
-                    and isinstance(node.args[0].value, str):
-                return node.args[0].value, node
+            if node.args:
+                return _name_of(node.args[0]), node
             return None, node
     if isinstance(node, ast.Subscript) and attr_chain(node.value) in ("os.environ", "environ"):
-        sl = node.slice
-        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
-            return sl.value, node
-        return None, node
+        return _name_of(node.slice), node
     return None
-
-
-def _registration_bodies(ctx: FileContext) -> Iterable[Tuple[str, ast.FunctionDef]]:
-    """(node name hint, resolved body def) for each scheduler registration."""
-    defs: Dict[str, ast.FunctionDef] = {}
-    for node in ast.walk(ctx.tree):
-        if isinstance(node, ast.FunctionDef):
-            defs.setdefault(node.name, node)
-    for call in ast.walk(ctx.tree):
-        if not isinstance(call, ast.Call):
-            continue
-        if not (isinstance(call.func, ast.Attribute)
-                and call.func.attr in _REGISTRAR_ATTRS):
-            continue
-        if len(call.args) < 2:
-            continue
-        kwargs = {kw.arg for kw in call.keywords}
-        if call.func.attr == "add" and not ({"reads", "writes", "cache"} & kwargs):
-            continue  # not a scheduler registration (e.g. set.add)
-        fn_arg = call.args[1]
-        if isinstance(fn_arg, ast.Name) and fn_arg.id in defs:
-            yield fn_arg.id, defs[fn_arg.id]
 
 
 @register
 class CacheKeyCompletenessRule(Rule):
     id = "GC008"
-    title = "node-body inputs invisible to the cache key (env knobs, mutable globals)"
+    title = "node-reachable inputs invisible to the cache key (env knobs, mutable globals)"
 
     def check(self, ctx: FileContext):
-        knobs = set(known_env_knobs())
+        reachable: Dict[str, str] = ctx.view.get("node_reachable", {})
+        if not reachable:
+            return
+        audited = set(known_env_knobs()) | set(exempt_env_knobs())
         mutable_globals = _module_mutable_globals(ctx.tree)
-        defs: Dict[str, ast.FunctionDef] = {}
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.FunctionDef):
-                defs.setdefault(node.name, node)
+        consts = _str_consts(ctx.tree)
 
         seen: Set[Tuple] = set()
-        for body_name, body in _registration_bodies(ctx):
-            # the body plus same-file callees one level deep — the helper
-            # layer (save/stats_args) node bodies route their effects through
-            funcs: List[ast.FunctionDef] = [body]
-            for sub in ast.walk(body):
-                if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
-                        and sub.func.id in defs and defs[sub.func.id] is not body):
-                    callee = defs[sub.func.id]
-                    if callee not in funcs:
-                        funcs.append(callee)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            qual = ctx.qualname(fn)
+            via = reachable.get(qual)
+            if via is None:
+                continue
             local_names = set()
-            for fn in funcs:
-                a = fn.args
-                for arg in a.posonlyargs + a.args + a.kwonlyargs:
-                    local_names.add(arg.arg)
-            for fn in funcs:
-                for sub in ast.walk(fn):
-                    env = _env_read(sub)
-                    if env is not None:
-                        name, anchor = env
-                        if name is None:
-                            key = (ctx.relpath, ctx.qualname(anchor), "dyn")
-                            if key not in seen:
-                                seen.add(key)
-                                yield ctx.finding(
-                                    self.id, anchor,
-                                    f"node body {body_name!r} reads an environment "
-                                    "variable through a NON-LITERAL name — the cache "
-                                    "key cannot audit it; use a literal knob name "
-                                    "from cache.fingerprint.KNOWN_ENV_KNOBS")
-                            continue
-                        if name not in knobs:
-                            key = (ctx.relpath, ctx.qualname(anchor), name)
-                            if key not in seen:
-                                seen.add(key)
-                                yield ctx.finding(
-                                    self.id, anchor,
-                                    f"node body {body_name!r} reads env knob {name!r} "
-                                    "which is NOT in cache.fingerprint.KNOWN_ENV_KNOBS "
-                                    "— an identical fingerprint can then restore "
-                                    "artifacts this knob would have changed; add it "
-                                    "to the audited list or justify in the baseline")
-                        continue
-                    if (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
-                            and sub.id in mutable_globals
-                            and not sub.id.isupper()
-                            and sub.id not in local_names):
-                        key = (ctx.relpath, ctx.qualname(sub), sub.id)
+            a = fn.args
+            for arg in a.posonlyargs + a.args + a.kwonlyargs:
+                local_names.add(arg.arg)
+            for sub in walk_function(fn):
+                env = _env_read(sub, consts)
+                if env is not None:
+                    name, anchor = env
+                    if name is None:
+                        key = (ctx.relpath, qual, "dyn")
                         if key not in seen:
                             seen.add(key)
                             yield ctx.finding(
-                                self.id, sub,
-                                f"node body {body_name!r} reads mutable module "
-                                f"global {sub.id!r} — process state the cache key "
-                                "cannot see; thread it through the config slice or "
-                                "rename ALL_CAPS if it is a declared constant")
+                                self.id, anchor,
+                                f"code reachable from scheduler node {via!r} reads "
+                                "an environment variable through a NON-LITERAL name "
+                                "— the cache key cannot audit it; use a literal "
+                                "knob name from cache.fingerprint.KNOWN_ENV_KNOBS")
+                        continue
+                    if name not in audited:
+                        key = (ctx.relpath, qual, name)
+                        if key not in seen:
+                            seen.add(key)
+                            yield ctx.finding(
+                                self.id, anchor,
+                                f"code reachable from scheduler node {via!r} reads "
+                                f"env knob {name!r} which is in neither "
+                                "cache.fingerprint.KNOWN_ENV_KNOBS nor "
+                                "EXEMPT_ENV_KNOBS — an identical fingerprint can "
+                                "then restore artifacts this knob would have "
+                                "changed; fingerprint it, document the exemption, "
+                                "or justify in the baseline")
+                    continue
+                if (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+                        and sub.id in mutable_globals
+                        and not sub.id.isupper()
+                        and sub.id not in local_names):
+                    key = (ctx.relpath, qual, sub.id)
+                    if key not in seen:
+                        seen.add(key)
+                        yield ctx.finding(
+                            self.id, sub,
+                            f"code reachable from scheduler node {via!r} reads "
+                            f"mutable module global {sub.id!r} — process state "
+                            "the cache key cannot see; thread it through the "
+                            "config slice or rename ALL_CAPS if it is a "
+                            "declared constant")

@@ -10,22 +10,24 @@ wedged node died in (``last_op: null`` — exactly the postmortem field the
 TPU-tunnel wedge investigation needs).
 
 This rule flags **public module-level functions in ``anovos_tpu/ops/``
-that dispatch device programs unattributed**.  "Dispatches" means the
-body (or a private same-file helper it calls) invokes
+that dispatch device programs unattributed**.  Engine v2: both sides of
+the test ride the whole-program call graph.  "Dispatches" means the
+function's transitive call chain — across module boundaries — reaches
 
-* a module-level jitted callable — ``X = jax.jit(f)`` /
-  ``functools.partial(jax.jit, ...)`` assignments or ``@jax.jit`` /
-  ``@partial(jax.jit, ...)`` decorated defs, or
+* a jitted callable (``X = jax.jit(f)`` / ``functools.partial(jax.jit,
+  ...)`` assignments, ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated
+  defs, anywhere in the repo), or
 * ``jax.device_get`` / ``.block_until_ready()`` (a host-blocking fetch
-  is the dispatch tail by definition).
+  is the dispatch tail by definition);
 
-A function is ATTRIBUTED (quiet) when any of:
+the finding anchors at this function's OWN call site that starts the
+dispatching chain.  A function is ATTRIBUTED (quiet) when any of:
 
 * it is decorated ``@timed(...)`` (the ``obs.timed`` wrapper);
-* it enters ``devprof.dispatch_bracket(...)`` itself;
-* it is called, directly, by a ``@timed``-decorated function in the same
-  module (attribution flows to the wrapper — helpers under a timed entry
-  point must NOT be double-wrapped, that would double-count dispatch);
+* it enters ``devprof.dispatch_bracket(...)`` / ``node_bracket(...)``;
+* it is a transitive callee of an attributed function — attribution flows
+  down REAL call edges, cross-module (helpers under a timed entry point
+  must NOT be double-wrapped, that would double-count dispatch);
 * it is private (``_``-prefixed — not an entry point).
 
 Deliberate exemptions (cold paths, fit-once model code) go in the
@@ -35,80 +37,9 @@ baseline with a justification, as ever.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, Optional, Set
+from typing import Iterable
 
-from tools.graftcheck.jaxmodel import attr_chain, call_chain
 from tools.graftcheck.registry import FileContext, Rule, register
-
-
-def _is_jit_call(node: ast.AST) -> bool:
-    """``jax.jit(...)`` / ``jit(...)`` / ``functools.partial(jax.jit, …)``."""
-    if not isinstance(node, ast.Call):
-        return False
-    chain = call_chain(node)
-    if chain in ("jax.jit", "jit"):
-        return True
-    if chain in ("functools.partial", "partial") and node.args:
-        head = node.args[0]
-        if attr_chain(head) in ("jax.jit", "jit"):
-            return True
-        # partial(jit(f), ...) — still a jitted callable
-        if _is_jit_call(head):
-            return True
-    return False
-
-
-def _jitted_names(tree: ast.Module) -> Set[str]:
-    """Module-level names bound to jitted callables."""
-    out: Set[str] = set()
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and _is_jit_call(node.value):
-            for t in node.targets:
-                if isinstance(t, ast.Name):
-                    out.add(t.id)
-        elif isinstance(node, ast.FunctionDef):
-            for dec in node.decorator_list:
-                if attr_chain(dec) in ("jax.jit", "jit") or _is_jit_call(dec):
-                    out.add(node.name)
-    return out
-
-
-def _is_timed_decorator(dec: ast.AST) -> bool:
-    if isinstance(dec, ast.Call):
-        return call_chain(dec) in ("timed", "obs.timed")
-    return attr_chain(dec) in ("timed", "obs.timed")
-
-
-def _dispatch_evidence(fn: ast.FunctionDef, jitted: Set[str],
-                       defs: Dict[str, ast.FunctionDef],
-                       depth: int = 0) -> Optional[ast.AST]:
-    """The first node proving ``fn`` dispatches device work — direct jitted
-    calls, blocking fetches, or (one level deep) a private same-file helper
-    that does."""
-    for sub in ast.walk(fn):
-        if not isinstance(sub, ast.Call):
-            continue
-        chain = call_chain(sub) or ""
-        if isinstance(sub.func, ast.Name) and sub.func.id in jitted:
-            return sub
-        if chain in ("jax.device_get", "device_get"):
-            return sub
-        if chain.endswith(".block_until_ready"):
-            return sub
-        if (depth == 0 and isinstance(sub.func, ast.Name)
-                and sub.func.id in defs and sub.func.id.startswith("_")):
-            inner = _dispatch_evidence(defs[sub.func.id], jitted, defs, depth + 1)
-            if inner is not None:
-                return sub  # anchor at the public function's call site
-    return None
-
-
-def _enters_dispatch_bracket(fn: ast.FunctionDef) -> bool:
-    for sub in ast.walk(fn):
-        if isinstance(sub, ast.Call) and (call_chain(sub) or "").endswith(
-                "dispatch_bracket"):
-            return True
-    return False
 
 
 @register
@@ -120,36 +51,20 @@ class UnattributedDispatchRule(Rule):
         return relpath.startswith("anovos_tpu/ops/") or "gc010" in relpath
 
     def check(self, ctx: FileContext) -> Iterable:
-        jitted = _jitted_names(ctx.tree)
-        defs: Dict[str, ast.FunctionDef] = {
-            n.name: n for n in ctx.tree.body if isinstance(n, ast.FunctionDef)
-        }
-        if not jitted and not any(
-                isinstance(c, ast.Call) and call_chain(c) in
-                ("jax.device_get", "device_get")
-                for c in ast.walk(ctx.tree)):
-            return
-        # functions a @timed function calls directly: attributed through
-        # the wrapper (double-wrapping them would double-count dispatch)
-        covered_by_timed: Set[str] = set()
-        for fn in defs.values():
-            if any(_is_timed_decorator(d) for d in fn.decorator_list):
-                covered_by_timed.add(fn.name)
-                for sub in ast.walk(fn):
-                    if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
-                        covered_by_timed.add(sub.func.id)
-        for name, fn in defs.items():
-            if name.startswith("_"):
+        attributed = set(ctx.view.get("attributed", ()))
+        dispatch = ctx.view.get("dispatch", {})
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.FunctionDef):
                 continue
-            if name in covered_by_timed:
+            name = node.name
+            if name.startswith("_") or name in attributed:
                 continue
-            if _enters_dispatch_bracket(fn):
-                continue
-            evidence = _dispatch_evidence(fn, jitted, defs)
+            evidence = dispatch.get(name)
             if evidence is None:
                 continue
-            yield ctx.finding(
-                self.id, evidence,
+            line, _desc = evidence
+            yield ctx.finding_at(
+                self.id, line, name,
                 f"public ops entry point {name!r} dispatches device programs "
                 "with no timed()/devprof attribution — its dispatch wall "
                 "books as anonymous host time and flight-recorder dumps "

@@ -13,13 +13,15 @@ nothing (stale placement).  This rule keeps the classification DATA, not
 folklore.
 
 For every scheduler registration (``pipe.spine`` / ``pipe.fanout`` /
-``sched.add`` carrying a registration-shaped keyword set):
+``pipe.aside`` / ``sched.add`` carrying a registration-shaped keyword
+set):
 
 1. **missing placement** *(library registrars only — ``anovos_tpu/``)*:
    the registration passes no ``placement=`` at all.  Unclassified nodes
    default to ``host``, which is exactly the dangerous direction.
-2. **collective reach from a non-collective placement**: the body (or a
-   same-file helper, one level deep) calls a collective primitive —
+2. **collective reach from a non-collective placement**: (engine v2) the
+   body's TRANSITIVE call closure — across module boundaries, through
+   the whole-program call graph — reaches a collective primitive:
    ``psum``/``pmean``/``pmax``/``pmin``/``all_gather``/``all_to_all``/
    ``ppermute``, ``shard_map``/``pmap``, ``with_sharding_constraint``,
    the runtime's ``column_parallel``/``row_sharded``/``replicated``
@@ -27,12 +29,13 @@ For every scheduler registration (``pipe.spine`` / ``pipe.fanout`` /
    ``numeric_block(..., shard_cols=True)`` — while the registration says
    ``device`` or ``host``.
 3. **stale collective placement**: the registration says ``mesh``/
-   ``submesh`` but the body is FULLY resolvable (every call lands on a
-   same-file def or a known host-side helper) and nothing in it
-   collects.  Opaque bodies (dynamic ``getattr`` dispatch, cross-module
-   calls) are exempt from this check — absence of collectives cannot be
-   proven statically there, and a false "stale" would push a collective
-   node off the rendezvous lane.
+   ``submesh`` but the body's closure is FULLY resolvable (every
+   transitive call lands on a summarized function or a known-host-side
+   name) and nothing in it collects.  Opaque closures (dynamic
+   ``getattr`` dispatch, unresolvable imports) are exempt from this
+   check — absence of collectives cannot be proven statically there,
+   and a false "stale" would push a collective node off the rendezvous
+   lane.
 
 A non-constant ``placement=`` expression is treated as classified but
 unauditable (the workflow's inner ``sched.add(placement=placement)``
@@ -43,78 +46,13 @@ the audit).
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, Optional, Set
+from typing import Iterable
 
-from tools.graftcheck.jaxmodel import attr_chain, call_chain
 from tools.graftcheck.registry import FileContext, Rule, register
 
-_REGISTRAR_ATTRS = {"spine", "fanout", "add"}
+_REGISTRAR_ATTRS = {"spine", "fanout", "aside", "add"}
 _REG_KWARGS = {"reads", "writes", "placement", "on_error", "cache", "timed",
                "cache_slice"}
-
-# call-chain tails that prove a cross-device collective dispatch
-_COLLECTIVE_TAILS = {
-    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
-    "shard_map", "pmap", "xmap", "with_sharding_constraint",
-    "column_parallel", "row_sharded", "replicated", "masked_moments_shmap",
-}
-
-# builtins whose calls never dispatch device work (resolvability model for
-# the stale-collective check)
-_HOST_BUILTINS = {
-    "open", "len", "str", "int", "float", "bool", "sorted", "list", "dict",
-    "tuple", "set", "range", "enumerate", "zip", "min", "max", "sum", "abs",
-    "isinstance", "getattr", "round", "repr", "format",
-}
-
-
-def _is_collective_call(node: ast.Call) -> bool:
-    chain = call_chain(node) or ""
-    tail = chain.rsplit(".", 1)[-1]
-    if tail in _COLLECTIVE_TAILS:
-        return True
-    if tail == "numeric_block":
-        for kw in node.keywords:
-            if kw.arg == "shard_cols" and isinstance(kw.value, ast.Constant) \
-                    and kw.value.value is True:
-                return True
-    return False
-
-
-class _BodyScan:
-    """Collective evidence + resolvability of one body (one helper level)."""
-
-    def __init__(self, defs: Dict[str, ast.FunctionDef]):
-        self.defs = defs
-
-    def scan(self, fn: ast.AST, depth: int = 0):
-        """(evidence node | None, fully_resolvable: bool)."""
-        evidence: Optional[ast.AST] = None
-        resolvable = True
-        for sub in ast.walk(fn):
-            if not isinstance(sub, ast.Call):
-                continue
-            if _is_collective_call(sub):
-                return sub, True
-            func = sub.func
-            if isinstance(func, ast.Name):
-                if func.id in _HOST_BUILTINS:
-                    continue
-                target = self.defs.get(func.id)
-                if target is not None:
-                    if depth < 1 and target is not fn:
-                        ev, res = self.scan(target, depth + 1)
-                        if ev is not None:
-                            return sub, True  # anchor at the call site
-                        resolvable = resolvable and res
-                    continue
-                resolvable = False  # cross-module name: opaque
-            else:
-                # attribute/dynamic call: opaque unless provably collective
-                # (handled above); logging-ish attrs stay opaque too — the
-                # stale check only fires on FULLY resolvable bodies
-                resolvable = False
-        return evidence, resolvable
 
 
 @register
@@ -123,11 +61,7 @@ class CollectivePlacementRule(Rule):
     title = "declared node placement vs the body's actual collective dispatches"
 
     def check(self, ctx: FileContext) -> Iterable:
-        defs: Dict[str, ast.FunctionDef] = {}
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.FunctionDef):
-                defs.setdefault(node.name, node)
-        scanner = _BodyScan(defs)
+        registrations = ctx.view.get("registrations", {})
         audit_missing = (ctx.relpath.startswith("anovos_tpu/")
                          or "gc011" in ctx.relpath)
         for call in ast.walk(ctx.tree):
@@ -141,10 +75,10 @@ class CollectivePlacementRule(Rule):
             kwargs = {kw.arg for kw in call.keywords if kw.arg}
             if call.func.attr == "add" and not (kwargs & _REG_KWARGS):
                 continue  # not a scheduler registration (e.g. set.add)
-            yield from self._audit(ctx, call, scanner, defs, audit_missing)
+            yield from self._audit(ctx, call, registrations, audit_missing)
 
-    def _audit(self, ctx: FileContext, call: ast.Call, scanner: _BodyScan,
-               defs: Dict[str, ast.FunctionDef], audit_missing: bool):
+    def _audit(self, ctx: FileContext, call: ast.Call, registrations: dict,
+               audit_missing: bool):
         node_name = ""
         if isinstance(call.args[0], ast.Constant):
             node_name = str(call.args[0].value)
@@ -165,26 +99,20 @@ class CollectivePlacementRule(Rule):
             return  # pass-through variable: audited at the literal site
         placement = placement_expr.value
         collective = placement == "mesh" or placement.startswith("submesh")
-        fn_ref = call.args[1]
-        if isinstance(fn_ref, ast.Name):
-            fn = defs.get(fn_ref.id)
-        elif isinstance(fn_ref, ast.Lambda):
-            fn = fn_ref
-        else:
-            fn = None
-        if fn is None:
-            return  # unresolvable callee: nothing to audit
-        evidence, resolvable = scanner.scan(fn)
-        if not collective and evidence is not None:
+        entry = registrations.get(str(call.lineno))
+        if entry is None:
+            return  # body unresolvable to the call graph: nothing to audit
+        collects = entry.get("collects")
+        if not collective and collects is not None:
             yield ctx.finding(
-                self.id, evidence,
+                self.id, call,
                 f"node {node_name or '<dynamic>'!r} is declared "
                 f"placement={placement!r} but its body reaches a cross-"
-                "device collective dispatch — off the rendezvous lane this "
-                "re-creates the AllReduce interleaving deadlock; declare "
-                "the node 'mesh' (or 'submesh:N'), or make the body "
-                "single-device")
-        elif collective and evidence is None and resolvable:
+                f"device collective dispatch ({collects}) — off the "
+                "rendezvous lane this re-creates the AllReduce interleaving "
+                "deadlock; declare the node 'mesh' (or 'submesh:N'), or "
+                "make the body single-device")
+        elif collective and collects is None and entry.get("resolvable"):
             yield ctx.finding(
                 self.id, call,
                 f"node {node_name or '<dynamic>'!r} is declared "

@@ -15,10 +15,10 @@ scheduler can reach:
 * **scan scope** — the ingest layer itself (``anovos_tpu/data_ingest/``,
   ``anovos_tpu/ops/streaming.py`` — every function there is reachable
   from node bodies via ``read_dataset``/``describe_streaming``,
-  including import-time module level), plus any file that REGISTERS
-  scheduler nodes (``pipe.spine``/``pipe.fanout``/``sched.add`` — there
-  the registration bodies and their same-file callees one level deep
-  are checked, the GC006/GC008 reachability model);
+  including import-time module level), plus (engine v2) EVERY function
+  the whole-program call graph proves transitively reachable from a
+  scheduler registration body, across module boundaries — the finding
+  is anchored where the I/O lives, naming the reaching node;
 * **flagged calls** — read-mode ``open()``/``gzip.open()`` (write/append
   modes pass: the artifact-capture hook owns those) and the decode
   entry points ``read_parquet`` / ``read_csv`` / ``read_json`` /
@@ -34,11 +34,22 @@ scheduler can reach:
 from __future__ import annotations
 
 import ast
-from typing import Set
+from typing import Dict
 
 from tools.graftcheck.jaxmodel import call_chain
 from tools.graftcheck.registry import FileContext, Rule, register
-from tools.graftcheck.rules.gc008_cache_key import _registration_bodies
+
+
+def _walk_body(fn: ast.AST):
+    """Walk a function body excluding nested def/class bodies but INCLUDING
+    lambdas (which have no qualname of their own)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
 
 # attribute/function names whose call is a host DECODE of external bytes
 _READER_ATTRS = {
@@ -146,30 +157,24 @@ class UnguardedHostIORule(Rule):
                         and not _inside_guarded_lambda(ctx, call):
                     yield ctx.finding(self.id, call, _MSG.format(what=what))
             return
-        # registration files: node bodies + same-file callees one level deep
-        bodies = list(_registration_bodies(ctx))
-        if not bodies:
+        # engine v2: every function the call graph proves node-reachable,
+        # cross-module — anchored where the I/O lives
+        reachable: Dict[str, str] = ctx.view.get("node_reachable", {})
+        if not reachable:
             return
-        defs = {}
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.FunctionDef):
-                defs.setdefault(node.name, node)
-        scope: Set[ast.AST] = set()
-        for _name, body in bodies:
-            scope.add(body)
-            for sub in ast.walk(body):
-                if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
-                        and sub.func.id in defs):
-                    scope.add(defs[sub.func.id])
-        reported: Set[int] = set()
-        for fn in sorted(scope, key=lambda n: n.lineno):
-            if _is_raw_reader(fn):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            for call in ast.walk(fn):
-                if not isinstance(call, ast.Call) or id(call) in reported:
+            via = reachable.get(ctx.qualname(fn))
+            if via is None or _is_raw_reader(fn):
+                continue
+            # nested defs are audited under their own qual, so walk only
+            # this function's direct body (lambdas included — they have no
+            # qual of their own)
+            for call in _walk_body(fn):
+                if not isinstance(call, ast.Call):
                     continue
                 what = _flagged(call)
                 if what and not _inside_raw_reader(ctx, call) \
                         and not _inside_guarded_lambda(ctx, call):
-                    reported.add(id(call))
                     yield ctx.finding(self.id, call, _MSG.format(what=what))

@@ -19,19 +19,20 @@ This rule flags, in ``anovos_tpu/serving/``:
   startup-only construction must carry an inline suppression with its
   justification.
 * **host-sync calls (``jax.device_get`` / ``.block_until_ready()``)
-  in functions with no dispatch attribution** — a function is attributed
-  when it is decorated ``@timed(...)``, itself enters
-  ``devprof.dispatch_bracket`` / ``devprof.node_bracket``, or is called
-  (one level, same module — including ``self.``-method calls) by an
-  attributed function.  All device dispatch on the request path must go
-  through the pre-compiled executables under ``timed()`` /
+  in functions with no dispatch attribution** — (engine v2) a function
+  is attributed when it is decorated ``@timed(...)``, itself enters
+  ``devprof.dispatch_bracket`` / ``devprof.node_bracket``, or is a
+  TRANSITIVE callee of an attributed function — attribution flows down
+  real call-graph edges (``self.``-method calls resolved through the
+  class), across module boundaries.  All device dispatch on the request
+  path must go through the pre-compiled executables under ``timed()`` /
   ``dispatch_bracket`` / ``node_bracket``.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, Set
+from typing import Iterable
 
 from tools.graftcheck.jaxmodel import attr_chain, call_chain
 from tools.graftcheck.registry import FileContext, Rule, register
@@ -53,39 +54,6 @@ def _is_jit_call(node: ast.AST) -> bool:
     return False
 
 
-def _is_timed_decorator(dec: ast.AST) -> bool:
-    if isinstance(dec, ast.Call):
-        return call_chain(dec) in ("timed", "obs.timed")
-    return attr_chain(dec) in ("timed", "obs.timed")
-
-
-_BRACKETS = ("dispatch_bracket", "node_bracket")
-
-
-def _enters_bracket(fn: ast.FunctionDef) -> bool:
-    for sub in ast.walk(fn):
-        if isinstance(sub, ast.Call):
-            chain = call_chain(sub) or ""
-            if any(chain.endswith(b) for b in _BRACKETS):
-                return True
-    return False
-
-
-def _called_names(fn: ast.FunctionDef) -> Set[str]:
-    """Bare function names and ``self.<name>`` method names ``fn`` calls."""
-    out: Set[str] = set()
-    for sub in ast.walk(fn):
-        if not isinstance(sub, ast.Call):
-            continue
-        f = sub.func
-        if isinstance(f, ast.Name):
-            out.add(f.id)
-        elif (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
-              and f.value.id in ("self", "cls")):
-            out.add(f.attr)
-    return out
-
-
 @register
 class ServingRequestPathRule(Rule):
     id = "GC013"
@@ -96,28 +64,18 @@ class ServingRequestPathRule(Rule):
         return relpath.startswith("anovos_tpu/serving/") or "gc013" in relpath
 
     def check(self, ctx: FileContext) -> Iterable:
+        # engine v2: the attribution closure is the whole-program one —
+        # @timed / bracket-entering functions plus all their transitive
+        # callees (a helper under a bracketed caller must not be
+        # double-bracketed)
+        attributed = set(ctx.view.get("attributed", ()))
         # EVERY def is scanned, including same-named methods on different
         # classes — a name-keyed dict would silently skip all but the first
         all_fns = [n for n in ast.walk(ctx.tree)
                    if isinstance(n, ast.FunctionDef)]
-        names = {fn.name for fn in all_fns}
-        attributed: Set[str] = set()
-        for fn in all_fns:
-            if any(_is_timed_decorator(d) for d in fn.decorator_list):
-                attributed.add(fn.name)
-            elif _enters_bracket(fn):
-                attributed.add(fn.name)
-        # attribution flows one level to same-module callees (a helper
-        # under a bracketed caller must not be double-bracketed).  Name-
-        # based: a call to a name attributes every same-named def — the
-        # conservative direction is bounded by how rare the collision is,
-        # and the scan itself never skips a body either way.
-        for fn in all_fns:
-            if fn.name in attributed:
-                attributed |= _called_names(fn) & names
-
         for fn in all_fns:
             name = fn.name
+            fn_attributed = ctx.qualname(fn) in attributed
             decorator_nodes = {id(d) for dec in fn.decorator_list
                                for d in ast.walk(dec)}
             for sub in ast.walk(fn):
@@ -132,7 +90,7 @@ class ServingRequestPathRule(Rule):
                         "hoist to module level (or suppress with a startup-"
                         "only justification)")
                     continue
-                if name in attributed:
+                if fn_attributed:
                     continue
                 chain = call_chain(sub) or ""
                 if chain in ("jax.device_get", "device_get") or \

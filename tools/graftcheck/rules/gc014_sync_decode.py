@@ -12,10 +12,14 @@ degrades back to round-10 synchronous behavior with no test failing.
 This rule keeps whole-table streaming passes routed through the prefetch
 iterator:
 
-* **scan scope** — functions whose name ends in ``_streaming`` (the
-  streaming-consumer naming contract: ``describe_streaming``,
-  ``missing_stats_streaming``, ``statistics_streaming``, …) anywhere
-  under ``anovos_tpu/``, including nested helpers defined inside them;
+* **scan scope** — (engine v2) the whole-program streaming-consumer cone:
+  every function transitively reachable, across module boundaries, from a
+  function whose name ends in ``_streaming`` (the streaming-consumer
+  naming contract: ``describe_streaming``, ``missing_stats_streaming``,
+  ``statistics_streaming``, …).  The cone deliberately does NOT descend
+  through the sanctioned pool boundary (``_run_pass``/``_iter_chunks``/
+  ``stream_schema``/the prefetch module) — decode there happens on pool
+  workers by design.  Findings name the reaching consumer;
 * **flagged calls** — the part-decode entry points: ``read_host_frame``,
   ``read_dataset`` (+ ``read_dataset_distributed``), ``_read_one_part``,
   ``guarded_part_read``, ``read_parquet``, ``read_avro``,
@@ -100,16 +104,30 @@ class SyncDecodeInStreamingConsumerRule(Rule):
         return relpath.startswith("anovos_tpu/") or "gc014" in relpath
 
     def check(self, ctx: FileContext):
+        cone = ctx.view.get("streaming", {})
         for fn in ast.walk(ctx.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            if not fn.name.endswith("_streaming"):
+            consumer = cone.get(ctx.qualname(fn))
+            if consumer is None:
                 continue
-            for call in ast.walk(fn):
+            for call in _walk_body(fn):
                 if not isinstance(call, ast.Call):
                     continue
                 what = _flagged(call)
                 if what:
                     yield ctx.finding(
                         self.id, call,
-                        _MSG.format(what=what, fn=fn.name))
+                        _MSG.format(what=what, fn=consumer))
+
+
+def _walk_body(fn: ast.AST):
+    """Walk one function's direct body — nested defs are cone members (or
+    not) under their own quals; lambdas stay in scope."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,56 @@
+"""GC018 — cross-module mutation of a lock-disciplined global off the lock.
+
+GC005 polices module globals WITHIN a file: a mutation of a mutable
+module global must either hold the module's lock or be baselined.  What
+it cannot see is the cross-module completion of the same hazard: module A
+declares ``_STATE`` and mutates it only under ``_STATE_LOCK`` (the global
+is lock-DISCIPLINED — some site somewhere holds a lock for it), while
+module B imports ``_STATE`` (or ``A`` itself) and mutates it directly on
+a call path that never traverses the lock.  Under the concurrent DAG
+executor two nodes can run A's locked writer and B's unlocked writer
+simultaneously — a data race the per-file rule structurally cannot flag.
+
+Engine v2 computes this whole-program (``callgraph.Program``):
+
+* every mutation site (assign/augassign/del/``.append``-style mutator
+  calls, bare-name and ``alias.G`` chains) resolves to its OWNING module's
+  global;
+* a global is **disciplined** when at least one mutation site anywhere
+  holds a lock (``with ...lock...:`` ancestor);
+* a cross-module site (mutating module ≠ owning module) is a violation
+  when the site itself is unlocked AND the call graph shows an
+  **unlocked path** into it — reachable from an entry point (scheduler
+  registration body or uncalled root) without traversing any
+  lock-holding call site.  A helper ONLY ever called under the owner's
+  lock is sanctioned and stays quiet.
+
+Same-module unlocked mutations remain GC005's jurisdiction — GC018 fires
+exclusively on the cross-module completion, so the two rules never
+double-report one site.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from tools.graftcheck.registry import FileContext, Rule, register
+
+
+@register
+class CrossModuleLockDisciplineRule(Rule):
+    id = "GC018"
+    title = "cross-module mutation of a lock-disciplined global on an unlocked path"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("anovos_tpu/") or "gc018" in relpath
+
+    def check(self, ctx: FileContext) -> Iterable:
+        for qual, line, owner_global, how in ctx.view.get("gc018", ()):
+            yield ctx.finding_at(
+                self.id, line, qual,
+                f"{how} mutation of lock-disciplined global {owner_global!r} "
+                "from another module without its lock — the owner guards "
+                "this state with a lock, and the call graph shows an "
+                "unlocked path into this site, so two scheduler nodes can "
+                "race the locked and unlocked writers; take the owning "
+                "module's lock here (or route through its locked mutator)")

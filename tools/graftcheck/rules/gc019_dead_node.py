@@ -1,0 +1,50 @@
+"""GC019 — dead node bodies: defined next to registrations, never wired in.
+
+The workflow registers scheduler nodes by defining ``_``-prefixed closures
+and handing them to ``pipe.spine``/``pipe.fanout``/``pipe.aside``/
+``sched.add``.  The failure mode this rule exists for: a refactor renames
+or re-registers a node and leaves the OLD closure behind — it still
+parses, still captures config, looks exactly like live pipeline code, and
+silently never runs.  Nothing else catches that (the function is private,
+so linters see no unused export; no test imports a nested closure).
+
+Engine v2 detects it whole-program (``callgraph.Program``): a function is
+a dead node body when ALL of
+
+* it is ``_``-prefixed (non-dunder) and NESTED inside a scope that
+  performs scheduler registrations (the registering idiom — module-level
+  helpers are public API surface and stay out of scope);
+* no registration anywhere passes it as a body (positionally or via
+  ``body=``, including through ``functools.partial`` wrapping);
+* the whole-repo call graph shows zero incoming call edges;
+* it is never referenced by name anywhere in its module (not stored,
+  not passed, not decorated onto something else).
+
+Delete the function, or wire it back into a registration.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from tools.graftcheck.registry import FileContext, Rule, register
+
+
+@register
+class DeadNodeBodyRule(Rule):
+    id = "GC019"
+    title = "node-body closure defined in a registering scope but never registered or called"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("anovos_tpu/") or "gc019" in relpath
+
+    def check(self, ctx: FileContext) -> Iterable:
+        for qual, line, scope in ctx.view.get("gc019", ()):
+            yield ctx.finding_at(
+                self.id, line, qual,
+                f"function {qual!r} is defined inside registering scope "
+                f"{scope!r} but is never registered as a node body, never "
+                "called, and never referenced — a dead node body, most "
+                "likely left behind by a rename/re-registration; delete it "
+                "or wire it back into a pipe.spine/fanout/aside/sched.add "
+                "registration")

@@ -1,0 +1,120 @@
+"""SARIF 2.1.0 output for graftcheck (``--format sarif``).
+
+One run per invocation: the tool driver carries the full rule catalogue
+(id + title + the rule docstring as full description), every finding
+becomes a ``result`` with a physical location (repo-relative URI +
+1-based line) and a logical location (the flagged symbol), and findings
+grandfathered by the committed baseline are emitted with a SARIF
+``suppression`` carrying the baseline's human justification — so a SARIF
+viewer shows exactly the debt the baseline workflow tracks, not a
+filtered subset.
+
+Output is deterministic: no timestamps, no absolute paths, sorted keys —
+two scans of one tree serialize byte-identically (the determinism gate
+covers this format too).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tools.graftcheck.registry import Finding, all_rules
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA_URI = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                    "master/Schemata/sarif-schema-2.1.0.json")
+
+# GC000 is the engine's synthetic unparseable-file finding — it has no Rule
+# subclass, but SARIF results must resolve to a driver rule entry.
+_SYNTHETIC_RULES: Dict[str, str] = {
+    "GC000": "file does not parse (syntax error)",
+}
+
+
+def _driver_rules(extra_ids: Iterable[str]) -> List[dict]:
+    rules: List[dict] = []
+    seen = set()
+    for rid, title in sorted(_SYNTHETIC_RULES.items()):
+        if rid in extra_ids:
+            rules.append({"id": rid, "name": rid,
+                          "shortDescription": {"text": title}})
+            seen.add(rid)
+    for r in all_rules():
+        entry = {
+            "id": r.id,
+            "name": type(r).__name__,
+            "shortDescription": {"text": r.title},
+        }
+        mod = sys.modules.get(type(r).__module__)
+        doc = ((mod.__doc__ if mod else "") or "").strip()
+        if doc:
+            entry["fullDescription"] = {"text": doc}
+        rules.append(entry)
+        seen.add(r.id)
+    for rid in sorted(set(extra_ids) - seen):  # belt + braces: never orphan
+        rules.append({"id": rid, "name": rid,
+                      "shortDescription": {"text": rid}})
+    return rules
+
+
+def _result(f: Finding, rule_index: Dict[str, int],
+            suppression: Optional[str]) -> dict:
+    res = {
+        "ruleId": f.rule,
+        "ruleIndex": rule_index[f.rule],
+        "level": "error" if f.rule == "GC000" else "warning",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path, "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(int(f.line), 1)},
+            },
+            "logicalLocations": [{"name": f.symbol, "kind": "function"}],
+        }],
+    }
+    if suppression is not None:
+        res["suppressions"] = [{
+            "kind": "external",
+            "justification": suppression,
+        }]
+    return res
+
+
+def to_sarif(findings: List[Finding],
+             baseline_entries: Optional[List[dict]] = None) -> dict:
+    """SARIF 2.1.0 log dict for ``findings``.  When ``baseline_entries`` is
+    given, findings covered by the baseline (same ``(rule, path, symbol,
+    message)`` identity, up to each entry's ``count``) are marked
+    suppressed with the entry's justification."""
+    budget: Dict[Tuple[str, str, str, str], List] = {}
+    for e in baseline_entries or ():
+        k = (e["rule"], e["path"], e["symbol"], e["message"])
+        ent = budget.setdefault(k, [0, e.get("justification", "")])
+        ent[0] += int(e.get("count", 1))
+    rules = _driver_rules({f.rule for f in findings})
+    rule_index = {r["id"]: i for i, r in enumerate(rules)}
+    results: List[dict] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message)):
+        ent = budget.get(f.key())
+        sup = None
+        if ent and ent[0] > 0:
+            ent[0] -= 1
+            sup = ent[1] or "baselined"
+        results.append(_result(f, rule_index, sup))
+    return {
+        "$schema": SARIF_SCHEMA_URI,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftcheck",
+                "informationUri": "tools/graftcheck/README.md",
+                "rules": rules,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {
+                "description": {"text": "repository root"},
+            }},
+            "columnKind": "utf16CodeUnits",
+            "results": results,
+        }],
+    }

@@ -110,6 +110,11 @@ TRACKED_FIELDS: Dict[str, Tuple[str, float]] = {
     # the scrape tail rides the usual shared-box latency band.
     "e2e_telemetry_overhead_pct": ("lower", 3.00),
     "e2e_scrape_p99_ms": ("lower", 0.60),
+    # static analysis (graftcheck engine v2): the warm incremental re-scan
+    # wall — the cost every tier-1 run pays once the cache is populated.
+    # A very wide band (interpreter start + AST parse on a timeshared
+    # box), but a blown cache shows up as a multiple, not a percentage.
+    "e2e_graftcheck_incr_s": ("lower", 1.00),
 }
 BASELINE_WINDOW = 3
 
